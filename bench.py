@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Headline benchmark: steady-state training throughput, images/sec/chip.
+
+Runs the faithful reference workload — the 5-layer CIFAR-10 CNN at global
+batch 128 (``cifar10cnn.py:13,94-147``) — as one compiled SPMD step over all
+available devices, fed by the real input pipeline (shuffle buffer + host→HBM
+prefetch), and measures steady-state throughput after compile.
+
+Baseline note: the reference publishes NO performance numbers
+(``README.md``, SURVEY §6 — ``BASELINE.json.published == {}``).
+``vs_baseline`` is therefore anchored to the driver's north-star throughput:
+≥20,000 steps × batch 128 in <120 s on a v4-8 ⇒ 21,333 images/sec ÷ 8 chips
+= 2,666.7 images/sec/chip. vs_baseline = measured / 2666.7.
+
+Prints ONE JSON line:
+  {"metric": "train_throughput", "value": N, "unit": "images/sec/chip",
+   "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+NORTH_STAR_IMAGES_PER_SEC_PER_CHIP = 20000 * 128 / 120.0 / 8.0  # 2666.7
+
+
+def main() -> None:
+    import jax
+
+    from dml_cnn_cifar10_tpu.config import reference_config
+    from dml_cnn_cifar10_tpu.data import pipeline as pipe
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+
+    cfg = reference_config()
+    cfg.data.dataset = "synthetic"           # zero-egress box: CIFAR-layout
+    cfg.data.data_dir = "/tmp/bench_cifar"   # synthetic records, real pipeline
+    cfg.data.synthetic_train_records = 20480
+    cfg.data.synthetic_test_records = 1024
+    cfg.batch_size = 128
+    cfg.log_dir = "/tmp/bench_logs_unused"
+    cfg.checkpoint_every = 10**9             # no checkpoint I/O in the loop
+
+    trainer = Trainer(cfg)
+    state = trainer.init_or_restore()
+    n_chips = len(jax.devices())
+
+    train_it = pipe.input_pipeline(cfg.data, cfg.batch_size, train=True)
+    prefetch = pipe.PrefetchIterator(train_it, depth=cfg.data.prefetch,
+                                     place=trainer._placed)
+
+    # Warmup: first call compiles (~20-40s), a few more to fill the pipeline.
+    for _ in range(8):
+        state, metrics = trainer.train_step(state, *next(prefetch))
+    jax.block_until_ready(metrics["loss"])
+
+    # Timed steady state.
+    steps = 300
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.train_step(state, *next(prefetch))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+    prefetch.close()
+
+    images_per_sec = steps * cfg.batch_size / dt
+    per_chip = images_per_sec / n_chips
+    print(json.dumps({
+        "metric": "train_throughput",
+        "value": round(per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            per_chip / NORTH_STAR_IMAGES_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""Drop-in entrypoint named after the reference's single script.
+
+Same flags (``--ps_hosts --worker_hosts --job_name --task_index --data_dir
+--log_dir``), same defaults, same console output format — but running the
+TPU-native SPMD framework instead of a TF1 parameter-server cluster.
+"""
+
+import sys
+
+from dml_cnn_cifar10_tpu.cli.main import main
+
+if __name__ == "__main__":
+    sys.exit(main())

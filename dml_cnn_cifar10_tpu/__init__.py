@@ -1,0 +1,35 @@
+"""dml_cnn_cifar10_tpu — a TPU-native distributed CNN training framework.
+
+A ground-up JAX/XLA/pjit/Pallas re-design of the capabilities of the
+reference repo ``Huzo/Distributed-Machine-Learning-using-CNN-CIFAR-10-dataset-``
+(a TF1 parameter-server CIFAR-10 CNN trainer, ``cifar10cnn.py``).
+
+Layers (the reference's implicit TF-runtime layers made explicit):
+
+- :mod:`~dml_cnn_cifar10_tpu.data`     — host-side input pipeline
+  (replaces TF queue runners / FixedLengthRecordReader,
+  reference ``cifar10cnn.py:54-91``).
+- :mod:`~dml_cnn_cifar10_tpu.ops`      — XLA/Pallas compute primitives
+  (replaces TF C++ op kernels invoked at ``cifar10cnn.py:107-145``).
+- :mod:`~dml_cnn_cifar10_tpu.models`   — model zoo (reference CNN at parity,
+  plus the config ladder: CIFAR-100 head, ResNet, ViT).
+- :mod:`~dml_cnn_cifar10_tpu.train`    — loss/optimizer/metrics/driver
+  (reference ``cifar10cnn.py:150-176,228-242``).
+- :mod:`~dml_cnn_cifar10_tpu.parallel` — mesh/pjit/collectives/multi-host
+  (replaces the gRPC PS cluster, ``cifar10cnn.py:184-196``).
+- :mod:`~dml_cnn_cifar10_tpu.ckpt`     — checkpoint/restore
+  (replaces MonitoredTrainingSession's saver, ``cifar10cnn.py:222``).
+- :mod:`~dml_cnn_cifar10_tpu.cli`      — reference-compatible CLI
+  (``cifar10cnn.py:245-274``).
+"""
+
+__version__ = "0.1.0"
+
+from dml_cnn_cifar10_tpu.config import (  # noqa: F401
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    ParallelConfig,
+    TrainConfig,
+    reference_config,
+)

@@ -1,0 +1,9 @@
+"""Checkpoint / restore."""
+
+from dml_cnn_cifar10_tpu.ckpt.checkpoint import (  # noqa: F401
+    CheckpointManager,
+    all_checkpoint_steps,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)

@@ -1,0 +1,105 @@
+"""Pytree checkpointing: save/restore the full training state.
+
+Replaces the MonitoredTrainingSession saver the reference relies on —
+``checkpoint_dir=FLAGS.log_dir`` makes the chief save periodically and any
+restart restore the latest checkpoint and resume at the saved global step
+(``cifar10cnn.py:222``, SURVEY §3.5). Same contract here:
+
+- ``CheckpointManager.maybe_save(state)`` — periodic, chief-only
+  (process 0), atomic (tmp + rename), bounded retention.
+- ``restore_checkpoint(dir, target)`` — returns the restored state or the
+  target untouched when no checkpoint exists, so startup is always
+  "restore-if-present" exactly like MTS.
+
+Format: flax msgpack bytes of the state pytree (arrays are fetched to host
+first — checkpoints of sharded/replicated device arrays just work). A
+``checkpoint`` index file names the latest step, mirroring TF's
+``checkpoint`` protofile convention.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import jax
+
+from flax import serialization
+
+_CKPT_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
+
+
+def _ckpt_path(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, f"ckpt_{step}.msgpack")
+
+
+def save_checkpoint(ckpt_dir: str, state: Any, step: int,
+                    keep: int = 3) -> str:
+    """Atomically write ``ckpt_<step>.msgpack``; prune to ``keep`` newest."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    host_state = jax.tree.map(lambda x: jax.device_get(x), state)
+    data = serialization.to_bytes(host_state)
+    path = _ckpt_path(ckpt_dir, step)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    with open(os.path.join(ckpt_dir, "checkpoint"), "w") as f:
+        f.write(os.path.basename(path) + "\n")
+    steps = sorted(all_checkpoint_steps(ckpt_dir))
+    for old in steps[:-keep]:
+        try:
+            os.remove(_ckpt_path(ckpt_dir, old))
+        except OSError:
+            pass
+    return path
+
+
+def all_checkpoint_steps(ckpt_dir: str):
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return [int(m.group(1)) for name in os.listdir(ckpt_dir)
+            if (m := _CKPT_RE.match(name))]
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    steps = all_checkpoint_steps(ckpt_dir)
+    return _ckpt_path(ckpt_dir, max(steps)) if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, target: Any,
+                       sharding=None) -> Any:
+    """Restore the latest checkpoint into ``target``'s structure, or return
+    ``target`` unchanged if none exists. ``sharding`` (e.g. a replicated
+    NamedSharding) places the restored arrays back on the mesh."""
+    path = latest_checkpoint(ckpt_dir)
+    if path is None:
+        return target
+    with open(path, "rb") as f:
+        data = f.read()
+    host_target = jax.tree.map(lambda x: jax.device_get(x), target)
+    restored = serialization.from_bytes(host_target, data)
+    if sharding is not None:
+        restored = jax.device_put(restored, sharding)
+    return restored
+
+
+class CheckpointManager:
+    """Periodic chief-only saver (the CheckpointSaverHook role)."""
+
+    def __init__(self, ckpt_dir: str, every_steps: int, keep: int = 3,
+                 is_chief: Optional[bool] = None):
+        self.ckpt_dir = ckpt_dir
+        self.every_steps = max(1, every_steps)
+        self.keep = keep
+        self.is_chief = (jax.process_index() == 0) if is_chief is None \
+            else is_chief
+
+    def maybe_save(self, state: Any, step: int, force: bool = False) -> bool:
+        if not self.is_chief:
+            return False
+        if not force and step % self.every_steps != 0:
+            return False
+        save_checkpoint(self.ckpt_dir, state, step, keep=self.keep)
+        return True

@@ -1,0 +1,3 @@
+"""Reference-compatible command line interface."""
+
+from dml_cnn_cifar10_tpu.cli.main import main, build_parser  # noqa: F401

@@ -1,0 +1,145 @@
+"""CLI: keeps the reference's flags working, adds the framework's own.
+
+Reference flags (``cifar10cnn.py:245-273``): ``--ps_hosts --worker_hosts
+--job_name --task_index --data_dir --log_dir``. Mapping to the SPMD world:
+
+- ``--job_name=ps`` — parameter servers don't exist under SPMD; the process
+  prints a deprecation note and exits 0 so old 3-terminal launch scripts
+  still "work" (the PS terminal just returns immediately).
+- ``--worker_hosts`` + ``--task_index`` — become the ``jax.distributed``
+  process set: ``num_processes=len(worker_hosts)``,
+  ``process_id=task_index``, coordinator = first worker host.
+- ``--ps_hosts`` — accepted and ignored (deprecation note).
+- ``--data_dir`` — honored here. (The reference parses it but ignores it,
+  using the hardcoded ``cifar10data`` — ``cifar10cnn.py:26`` vs ``:265-268``;
+  we default to the same hardcoded value, honoring the flag when given.)
+- ``--log_dir`` — checkpoint dir, as in the reference (``:222``).
+
+New flags expose the config dataclasses (model/steps/batch/fidelity/mesh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from dml_cnn_cifar10_tpu import config as config_lib
+
+
+def _bool(v: str) -> bool:
+    return v.lower() == "true"   # the reference's custom bool (:247)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dml_cnn_cifar10_tpu",
+        description="TPU-native distributed CNN training "
+                    "(reference-compatible CLI)")
+    p.register("type", "bool", _bool)
+    # --- reference flags (cifar10cnn.py:249-272) ---
+    p.add_argument("--ps_hosts", type=str, default="",
+                   help="DEPRECATED: comma-separated ps hosts (ignored; "
+                        "SPMD has no parameter servers)")
+    p.add_argument("--worker_hosts", type=str, default="",
+                   help="Comma-separated hostname:port list; becomes the "
+                        "jax.distributed process set")
+    p.add_argument("--job_name", type=str, default="",
+                   help="One of 'ps', 'worker' (ps exits immediately)")
+    p.add_argument("--task_index", type=int, default=0,
+                   help="Index of task within the job (process_id)")
+    p.add_argument("--data_dir", type=str, default="cifar10data",
+                   help="Directory for input data")
+    p.add_argument("--log_dir", type=str, default="/tmp/train_logs",
+                   help="Checkpoint/log directory")
+    # --- framework flags ---
+    p.add_argument("--model", type=str, default="cnn",
+                   choices=["cnn", "resnet18", "resnet50", "vit_tiny"])
+    p.add_argument("--dataset", type=str, default="cifar10",
+                   choices=["cifar10", "cifar100", "synthetic"])
+    p.add_argument("--batch_size", type=int, default=128)
+    p.add_argument("--total_steps", type=int, default=20000)
+    p.add_argument("--output_every", type=int, default=200,
+                   help="train-metrics cadence (reference OUTPUT_EVERY)")
+    p.add_argument("--eval_every", type=int, default=500,
+                   help="eval cadence (reference EVAL_EVERY)")
+    p.add_argument("--checkpoint_every", type=int, default=1000)
+    p.add_argument("--learning_rate", type=float, default=0.1)
+    p.add_argument("--fidelity", type=str, default="faithful",
+                   choices=["faithful", "fixed"],
+                   help="faithful reproduces the reference quirks (ReLU'd "
+                        "logits, dead LR decay, single-batch eval, raw "
+                        "pixels); fixed applies the sane versions")
+    p.add_argument("--model_axis", type=int, default=1,
+                   help="tensor-parallel mesh degree")
+    p.add_argument("--seq_axis", type=int, default=1,
+                   help="sequence-parallel mesh degree")
+    p.add_argument("--explicit_collectives", type="bool", default=False,
+                   help="use the shard_map+psum step instead of jit "
+                        "auto-partitioning")
+    p.add_argument("--compute_dtype", type=str, default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--profile_dir", type=str, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
+    make = (config_lib.reference_config if args.fidelity == "faithful"
+            else config_lib.fixed_config)
+    cfg = make(
+        batch_size=args.batch_size,
+        total_steps=args.total_steps,
+        output_every=args.output_every,
+        eval_every=args.eval_every,
+        checkpoint_every=args.checkpoint_every,
+        log_dir=args.log_dir,
+        metrics_jsonl=args.metrics_jsonl,
+        profile_dir=args.profile_dir,
+        seed=args.seed,
+    )
+    cfg.data.dataset = args.dataset
+    cfg.data.data_dir = args.data_dir
+    if args.dataset == "cifar100":
+        cfg.data.num_classes = cfg.model.num_classes = 100
+    cfg.model.name = args.model
+    cfg.model.compute_dtype = args.compute_dtype
+    cfg.optim.learning_rate = args.learning_rate
+    cfg.parallel.model_axis = args.model_axis
+    cfg.parallel.seq_axis = args.seq_axis
+    cfg.parallel.explicit_collectives = args.explicit_collectives
+    return cfg
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args, unparsed = build_parser().parse_known_args(argv)
+    if unparsed:
+        print(f"[cli] ignoring unrecognized args: {unparsed}",
+              file=sys.stderr)
+
+    if args.job_name == "ps":
+        # The reference blocks a whole process on server.join()
+        # (cifar10cnn.py:191-192). SPMD has no parameter servers: parameters
+        # live replicated/sharded in device HBM and gradients all-reduce
+        # over ICI. The ps role exits successfully for launch-script compat.
+        print("[cli] job_name=ps is obsolete under SPMD: parameters live on "
+              "device, gradients all-reduce over ICI. Nothing to serve; "
+              "exiting.")
+        return 0
+
+    workers = [h for h in args.worker_hosts.split(",") if h]
+    if len(workers) > 1:
+        from dml_cnn_cifar10_tpu.parallel import multihost
+        multihost.initialize_from_hosts(workers, args.task_index)
+
+    cfg = config_from_args(args)
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+    result = Trainer(cfg, task_index=args.task_index).fit()
+    print(f"[cli] done at step {result.final_step}; "
+          f"{result.images_per_sec:.1f} images/sec")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

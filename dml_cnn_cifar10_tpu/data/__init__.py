@@ -1,0 +1,26 @@
+"""Host-side input pipeline.
+
+Replaces the reference's TF queue runtime — ``string_input_producer`` →
+``FixedLengthRecordReader`` → ``shuffle_batch`` with its background
+queue-runner threads (``cifar10cnn.py:54-91,223``) — with an explicit
+host-side loader: mmap'd record files, a shuffle buffer, NumPy decode/crop,
+and a double-buffered host→device prefetcher. On TPU the goal is identical:
+keep the chip fed so the compiled step never waits on input.
+"""
+
+from dml_cnn_cifar10_tpu.data.download import (  # noqa: F401
+    ensure_dataset,
+    generate_synthetic_dataset,
+    train_files,
+    test_files,
+)
+from dml_cnn_cifar10_tpu.data.records import (  # noqa: F401
+    read_record_file,
+    decode_records,
+)
+from dml_cnn_cifar10_tpu.data.pipeline import (  # noqa: F401
+    Batch,
+    input_pipeline,
+    ShuffleBatchIterator,
+    PrefetchIterator,
+)

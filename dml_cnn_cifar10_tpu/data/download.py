@@ -1,0 +1,137 @@
+"""Dataset acquisition: download-or-reuse, plus an offline synthetic mode.
+
+Mirrors ``download_data`` (``cifar10cnn.py:34-52``): fetch
+``cifar-10-binary.tar.gz`` from cs.toronto.edu with a progress callback,
+extract into ``<data_dir>/cifar-10-batches-bin``, and skip the download when
+the tarball is already present. Additionally supports CIFAR-100 (same binary
+framing, 1 coarse + 1 fine label byte) and a fully offline *synthetic* mode
+that writes files in the exact CIFAR binary layout so every downstream stage
+(reader, shuffle buffer, crop, training) is exercised without network access.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+import urllib.request
+from typing import List
+
+import numpy as np
+
+from dml_cnn_cifar10_tpu.config import DataConfig
+
+CIFAR10_URL = "http://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz"
+CIFAR100_URL = "http://www.cs.toronto.edu/~kriz/cifar-100-binary.tar.gz"
+CIFAR10_FOLDER = "cifar-10-batches-bin"   # extract_folder (cifar10cnn.py:27)
+CIFAR100_FOLDER = "cifar-100-binary"
+
+
+def _progress(url: str):
+    # Console progress bar, same format as cifar10cnn.py:47-49.
+    def cb(block_num, block_size, total_size):
+        pct = float(block_num * block_size) / float(max(total_size, 1)) * 100.0
+        print("\r Downloading {} - {:.2f}%".format(url, pct), end="")
+    return cb
+
+
+def download_and_extract(data_dir: str, url: str) -> str:
+    """Fetch + untar ``url`` into ``data_dir``.
+
+    Unlike the reference (which skips extraction whenever the tarball exists,
+    ``cifar10cnn.py:43-44`` — leaving a half-extracted dir broken forever),
+    extraction re-runs whenever this is called: callers only call it when
+    the target .bin files are missing.
+    """
+    os.makedirs(data_dir, exist_ok=True)
+    data_file = os.path.join(data_dir, os.path.basename(url))
+    if not os.path.isfile(data_file):
+        data_file, _ = urllib.request.urlretrieve(url, data_file,
+                                                  _progress(url))
+        print()
+    tarfile.open(data_file, "r:gz").extractall(data_dir)
+    return data_dir
+
+
+def train_files(cfg: DataConfig) -> List[str]:
+    """Training shards. CIFAR-10: ``data_batch_{1..5}.bin`` (cifar10cnn.py:78)."""
+    if cfg.dataset in ("cifar10", "synthetic"):
+        base = os.path.join(cfg.data_dir, CIFAR10_FOLDER)
+        return [os.path.join(base, f"data_batch_{i}.bin") for i in range(1, 6)]
+    if cfg.dataset == "cifar100":
+        return [os.path.join(cfg.data_dir, CIFAR100_FOLDER, "train.bin")]
+    raise ValueError(f"unknown dataset {cfg.dataset!r}")
+
+
+def test_files(cfg: DataConfig) -> List[str]:
+    """Test shard: ``test_batch.bin`` (cifar10cnn.py:80)."""
+    if cfg.dataset in ("cifar10", "synthetic"):
+        return [os.path.join(cfg.data_dir, CIFAR10_FOLDER, "test_batch.bin")]
+    if cfg.dataset == "cifar100":
+        return [os.path.join(cfg.data_dir, CIFAR100_FOLDER, "test.bin")]
+    raise ValueError(f"unknown dataset {cfg.dataset!r}")
+
+
+def label_bytes(cfg: DataConfig) -> int:
+    """CIFAR-10 records lead with 1 label byte; CIFAR-100 with 2 (coarse+fine)."""
+    return 2 if cfg.dataset == "cifar100" else 1
+
+
+def generate_synthetic_dataset(cfg: DataConfig, seed: int = 0) -> None:
+    """Write CIFAR-layout binary files with class-separable random images.
+
+    Byte layout per record is identical to the real dataset (label byte(s) +
+    CHW uint8 image, ``cifar10cnn.py:24-25,58-62``). Images are Gaussian noise
+    around a per-class mean color so a real model can overfit them — that lets
+    integration tests assert "loss decreases / accuracy beats chance" offline.
+    """
+    rng = np.random.default_rng(seed)
+    nlb = label_bytes(cfg)
+    img_len = cfg.image_height * cfg.image_width * cfg.num_channels
+    # One per-class mean-color table for the WHOLE dataset (train and test
+    # shards must share the class→color mapping or nothing generalizes).
+    means = rng.integers(30, 226, size=(cfg.num_classes, cfg.num_channels))
+
+    def write(path: str, n: int) -> None:
+        if os.path.isfile(path):
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        labels = rng.integers(0, cfg.num_classes, size=n, dtype=np.uint8)
+        recs = np.empty((n, nlb + img_len), dtype=np.uint8)
+        for lb in range(nlb):
+            recs[:, lb] = labels  # coarse == fine for synthetic CIFAR-100
+        chw = rng.normal(
+            means[labels][:, :, None, None],
+            40.0,
+            size=(n, cfg.num_channels, cfg.image_height, cfg.image_width),
+        )
+        recs[:, nlb:] = np.clip(chw, 0, 255).astype(np.uint8).reshape(n, img_len)
+        with open(path, "wb") as f:
+            f.write(recs.tobytes())
+
+    per_shard = max(1, cfg.synthetic_train_records // len(train_files(cfg)))
+    for path in train_files(cfg):
+        write(path, per_shard)
+    for path in test_files(cfg):
+        write(path, cfg.synthetic_test_records)
+
+
+def ensure_dataset(cfg: DataConfig) -> None:
+    """Make sure the binary shards exist: download, or synthesize offline.
+
+    Parity entrypoint for ``download_data()`` (``cifar10cnn.py:34-52``). In
+    ``synthetic`` mode (or when the download fails — e.g. an air-gapped host)
+    it falls back to :func:`generate_synthetic_dataset`.
+    """
+    if cfg.dataset == "synthetic":
+        generate_synthetic_dataset(cfg, seed=cfg.seed)
+        return
+    needed = train_files(cfg) + test_files(cfg)
+    if all(os.path.isfile(p) for p in needed):
+        return
+    url = CIFAR100_URL if cfg.dataset == "cifar100" else CIFAR10_URL
+    try:
+        download_and_extract(cfg.data_dir, url)
+    except Exception as e:  # no network: degrade to synthetic with a warning
+        print(f"[data] download failed ({e!r}); generating synthetic "
+              f"CIFAR-format data instead")
+        generate_synthetic_dataset(cfg, seed=cfg.seed)

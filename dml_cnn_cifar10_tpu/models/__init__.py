@@ -1,0 +1,13 @@
+"""Model zoo.
+
+``cnn`` is the reference architecture at parity (``cifar10cnn.py:94-147``);
+``resnet18``/``resnet50`` and ``vit_tiny`` are the BASELINE.json config-ladder
+models. All models share one functional interface:
+
+  init_params(key, model_cfg, data_cfg) -> params pytree
+  apply(params, images, model_cfg, train=...) -> logits      (stateless), or
+  apply(params, state, images, model_cfg, train=...) -> (logits, new_state)
+  (stateful models, e.g. BatchNorm running stats — see registry.has_state)
+"""
+
+from dml_cnn_cifar10_tpu.models.registry import get_model, MODELS  # noqa: F401

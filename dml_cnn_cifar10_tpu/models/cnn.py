@@ -1,0 +1,84 @@
+"""The reference 5-layer CNN (2 conv + 3 FC), as a functional JAX model.
+
+Exact architecture from ``create_cnn`` (``cifar10cnn.py:94-147``):
+
+  conv1 5×5×C→64 s1 SAME + bias + ReLU   (:105-110)
+  maxpool 3×3 s2 SAME                    (:113)
+  conv2 5×5×64→64 s1 SAME + bias + ReLU  (:116-121)
+  maxpool 3×3 s2 SAME                    (:123)
+  flatten                                (:126-127)
+  FC →384 + ReLU                         (:130-133)
+  FC 384→192 + ReLU                      (:136-139)
+  FC 192→num_classes (+ReLU in faithful mode — the reference clamps its
+  logits at 0, ``:145``; ``ModelConfig.logit_relu`` controls this)
+
+Init: truncated normal σ=0.05 for weights (``:97-98``), constant 0.1 for
+biases (``:100-101``). Parameters live in a flat dict pytree; the weight
+sharing the reference gets from ``tf.get_variable`` reuse (``:204-210``)
+falls out of functional purity — the same pytree is passed to the train and
+eval applications.
+
+For CIFAR-100 the only change is ``num_classes=100`` (the "head swap"
+config); for bigger inputs the flatten dim is derived from the config, not
+hardcoded to 2304.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig
+from dml_cnn_cifar10_tpu.ops import layers as L
+
+Params = Dict[str, Any]
+
+
+def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
+    dtype = jnp.dtype(cfg.dtype)
+    h, w = L.pooled_hw(data.crop_height, data.crop_width, n_pools=2)
+    flat = h * w * 64
+    ks = jax.random.split(key, 5)
+    tn = lambda k, shape: L.truncated_normal_init(k, shape, cfg.init_stddev,
+                                                  dtype)
+    bias = lambda shape: L.bias_init(shape, cfg.bias_init, dtype)
+    return {
+        "conv1": {"kernel": tn(ks[0], (5, 5, data.num_channels, 64)),
+                  "bias": bias((64,))},
+        "conv2": {"kernel": tn(ks[1], (5, 5, 64, 64)), "bias": bias((64,))},
+        "full1": {"kernel": tn(ks[2], (flat, 384)), "bias": bias((384,))},
+        "full2": {"kernel": tn(ks[3], (384, 192)), "bias": bias((192,))},
+        "full3": {"kernel": tn(ks[4], (192, cfg.num_classes)),
+                  "bias": bias((cfg.num_classes,))},
+    }
+
+
+def apply(params: Params, images: jax.Array, cfg: ModelConfig,
+          train: bool = True) -> jax.Array:
+    """Forward pass: NHWC images → logits [B, num_classes].
+
+    ``train`` is accepted for registry uniformity (this model has no
+    BatchNorm/dropout, ``cifar10cnn.py:94-147``).
+    """
+    del train
+    cdt = jnp.dtype(cfg.compute_dtype)
+    x = images.astype(cdt)
+    p = jax.tree.map(lambda a: a.astype(cdt), params)
+
+    x = jax.nn.relu(L.conv2d(x, p["conv1"]["kernel"]) + p["conv1"]["bias"])
+    x = L.max_pool(x)
+    x = jax.nn.relu(L.conv2d(x, p["conv2"]["kernel"]) + p["conv2"]["bias"])
+    x = L.max_pool(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(L.dense(x, p["full1"]["kernel"], p["full1"]["bias"]))
+    x = jax.nn.relu(L.dense(x, p["full2"]["kernel"], p["full2"]["bias"]))
+    logits = L.dense(x, p["full3"]["kernel"], p["full3"]["bias"])
+    if cfg.logit_relu:  # faithful: reference ReLUs its logits (:145)
+        logits = jax.nn.relu(logits)
+    return logits.astype(jnp.float32)
+
+
+def param_count(params: Params) -> int:
+    return sum(int(a.size) for a in jax.tree.leaves(params))

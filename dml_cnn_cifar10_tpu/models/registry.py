@@ -1,0 +1,55 @@
+"""Model registry: name → (init, apply, has_state)."""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+
+class ModelDef(NamedTuple):
+    init: Callable          # (key, model_cfg, data_cfg) -> params
+    apply: Callable         # stateless: (params, images, cfg, train) -> logits
+                            # stateful: (params, state, images, cfg, train)
+                            #           -> (logits, new_state)
+    init_state: Callable    # (params) -> mutable state pytree ({} if none)
+    has_state: bool
+
+
+def _cnn() -> ModelDef:
+    from dml_cnn_cifar10_tpu.models import cnn
+    return ModelDef(cnn.init_params, cnn.apply, lambda p: {}, False)
+
+
+def _resnet(depth: int) -> Callable[[], ModelDef]:
+    def make() -> ModelDef:
+        from dml_cnn_cifar10_tpu.models import resnet
+        return ModelDef(
+            lambda k, m, d: resnet.init_params(k, m, d, depth=depth),
+            resnet.apply,
+            resnet.init_state,
+            True,
+        )
+    return make
+
+
+def _vit() -> ModelDef:
+    from dml_cnn_cifar10_tpu.models import vit
+    return ModelDef(vit.init_params, vit.apply, lambda p: {}, False)
+
+
+MODELS = {
+    "cnn": _cnn,
+    "resnet18": _resnet(18),
+    "resnet50": _resnet(50),
+    "vit_tiny": _vit,
+}
+
+
+def get_model(name: str) -> ModelDef:
+    if name not in MODELS:
+        raise ValueError(f"unknown model {name!r}; have {sorted(MODELS)}")
+    try:
+        return MODELS[name]()
+    except ImportError as e:
+        raise NotImplementedError(
+            f"model {name!r} is registered but its module is not built yet "
+            f"({e}); available today: cnn") from e

@@ -1,0 +1,70 @@
+"""Core layer primitives: init schemes + conv/pool/dense on XLA.
+
+Parity notes (all against ``/root/reference/cifar10cnn.py``):
+- ``truncated_normal_init`` == ``tf.truncated_normal_initializer(stddev=0.05)``
+  (``:97-98``): normal samples truncated to ±2σ (resampled, not clipped),
+  NOT variance-rescaled — ``jax.random.truncated_normal`` has exactly these
+  semantics.
+- ``bias_init`` == ``tf.constant_initializer(0.1)`` (``:100-101``).
+- ``conv2d`` == ``tf.nn.conv2d(..., strides=[1,1,1,1], padding='SAME')``
+  (``:107,118``) in NHWC/HWIO layout.
+- ``max_pool`` == ``tf.nn.max_pool(ksize=[1,3,3,1], strides=[1,2,2,1],
+  'SAME')`` (``:113,123``): overlapping 3×3/2 windows, -inf padding.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def truncated_normal_init(key, shape, stddev: float = 0.05,
+                          dtype=jnp.float32) -> jax.Array:
+    """Truncated-normal (±2σ) init, TF-compatible (no rescaling)."""
+    return stddev * jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                                dtype=dtype)
+
+
+def bias_init(shape, value: float = 0.1, dtype=jnp.float32) -> jax.Array:
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def conv2d(x: jax.Array, kernel: jax.Array, stride: int = 1,
+           padding: str = "SAME") -> jax.Array:
+    """NHWC conv with HWIO kernel → NHWC out (MXU-friendly layout on TPU)."""
+    return lax.conv_general_dilated(
+        x, kernel,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def max_pool(x: jax.Array, window: int = 3, stride: int = 2,
+             padding: str = "SAME") -> jax.Array:
+    """Max pool over NHWC spatial dims via ``lax.reduce_window``."""
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding=padding,
+    )
+
+
+def dense(x: jax.Array, w: jax.Array, b: jax.Array) -> jax.Array:
+    """x @ w + b — a single MXU matmul; keep inputs 2-D [B, D]."""
+    return jnp.dot(x, w) + b
+
+
+def pooled_hw(h: int, w: int, n_pools: int, window: int = 3,
+              stride: int = 2) -> Tuple[int, int]:
+    """Spatial dims after ``n_pools`` SAME-padded stride-2 pools (ceil div)."""
+    for _ in range(n_pools):
+        h = -(-h // stride)
+        w = -(-w // stride)
+    return h, w

@@ -1,0 +1,29 @@
+"""Distribution layer: device meshes, SPMD step compilation, collectives,
+multi-host bootstrap.
+
+This subsystem replaces the reference's entire distributed runtime — the
+gRPC ``ClusterSpec``/``Server`` parameter-server cluster, device placement
+via ``replica_device_setter``, and the per-step parameter/gradient RPCs
+(``cifar10cnn.py:184-196`` and the implicit graph partitioning under every
+``session.run``). The TPU-native design has no server processes at all: one
+pjit-compiled SPMD step runs on every chip, the batch is sharded over the
+``data`` mesh axis, and gradient aggregation is a ``psum`` all-reduce
+compiled into the step and scheduled on ICI by XLA.
+
+The one deliberate semantic change from the reference: updates are
+**synchronous** (async staleness was an artifact of the PS architecture, not
+a capability). See SURVEY.md §2.3.
+"""
+
+from dml_cnn_cifar10_tpu.parallel.mesh import (  # noqa: F401
+    build_mesh,
+    batch_sharding,
+    replicated,
+    shard_batch,
+)
+from dml_cnn_cifar10_tpu.parallel.step import (  # noqa: F401
+    TrainState,
+    make_train_step,
+    make_eval_step,
+    init_train_state,
+)

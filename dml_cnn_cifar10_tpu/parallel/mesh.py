@@ -1,0 +1,72 @@
+"""Device mesh construction + sharding helpers.
+
+Axes:
+- ``data``  — synchronous data parallelism (batch dim). The replacement for
+  the reference's async PS data parallelism (``cifar10cnn.py:195-196``).
+- ``model`` — tensor parallelism (attention heads / MLP columns in ViT,
+  wide FCs elsewhere). Degree 1 for reference parity.
+- ``seq``   — sequence/context parallelism (ring attention) for long-context
+  configs. Degree 1 for image models at CIFAR scale.
+
+Collectives ride ICI when the mesh axes are laid out over the physical
+torus; DCN is only used for the multi-host bootstrap
+(:mod:`~dml_cnn_cifar10_tpu.parallel.multihost`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dml_cnn_cifar10_tpu.config import ParallelConfig
+
+AXES = ("data", "model", "seq")
+
+
+def build_mesh(cfg: Optional[ParallelConfig] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ``(data, model, seq)`` mesh over the given (default: all)
+    devices. ``data_axis=-1`` absorbs every device not claimed by
+    model/seq."""
+    cfg = cfg or ParallelConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    model, seq = max(1, cfg.model_axis), max(1, cfg.seq_axis)
+    data = cfg.data_axis if cfg.data_axis > 0 else n // (model * seq)
+    if data * model * seq != n:
+        raise ValueError(
+            f"mesh {data}x{model}x{seq} != {n} devices "
+            f"(data_axis={cfg.data_axis}, model_axis={model}, seq_axis={seq})")
+    arr = np.asarray(devices).reshape(data, model, seq)
+    return Mesh(arr, AXES)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
+    """Leading (batch) dim over ``data``; rest replicated."""
+    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+
+
+def shard_batch(mesh: Mesh, images, labels):
+    """Place a host batch on the mesh, batch dim sharded over ``data``.
+
+    Single-process: a plain ``device_put`` with a NamedSharding. Multi-host:
+    each process contributes its local slice of the global batch
+    (``jax.make_array_from_process_local_data``), the moral replacement for
+    every worker feeding its own queue in the reference
+    (``cifar10cnn.py:201``).
+    """
+    img_s = batch_sharding(mesh, images.ndim)
+    lab_s = batch_sharding(mesh, labels.ndim)
+    if jax.process_count() == 1:
+        return (jax.device_put(images, img_s), jax.device_put(labels, lab_s))
+    return (
+        jax.make_array_from_process_local_data(img_s, images),
+        jax.make_array_from_process_local_data(lab_s, labels),
+    )

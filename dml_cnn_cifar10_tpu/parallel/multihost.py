@@ -1,0 +1,51 @@
+"""Multi-host bootstrap over DCN.
+
+Replaces ``tf.train.ClusterSpec`` + ``tf.train.Server`` (``cifar10cnn.py:
+184-192``): instead of a gRPC parameter-server cluster there is one SPMD
+program per host, bootstrapped by ``jax.distributed.initialize`` (the
+coordinator fills the role of the TF master; all training traffic is XLA
+collectives over ICI/DCN, not parameter RPCs).
+
+The reference CLI shape is preserved: a comma list of ``host:port`` worker
+addresses plus a task index maps 1:1 onto (coordinator_address,
+num_processes, process_id) — see ``cli/main.py``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+
+from dml_cnn_cifar10_tpu.config import ParallelConfig
+
+
+def initialize_from_hosts(worker_hosts: List[str], task_index: int) -> None:
+    """README-recipe compat: ``--worker_hosts=a:2222,b:2222 --task_index=i``.
+
+    The first worker is the coordinator, exactly as task 0 is the TF chief
+    (``cifar10cnn.py:222`` ``is_chief=(task_index==0)``).
+    """
+    initialize(ParallelConfig(
+        coordinator_address=worker_hosts[0],
+        num_processes=len(worker_hosts),
+        process_id=task_index,
+    ))
+
+
+def initialize(cfg: ParallelConfig) -> None:
+    """Idempotent ``jax.distributed.initialize`` from config."""
+    if cfg.num_processes <= 1:
+        return
+    if jax.process_count() > 1:  # already initialized
+        return
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+
+
+def is_chief() -> bool:
+    """Process 0 plays the chief role (init/checkpointing decisions)."""
+    return jax.process_index() == 0

@@ -1,0 +1,200 @@
+"""The compiled SPMD training step.
+
+One ``jit``-compiled function replaces the reference's per-step machinery —
+graph pruning/partitioning, PS→worker param Recv, worker compute,
+worker→PS grad Send, PS apply (``cifar10cnn.py:228-230`` and SURVEY §3.3).
+Parameters are replicated over the mesh, the batch is sharded on ``data``,
+and XLA compiles the gradient all-reduce (psum over ICI) directly into the
+step. Two modes:
+
+- default: ``jit`` with sharding annotations; the partitioner inserts the
+  collectives (idiomatic, composes with tensor/sequence axes).
+- ``explicit_collectives``: the same math under ``shard_map`` with a literal
+  ``lax.psum``/``lax.pmean`` — the hand-written SPMD form, used by tests to
+  pin down the semantics and as the template for custom-collective work.
+
+Both modes are bit-comparable (tests assert it) and both donate the input
+state so parameter memory is updated in place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig, OptimConfig
+from dml_cnn_cifar10_tpu.models.registry import ModelDef
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.train import loss as loss_lib
+from dml_cnn_cifar10_tpu.train import metrics as metrics_lib
+from dml_cnn_cifar10_tpu.train import optim as optim_lib
+
+
+class TrainState(NamedTuple):
+    """Replicated training state: params + optimizer + model state (BN).
+
+    NamedTuple => already a pytree; flows through jit/shard_map/device_put.
+    """
+
+    params: Any
+    opt: Any
+    model_state: Any
+
+    @property
+    def step(self) -> jax.Array:
+        return self.opt["step"]
+
+
+def init_train_state(
+    key: jax.Array,
+    model_def: ModelDef,
+    model_cfg: ModelConfig,
+    data_cfg: DataConfig,
+    optim_cfg: OptimConfig,
+    mesh: Optional[Mesh] = None,
+) -> TrainState:
+    """Initialize params/opt/model-state; replicate over the mesh.
+
+    Replaces chief-initializes-variables-on-PS + workers-wait
+    (``cifar10cnn.py:222`` via MonitoredTrainingSession): under SPMD every
+    process runs the same deterministic init from the same seed, and the
+    replicated sharding guarantees identical values on every chip.
+    """
+    params = model_def.init(key, model_cfg, data_cfg)
+    state = TrainState(
+        params=params,
+        opt=optim_lib.sgd_init(params, optim_cfg),
+        model_state=model_def.init_state(params),
+    )
+    if mesh is not None:
+        state = jax.device_put(state, mesh_lib.replicated(mesh))
+    return state
+
+
+def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,
+                  axis_name: Optional[str] = None):
+    """loss_fn(params, model_state, images, labels) →
+    (loss, (logits, new_model_state))."""
+
+    def loss_fn(params, model_state, images, labels):
+        if model_def.has_state:
+            kwargs = {"axis_name": axis_name} if axis_name else {}
+            logits, new_state = model_def.apply(
+                params, model_state, images, model_cfg, train=True, **kwargs)
+        else:
+            logits = model_def.apply(params, images, model_cfg, train=True)
+            new_state = model_state
+        return loss_lib.softmax_cross_entropy(logits, labels), (logits,
+                                                                new_state)
+
+    return loss_fn
+
+
+def make_train_step(
+    model_def: ModelDef,
+    model_cfg: ModelConfig,
+    optim_cfg: OptimConfig,
+    mesh: Optional[Mesh] = None,
+    explicit_collectives: bool = False,
+) -> Callable[[TrainState, jax.Array, jax.Array],
+              Tuple[TrainState, dict]]:
+    """Build the jitted train step:
+    ``(state, images, labels) -> (new_state, {"loss", "accuracy"})``."""
+
+    if explicit_collectives and mesh is not None:
+        return _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh)
+
+    loss_fn = _forward_loss(model_def, model_cfg)
+
+    def step(state: TrainState, images, labels):
+        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, state.model_state, images,
+                                   labels)
+        new_params, new_opt = optim_lib.sgd_update(grads, state.opt,
+                                                   state.params, optim_cfg)
+        metrics = {"loss": loss,
+                   "accuracy": metrics_lib.batch_accuracy(logits, labels)}
+        return TrainState(new_params, new_opt, new_model_state), metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0)
+    repl = mesh_lib.replicated(mesh)
+    data = mesh_lib.batch_sharding(mesh, 4)
+    lab = mesh_lib.batch_sharding(mesh, 1)
+    return jax.jit(
+        step,
+        in_shardings=(repl, data, lab),
+        out_shardings=(repl, repl),
+        donate_argnums=0,
+    )
+
+
+def _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh: Mesh):
+    """shard_map form: per-device forward/backward on the local batch shard,
+    explicit ``lax.psum`` of gradients — the literal translation of
+    "workers compute grads, aggregation applies them" minus the
+    asynchrony (SURVEY §2.3, §3.3)."""
+    loss_fn = _forward_loss(model_def, model_cfg, axis_name="data")
+    ndev = mesh.shape["data"]
+
+    def local_step(state: TrainState, images, labels):
+        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, state.model_state, images,
+                                   labels)
+        # Gradient all-reduce over ICI — the replacement for worker→PS
+        # gradient RPCs (cifar10cnn.py:230, SURVEY §3.3). Mean, because each
+        # device's loss is already a mean over its local shard.
+        grads = lax.pmean(grads, "data")
+        loss = lax.pmean(loss, "data")
+        acc = lax.pmean(metrics_lib.batch_accuracy(logits, labels), "data")
+        new_params, new_opt = optim_lib.sgd_update(grads, state.opt,
+                                                   state.params, optim_cfg)
+        if model_def.has_state:
+            new_model_state = lax.pmean(new_model_state, "data")
+        return (TrainState(new_params, new_opt, new_model_state),
+                {"loss": loss, "accuracy": acc})
+
+    shmapped = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P("data"), P("data")),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=0)
+
+
+def make_eval_step(
+    model_def: ModelDef,
+    model_cfg: ModelConfig,
+    mesh: Optional[Mesh] = None,
+) -> Callable[[TrainState, jax.Array, jax.Array], dict]:
+    """Jitted eval: ``(state, images, labels) -> {"accuracy", "correct"}`` —
+    single-batch accuracy for faithful parity eval (``cifar10cnn.py:
+    237-241``); ``correct`` is the global summable count for full-test-set
+    eval (pad rows labeled -1 contribute 0)."""
+
+    def step(state: TrainState, images, labels):
+        if model_def.has_state:
+            logits, _ = model_def.apply(state.params, state.model_state,
+                                        images, model_cfg, train=False)
+        else:
+            logits = model_def.apply(state.params, images, model_cfg,
+                                     train=False)
+        return {
+            "accuracy": metrics_lib.batch_accuracy(logits, labels),
+            "correct": metrics_lib.correct_count(logits, labels),
+        }
+
+    if mesh is None:
+        return jax.jit(step)
+    repl = mesh_lib.replicated(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(repl, mesh_lib.batch_sharding(mesh, 4),
+                      mesh_lib.batch_sharding(mesh, 1)),
+        out_shardings=repl,
+    )

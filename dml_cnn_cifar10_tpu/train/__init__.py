@@ -1,0 +1,11 @@
+"""Training math + driver: loss, optimizer/schedule, metrics, loop.
+
+Reference: ``cifar_loss`` (``cifar10cnn.py:150-157``), ``train_step``
+(``:159-164``), ``batch_accuracy`` (``:166-176``), and the monitored-session
+step loop (``:219-242``).
+"""
+
+from dml_cnn_cifar10_tpu.train.loss import softmax_cross_entropy  # noqa: F401
+from dml_cnn_cifar10_tpu.train.metrics import batch_accuracy  # noqa: F401
+from dml_cnn_cifar10_tpu.train.optim import sgd_init, sgd_update, learning_rate  # noqa: F401
+from dml_cnn_cifar10_tpu.train.loop import Trainer  # noqa: F401

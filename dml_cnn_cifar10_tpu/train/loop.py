@@ -1,0 +1,158 @@
+"""The training driver.
+
+Replaces the reference's worker branch (``cifar10cnn.py:193-242``): graph
+construction becomes building the jitted SPMD step; MonitoredTrainingSession
+becomes explicit restore-if-present + periodic checkpointing +
+stop-at-step; the queue runners become the prefetching pipeline. Console
+cadence is parity: the training line every ``output_every`` (200) local
+steps, an eval line every ``eval_every`` (500) (``cifar10cnn.py:232-241``).
+
+Faithful-mode details mirrored deliberately:
+- Train accuracy at the 200-step mark is computed on a *fresh* train batch
+  (the reference reruns ``accuracy_train``, pulling a new batch from the
+  queue — ``cifar10cnn.py:235``), not the batch just trained on.
+- Eval is one *shuffled* test batch (``cifar10cnn.py:202,238``);
+  ``eval_full_test_set=True`` sweeps the whole split instead.
+- The stop condition is the *global* step, like ``StopAtStepHook``
+  (``cifar10cnn.py:219``), so restore + finish works.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+
+from dml_cnn_cifar10_tpu import ckpt as ckpt_lib
+from dml_cnn_cifar10_tpu.config import TrainConfig
+from dml_cnn_cifar10_tpu.data import pipeline as pipe
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+from dml_cnn_cifar10_tpu.utils.profiling import StepTimer, profile_trace
+
+
+@dataclasses.dataclass
+class TrainResult:
+    final_step: int
+    train_loss: list
+    test_accuracy: list
+    images_per_sec: float
+    state: step_lib.TrainState
+
+
+class Trainer:
+    def __init__(self, cfg: TrainConfig, mesh=None, task_index: int = 0):
+        self.cfg = cfg
+        self.task_index = task_index
+        self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(
+            cfg.parallel)
+        self.model_def = get_model(cfg.model.name)
+        self.train_step = step_lib.make_train_step(
+            self.model_def, cfg.model, cfg.optim, self.mesh,
+            explicit_collectives=cfg.parallel.explicit_collectives)
+        self.eval_step = step_lib.make_eval_step(self.model_def, cfg.model,
+                                                 self.mesh)
+        self.logger = MetricsLogger(cfg.metrics_jsonl, task_index=task_index)
+
+    def init_or_restore(self) -> step_lib.TrainState:
+        key = jax.random.key(self.cfg.seed)
+        state = step_lib.init_train_state(
+            key, self.model_def, self.cfg.model, self.cfg.data,
+            self.cfg.optim, self.mesh)
+        return ckpt_lib.restore_checkpoint(
+            self.cfg.log_dir, state, sharding=mesh_lib.replicated(self.mesh))
+
+    def _placed(self, batch: pipe.Batch):
+        return mesh_lib.shard_batch(self.mesh, batch.images, batch.labels)
+
+    def evaluate(self, state, test_it: pipe.ShuffleBatchIterator) -> float:
+        """Faithful: accuracy on ONE shuffled test batch
+        (``cifar10cnn.py:202,238``); fixed: full-split sweep.
+
+        The sweep uses fixed-shape padded batches (pad label -1 ⇒ 0 correct)
+        so every process issues the same number of collective eval steps,
+        and the global correct count divides the pre-shard record total —
+        correct under any process/shard layout."""
+        if not self.cfg.eval_full_test_set:
+            m = self.eval_step(state, *self._placed(next(test_it)))
+            return float(m["accuracy"])
+        correct = 0
+        for batch in test_it.full_sweep_padded():
+            m = self.eval_step(state, *self._placed(batch))
+            correct += int(m["correct"])
+        return correct / max(test_it.total_records, 1)
+
+    def fit(self, total_steps: Optional[int] = None,
+            state: Optional[step_lib.TrainState] = None) -> TrainResult:
+        cfg = self.cfg
+        total_steps = total_steps or cfg.total_steps
+        state = state if state is not None else self.init_or_restore()
+        start_step = int(jax.device_get(state.step))
+
+        num_shards = jax.process_count()
+        shard = jax.process_index()
+        per_process_batch = cfg.batch_size // num_shards
+        train_it = pipe.input_pipeline(
+            cfg.data, per_process_batch, train=True,
+            seed=cfg.seed + shard, shard=shard, num_shards=num_shards)
+        test_it = pipe.input_pipeline(
+            cfg.data, per_process_batch, train=False, seed=cfg.seed + shard,
+            shard=shard, num_shards=num_shards)
+        # Fresh-batch train accuracy (cifar10cnn.py:235) — an independent
+        # stream over the same decoded arrays (no second decode).
+        acc_it = train_it.clone(seed=cfg.seed + 7 + shard)
+        prefetch = pipe.PrefetchIterator(
+            train_it, depth=cfg.data.prefetch, place=self._placed)
+
+        ckpt_mgr = ckpt_lib.CheckpointManager(
+            cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints)
+        timer = StepTimer(cfg.batch_size)
+        train_loss, test_accuracy = [], []
+
+        print("Starting Training")  # parity: cifar10cnn.py:225
+        i = 0  # local step, like the reference's `i` (cifar10cnn.py:224)
+        global_step = start_step
+        with profile_trace(cfg.profile_dir):
+            while global_step < total_steps:
+                images, labels = next(prefetch)
+                state, metrics = self.train_step(state, images, labels)
+                global_step += 1
+                timer.tick()
+
+                if (i + 1) % cfg.output_every == 0:
+                    loss = float(jax.device_get(metrics["loss"]))
+                    train_loss.append(loss)
+                    acc = float(self.eval_step(
+                        state, *self._placed(next(acc_it)))["accuracy"])
+                    self.logger.train_print(global_step, i, acc)
+                    self.logger.log("train", step=global_step, loss=loss,
+                                    train_accuracy=acc,
+                                    images_per_sec=timer.images_per_sec,
+                                    lr=_current_lr(cfg, global_step))
+                if (i + 1) % cfg.eval_every == 0:
+                    ta = self.evaluate(state, test_it)
+                    test_accuracy.append(ta)
+                    self.logger.eval_print(ta)
+                    self.logger.log("eval", step=global_step,
+                                    test_accuracy=ta)
+                ckpt_mgr.maybe_save(state, global_step)
+                i += 1
+
+        ckpt_mgr.maybe_save(state, global_step, force=True)
+        prefetch.close()
+        self.logger.log("done", step=global_step,
+                        images_per_sec=timer.images_per_sec)
+        return TrainResult(global_step, train_loss, test_accuracy,
+                           timer.images_per_sec, state)
+
+
+def _current_lr(cfg: TrainConfig, step: int) -> float:
+    from dml_cnn_cifar10_tpu.train import optim as optim_lib
+    import jax.numpy as jnp
+    return float(optim_lib.learning_rate(cfg.optim, jnp.asarray(step)))
+
+

@@ -1,0 +1,20 @@
+"""Loss functions.
+
+``softmax_cross_entropy`` is the parity loss: sparse softmax cross-entropy
+averaged over the batch (``cifar_loss``, ``cifar10cnn.py:150-157`` —
+squeeze/cast of targets happens in the data layer, which already yields int32
+labels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean sparse softmax CE. logits [B, K] float, labels [B] int."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+    return jnp.mean(nll)

@@ -1,0 +1,22 @@
+"""Metrics.
+
+``batch_accuracy`` is parity with ``cifar10cnn.py:166-176``: argmax over
+logits vs int labels, mean over the batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def batch_accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.mean((preds == labels.astype(jnp.int32)).astype(jnp.float32))
+
+
+def correct_count(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Unnormalized correct count — summable across batches for full-test-set
+    eval (fixed mode; the reference only ever does single-batch eval)."""
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.sum((preds == labels.astype(jnp.int32)).astype(jnp.int32))

@@ -1,0 +1,4 @@
+"""Cross-cutting utilities: structured logging, profiling, timing."""
+
+from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger  # noqa: F401
+from dml_cnn_cifar10_tpu.utils.profiling import StepTimer, profile_trace  # noqa: F401

@@ -1,0 +1,56 @@
+"""Structured metrics logging.
+
+The reference's observability is ``print`` at a 200/500-step cadence plus two
+Python lists that are appended and then dropped on the floor
+(``cifar10cnn.py:226-241``). This logger keeps the exact console format for
+parity and *also* persists every record as JSONL with wall-clock and
+throughput, so runs are analyzable after the fact.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Optional
+
+
+def _finite(v):
+    """NaN/Inf → None so every line stays strict JSON (faithful runs with
+    the reference's LR-0.1-on-raw-pixels hyperparameters do NaN)."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    return v
+
+
+class MetricsLogger:
+    def __init__(self, jsonl_path: Optional[str] = None, task_index: int = 0):
+        self.task_index = task_index
+        self._file = None
+        if jsonl_path:
+            os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
+            self._file = open(jsonl_path, "a", buffering=1)
+        self._t0 = time.time()
+
+    def log(self, kind: str, **fields) -> None:
+        if self._file is not None:
+            rec = {"kind": kind, "t": round(time.time() - self._t0, 4),
+                   "task": self.task_index,
+                   **{k: _finite(v) for k, v in fields.items()}}
+            self._file.write(json.dumps(rec, allow_nan=False) + "\n")
+
+    def train_print(self, global_step: int, local_step: int,
+                    train_accuracy: float) -> None:
+        # Byte-for-byte the reference's training line (cifar10cnn.py:234-235).
+        print("global_step %s, task:%d_step %d, training accuracy %g"
+              % (global_step, self.task_index, local_step, train_accuracy))
+
+    def eval_print(self, test_accuracy: float) -> None:
+        # Reference's eval line (cifar10cnn.py:240-241).
+        print(" --- Test Accuracy = {:.2f}%.".format(100.0 * test_accuracy))
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None

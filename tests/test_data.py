@@ -1,0 +1,175 @@
+"""Data layer unit tests (SURVEY §4: record parsing vs hand-built records)."""
+
+import numpy as np
+import pytest
+
+from dml_cnn_cifar10_tpu.config import DataConfig
+from dml_cnn_cifar10_tpu.data import pipeline as pipe
+from dml_cnn_cifar10_tpu.data import records as rec
+from dml_cnn_cifar10_tpu.data.download import generate_synthetic_dataset, train_files
+
+
+def _handmade_record(label: int, seed: int, cfg: DataConfig) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    img = rng.integers(0, 256, size=3072, dtype=np.uint8)
+    return np.concatenate([[np.uint8(label)], img]).astype(np.uint8)
+
+
+def test_decode_matches_handbuilt_record():
+    """Byte 0 is the label; bytes 1..3072 are CHW, transposed to HWC
+    (reference read_cifar_files, cifar10cnn.py:54-66)."""
+    cfg = DataConfig()
+    recs = np.stack([_handmade_record(7, 1, cfg), _handmade_record(2, 2, cfg)])
+    images, labels = rec.decode_records(recs, cfg)
+    assert labels.tolist() == [7, 2]
+    assert images.shape == (2, 32, 32, 3) and images.dtype == np.float32
+    chw = recs[0, 1:].reshape(3, 32, 32)
+    np.testing.assert_array_equal(images[0], chw.transpose(1, 2, 0))
+
+
+def test_center_crop_is_deterministic_center():
+    """Parity with resize_image_with_crop_or_pad (cifar10cnn.py:68):
+    TF floors the offset: top = (32-24)//2 = 4."""
+    x = np.arange(32 * 32, dtype=np.float32).reshape(1, 32, 32, 1)
+    x = np.repeat(x, 3, axis=3)
+    out = rec.center_crop(x, 24, 24)
+    np.testing.assert_array_equal(out[0, :, :, 0], x[0, 4:28, 4:28, 0])
+
+
+def test_center_crop_pads_when_smaller():
+    x = np.ones((1, 16, 16, 3), dtype=np.float32)
+    out = rec.center_crop(x, 24, 24)
+    assert out.shape == (1, 24, 24, 3)
+    assert out[0, 0, 0, 0] == 0.0 and out[0, 12, 12, 0] == 1.0
+
+
+def test_random_crop_windows_are_valid(rng):
+    x = rng.random((8, 32, 32, 3)).astype(np.float32)
+    out = rec.random_crop(x, 24, 24, rng)
+    assert out.shape == (8, 24, 24, 3)
+    # every crop must be an exact subwindow of its source image
+    windows = np.lib.stride_tricks.sliding_window_view(x, (24, 24), axis=(1, 2))
+    for i in range(8):
+        matches = np.isclose(
+            windows[i].transpose(0, 1, 3, 4, 2), out[i], atol=0
+        ).all(axis=(2, 3, 4))
+        assert matches.any()
+
+
+def test_synthetic_files_have_cifar_layout(data_cfg):
+    path = train_files(data_cfg)[0]
+    records = rec.read_record_file(path, data_cfg.record_bytes)
+    assert records.shape[1] == 3073
+    images, labels = rec.decode_records(records, data_cfg)
+    assert labels.min() >= 0 and labels.max() < 10
+    assert 0 <= images.min() and images.max() <= 255
+
+
+def test_shuffle_iterator_covers_epoch_and_repeats(data_cfg):
+    it = pipe.ShuffleBatchIterator(
+        train_files(data_cfg), data_cfg, batch_size=64, train=True, seed=3)
+    n = it.n
+    seen = 0
+    labels_seen = []
+    for _ in range(2 * n // 64):
+        b = next(it)
+        assert b.images.shape == (64, 24, 24, 3)
+        assert b.labels.shape == (64,) and b.labels.dtype == np.int32
+        labels_seen.append(b.labels)
+        seen += 64
+    assert seen == 2 * n  # endless stream, no StopIteration
+
+
+def test_shuffle_iterator_is_seeded_deterministic(data_cfg):
+    a = pipe.ShuffleBatchIterator(train_files(data_cfg), data_cfg, 32, seed=5)
+    b = pipe.ShuffleBatchIterator(train_files(data_cfg), data_cfg, 32, seed=5)
+    for _ in range(3):
+        ba, bb = next(a), next(b)
+        np.testing.assert_array_equal(ba.images, bb.images)
+        np.testing.assert_array_equal(ba.labels, bb.labels)
+
+
+def test_sharded_iterators_are_disjoint(data_cfg):
+    its = [
+        pipe.ShuffleBatchIterator(train_files(data_cfg), data_cfg, 16,
+                                  seed=1, shard=s, num_shards=2)
+        for s in range(2)
+    ]
+    assert its[0].n + its[1].n == pipe.ShuffleBatchIterator(
+        train_files(data_cfg), data_cfg, 16, seed=1).n
+
+
+def test_full_sweep_visits_every_record_once(data_cfg):
+    it = pipe.ShuffleBatchIterator(
+        pipe.download.test_files(data_cfg), data_cfg, 48, train=False, seed=0)
+    total = sum(b.images.shape[0] for b in it.full_sweep())
+    assert total == it.n
+
+
+def test_full_sweep_padded_fixed_shapes_and_sentinel_labels(data_cfg):
+    it = pipe.ShuffleBatchIterator(
+        pipe.download.test_files(data_cfg), data_cfg, 48, train=False, seed=0)
+    batches = list(it.full_sweep_padded())
+    assert len(batches) == it.num_padded_sweep_batches()
+    assert all(b.images.shape == (48, 24, 24, 3) for b in batches)
+    real = sum(int((b.labels >= 0).sum()) for b in batches)
+    assert real == it.total_records
+    # pad rows are exactly the (-1)-labeled rows in the last batch
+    assert (batches[-1].labels >= 0).sum() == it.n - (len(batches) - 1) * 48
+
+
+def test_padded_sweep_equal_batch_count_across_shards(data_cfg):
+    """All shards must issue the same number of collective eval steps even
+    when strided shard sizes differ (lockstep requirement for multi-host)."""
+    its = [pipe.ShuffleBatchIterator(
+        pipe.download.test_files(data_cfg), data_cfg, 24, train=False,
+        seed=0, shard=s, num_shards=3) for s in range(3)]
+    counts = {it.num_padded_sweep_batches() for it in its}
+    assert len(counts) == 1
+    total_real = sum(
+        int((b.labels >= 0).sum()) for it in its for b in it.full_sweep_padded())
+    assert total_real == its[0].total_records
+
+
+def test_clone_shares_arrays_but_streams_independently(data_cfg):
+    it = pipe.ShuffleBatchIterator(train_files(data_cfg), data_cfg, 16, seed=1)
+    c = it.clone(seed=2)
+    assert c.images is it.images        # no second decode / copy
+    a, b = next(it), next(c)
+    assert not np.array_equal(a.labels, b.labels)  # independent shuffles
+    assert c.total_records == it.total_records
+
+
+def test_prefetch_close_with_depth_one_does_not_hang(data_cfg):
+    """Regression: close() while the producer is parked on a full depth-1
+    queue must terminate the thread, not leak it blocked mid-put."""
+    src = pipe.ShuffleBatchIterator(train_files(data_cfg), data_cfg, 16, seed=0)
+    pf = pipe.PrefetchIterator(src, depth=1)
+    next(pf)          # ensure producer is active and queue refills
+    pf.close()
+    pf._thread.join(timeout=5)
+    assert not pf._thread.is_alive()
+
+
+def test_prefetch_iterator_preserves_order_and_propagates(data_cfg):
+    src = pipe.ShuffleBatchIterator(train_files(data_cfg), data_cfg, 16, seed=9)
+    ref = pipe.ShuffleBatchIterator(train_files(data_cfg), data_cfg, 16, seed=9)
+    direct = [next(ref) for _ in range(4)]
+    pf = pipe.PrefetchIterator(src, depth=2)
+    for want in direct:
+        got = next(pf)
+        np.testing.assert_array_equal(got.images, want.images)
+    pf.close()
+
+
+def test_cifar100_record_layout(tmp_path):
+    cfg = DataConfig(dataset="cifar100", data_dir=str(tmp_path),
+                     num_classes=100, synthetic_train_records=64,
+                     synthetic_test_records=16, use_native_loader=False)
+    generate_synthetic_dataset(cfg)
+    from dml_cnn_cifar10_tpu.data.download import train_files as tf100
+    records = rec.read_record_file(tf100(cfg)[0], cfg.record_bytes + 1)
+    assert records.shape[1] == 3074  # coarse + fine label bytes
+    images, labels = rec.decode_records(records, cfg, label_offset=1)
+    assert images.shape[1:] == (32, 32, 3)
+    assert labels.max() < 100

@@ -1,0 +1,106 @@
+"""Loss / metrics / optimizer vs NumPy references (SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dml_cnn_cifar10_tpu.config import OptimConfig
+from dml_cnn_cifar10_tpu.train import loss as loss_lib
+from dml_cnn_cifar10_tpu.train import metrics as metrics_lib
+from dml_cnn_cifar10_tpu.train import optim as optim_lib
+
+
+def _np_softmax_ce(logits, labels):
+    z = logits - logits.max(axis=1, keepdims=True)
+    logp = z - np.log(np.exp(z).sum(axis=1, keepdims=True))
+    return -logp[np.arange(len(labels)), labels].mean()
+
+
+def test_loss_matches_numpy(rng):
+    logits = rng.normal(size=(16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    got = float(loss_lib.softmax_cross_entropy(jnp.asarray(logits),
+                                               jnp.asarray(labels)))
+    np.testing.assert_allclose(got, _np_softmax_ce(logits, labels), rtol=1e-5)
+
+
+def test_accuracy_matches_numpy(rng):
+    logits = rng.normal(size=(32, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, 32).astype(np.int32)
+    got = float(metrics_lib.batch_accuracy(jnp.asarray(logits),
+                                           jnp.asarray(labels)))
+    want = (logits.argmax(1) == labels).mean()
+    np.testing.assert_allclose(got, want)
+
+
+def test_faithful_lr_is_constant():
+    """Reference quirk: decay keyed on a never-incremented variable →
+    constant LR 0.1 (cifar10cnn.py:161,216)."""
+    cfg = OptimConfig(dead_lr_decay=True)
+    for step in [0, 100, 250, 5000, 19999]:
+        np.testing.assert_allclose(
+            float(optim_lib.learning_rate(cfg, jnp.asarray(step))), 0.1,
+            rtol=1e-6)
+
+
+def test_fixed_lr_staircase_decay():
+    """tf.train.exponential_decay(0.1, step, 250, 0.9, staircase=True)."""
+    cfg = OptimConfig(dead_lr_decay=False)
+    lr = lambda s: float(optim_lib.learning_rate(cfg, jnp.asarray(s)))
+    np.testing.assert_allclose(lr(0), 0.1)
+    np.testing.assert_allclose(lr(249), 0.1)
+    np.testing.assert_allclose(lr(250), 0.1 * 0.9, rtol=1e-6)
+    np.testing.assert_allclose(lr(999), 0.1 * 0.9**3, rtol=1e-5)
+
+
+def test_sgd_update_matches_formula(rng):
+    params = {"w": jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32))}
+    grads = {"w": jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32))}
+    cfg = OptimConfig()
+    st = optim_lib.sgd_init(params, cfg)
+    new_params, new_st = optim_lib.sgd_update(grads, st, params, cfg)
+    np.testing.assert_allclose(
+        np.asarray(new_params["w"]),
+        np.asarray(params["w"]) - 0.1 * np.asarray(grads["w"]), rtol=1e-6)
+    assert int(new_st["step"]) == 1
+
+
+def test_sgd_momentum_and_weight_decay(rng):
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    grads = {"w": jnp.full((3,), 2.0)}
+    cfg = OptimConfig(momentum=0.9, weight_decay=0.01, dead_lr_decay=True)
+    st = optim_lib.sgd_init(params, cfg)
+    p1, st = optim_lib.sgd_update(grads, st, params, cfg)
+    # g' = g + wd*p = 2.01; m = g'; p1 = 1 - 0.1*2.01
+    np.testing.assert_allclose(np.asarray(p1["w"]), 1 - 0.1 * 2.01, rtol=1e-6)
+    p2, st = optim_lib.sgd_update(grads, st, p1, cfg)
+    g2 = 2.0 + 0.01 * np.asarray(p1["w"])
+    m2 = 0.9 * 2.01 + g2
+    np.testing.assert_allclose(np.asarray(p2["w"]),
+                               np.asarray(p1["w"]) - 0.1 * m2, rtol=1e-6)
+
+
+def test_grad_clipping():
+    params = {"w": jnp.zeros((2,), jnp.float32)}
+    grads = {"w": jnp.asarray([3.0, 4.0])}  # norm 5
+    cfg = OptimConfig(grad_clip_norm=1.0)
+    st = optim_lib.sgd_init(params, cfg)
+    p1, _ = optim_lib.sgd_update(grads, st, params, cfg)
+    np.testing.assert_allclose(np.asarray(p1["w"]),
+                               -0.1 * np.asarray([0.6, 0.8]), rtol=1e-5)
+
+
+def test_optax_equivalence(rng):
+    """as_optax() applies the same update as the hand-rolled SGD."""
+    import optax
+    params = {"w": jnp.asarray(rng.normal(size=(5,)).astype(np.float32))}
+    grads = {"w": jnp.asarray(rng.normal(size=(5,)).astype(np.float32))}
+    cfg = OptimConfig(dead_lr_decay=False)
+    tx = optim_lib.as_optax(cfg)
+    ost = tx.init(params)
+    updates, _ = tx.update(grads, ost, params)
+    via_optax = optax.apply_updates(params, updates)
+    ours, _ = optim_lib.sgd_update(grads, optim_lib.sgd_init(params, cfg),
+                                   params, cfg)
+    np.testing.assert_allclose(np.asarray(via_optax["w"]),
+                               np.asarray(ours["w"]), rtol=1e-6)
