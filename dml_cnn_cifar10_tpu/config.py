@@ -81,6 +81,9 @@ class ModelConfig:
     bias_init: float = 0.1
     dtype: str = "float32"                # param dtype
     compute_dtype: str = "float32"        # activations; bfloat16 on TPU runs
+    # BatchNorm knobs (ResNet configs; SURVEY §2.3 cross-replica stats).
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
     # ViT-specific knobs (ignored by CNN/ResNet).
     patch_size: int = 4
     vit_dim: int = 192

@@ -10,4 +10,11 @@ models. All models share one functional interface:
   (stateful models, e.g. BatchNorm running stats — see registry.has_state)
 """
 
+import jax
+
 from dml_cnn_cifar10_tpu.models.registry import get_model, MODELS  # noqa: F401
+
+
+def param_count(params) -> int:
+    """Total parameter count of a params pytree (shared by all models)."""
+    return sum(int(a.size) for a in jax.tree.leaves(params))

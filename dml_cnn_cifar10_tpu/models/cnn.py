@@ -80,5 +80,5 @@ def apply(params: Params, images: jax.Array, cfg: ModelConfig,
     return logits.astype(jnp.float32)
 
 
-def param_count(params: Params) -> int:
-    return sum(int(a.size) for a in jax.tree.leaves(params))
+# Shared implementation: models.param_count
+from dml_cnn_cifar10_tpu.models import param_count  # noqa: E402,F401

@@ -1,0 +1,221 @@
+"""ResNet-18/50 — the "deeper conv stack" rungs of the config ladder.
+
+No reference counterpart (the reference model is the 5-layer CNN,
+``cifar10cnn.py:94-147``); these are the BASELINE.json ladder configs
+"ResNet-18 on CIFAR-10 (deeper conv stack, BatchNorm psum)" and
+"ResNet-50 on ImageNet-1k". Design notes:
+
+- Functional pytrees like :mod:`~dml_cnn_cifar10_tpu.models.cnn`; BatchNorm
+  running stats live in a parallel ``state`` pytree (the framework's
+  ``model_state``) so the train step stays pure.
+- Cross-replica BN (SURVEY §2.3): batch stats are global means — automatic
+  under jit auto-partitioning, explicit ``lax.pmean`` via ``axis_name``
+  under the shard_map step. See :func:`ops.layers.batch_norm`.
+- Stem adapts to input size: CIFAR-scale inputs (≤64 px) use the 3×3/s1
+  stem with no maxpool; larger (ImageNet) inputs use 7×7/s2 + 3×3/s2
+  maxpool.
+- All convs are bias-free (BN's offset absorbs the bias); final BN of each
+  residual branch is gamma-zero-initialized so blocks start as identity —
+  standard large-batch trick, keeps the big-LR parity regime stable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig
+from dml_cnn_cifar10_tpu.ops import layers as L
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+# depth → (blocks per stage, block kind)
+STAGES = {
+    18: ((2, 2, 2, 2), "basic"),
+    34: ((3, 4, 6, 3), "basic"),
+    50: ((3, 4, 6, 3), "bottleneck"),
+}
+STAGE_WIDTHS = (64, 128, 256, 512)
+BOTTLENECK_EXPANSION = 4
+
+
+def _conv_init(key, shape, dtype):
+    return L.he_normal_init(key, shape, dtype)
+
+
+def _init_basic_block(key, cin: int, width: int, stride: int, dtype):
+    ks = jax.random.split(key, 3)
+    p: Params = {}
+    p["conv1"] = _conv_init(ks[0], (3, 3, cin, width), dtype)
+    p["bn1"] = L.bn_init(width, dtype)
+    p["conv2"] = _conv_init(ks[1], (3, 3, width, width), dtype)
+    p["bn2"] = L.bn_init(width, dtype)
+    p["bn2"]["scale"] = jnp.zeros_like(p["bn2"]["scale"])  # identity start
+    if stride != 1 or cin != width:
+        p["proj"] = _conv_init(ks[2], (1, 1, cin, width), dtype)
+        p["proj_bn"] = L.bn_init(width, dtype)
+    return p, width
+
+
+def _init_bottleneck_block(key, cin: int, width: int, stride: int, dtype):
+    cout = width * BOTTLENECK_EXPANSION
+    ks = jax.random.split(key, 4)
+    p: Params = {}
+    p["conv1"] = _conv_init(ks[0], (1, 1, cin, width), dtype)
+    p["bn1"] = L.bn_init(width, dtype)
+    p["conv2"] = _conv_init(ks[1], (3, 3, width, width), dtype)
+    p["bn2"] = L.bn_init(width, dtype)
+    p["conv3"] = _conv_init(ks[2], (1, 1, width, cout), dtype)
+    p["bn3"] = L.bn_init(cout, dtype)
+    p["bn3"]["scale"] = jnp.zeros_like(p["bn3"]["scale"])  # identity start
+    if stride != 1 or cin != cout:
+        p["proj"] = _conv_init(ks[3], (1, 1, cin, cout), dtype)
+        p["proj_bn"] = L.bn_init(cout, dtype)
+    return p, cout
+
+
+def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig,
+                depth: int = 18) -> Params:
+    if depth not in STAGES:
+        raise ValueError(f"unsupported resnet depth {depth}; have "
+                         f"{sorted(STAGES)}")
+    blocks, kind = STAGES[depth]
+    dtype = jnp.dtype(cfg.dtype)
+    imagenet_stem = min(data.crop_height, data.crop_width) > 64
+    init_block = (_init_bottleneck_block if kind == "bottleneck"
+                  else _init_basic_block)
+
+    keys = jax.random.split(key, 2 + sum(blocks))
+    ki = iter(range(len(keys)))
+
+    p: Params = {}
+    stem_k = (7, 7) if imagenet_stem else (3, 3)
+    p["stem"] = {"conv": _conv_init(keys[next(ki)],
+                                    (*stem_k, data.num_channels, 64), dtype)}
+    p["stem"]["bn"] = L.bn_init(64, dtype)
+
+    cin = 64
+    for si, (n, width) in enumerate(zip(blocks, STAGE_WIDTHS)):
+        stage: List[Params] = []
+        for bi in range(n):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            bp, cin = init_block(keys[next(ki)], cin, width, stride, dtype)
+            stage.append(bp)
+        p[f"stage{si + 1}"] = stage
+
+    p["fc"] = {
+        "kernel": L.he_normal_init(keys[next(ki)], (cin, cfg.num_classes),
+                                   dtype),
+        "bias": jnp.zeros((cfg.num_classes,), dtype),
+    }
+    return p
+
+
+def init_state(params: Params) -> State:
+    """Derive the running-stat pytree from the param pytree: every dict with
+    ``scale``/``offset`` keys is a BN layer and gets ``mean``/``var``."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            if set(node) == {"scale", "offset"}:
+                return {"mean": jnp.zeros(node["scale"].shape, jnp.float32),
+                        "var": jnp.ones(node["scale"].shape, jnp.float32)}
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        return None  # non-BN leaf: no state
+
+    return walk(params)
+
+
+def _bn(x, p, s, cfg: ModelConfig, train: bool, axis_name):
+    return L.batch_norm(x, p, s, train, cfg.bn_momentum, cfg.bn_eps,
+                        axis_name)
+
+
+def _basic_block(x, p, s, stride, cfg, train, axis_name):
+    ns: State = {}
+    h = L.conv2d(x, p["conv1"], stride=stride)
+    h, ns["bn1"] = _bn(h, p["bn1"], s["bn1"], cfg, train, axis_name)
+    h = jax.nn.relu(h)
+    h = L.conv2d(h, p["conv2"])
+    h, ns["bn2"] = _bn(h, p["bn2"], s["bn2"], cfg, train, axis_name)
+    if "proj" in p:
+        x = L.conv2d(x, p["proj"], stride=stride)
+        x, ns["proj_bn"] = _bn(x, p["proj_bn"], s["proj_bn"], cfg, train,
+                               axis_name)
+    ns["conv1"] = ns["conv2"] = None
+    if "proj" in p:
+        ns["proj"] = None
+    return jax.nn.relu(x + h), ns
+
+
+def _bottleneck_block(x, p, s, stride, cfg, train, axis_name):
+    ns: State = {}
+    h = L.conv2d(x, p["conv1"])
+    h, ns["bn1"] = _bn(h, p["bn1"], s["bn1"], cfg, train, axis_name)
+    h = jax.nn.relu(h)
+    h = L.conv2d(h, p["conv2"], stride=stride)
+    h, ns["bn2"] = _bn(h, p["bn2"], s["bn2"], cfg, train, axis_name)
+    h = jax.nn.relu(h)
+    h = L.conv2d(h, p["conv3"])
+    h, ns["bn3"] = _bn(h, p["bn3"], s["bn3"], cfg, train, axis_name)
+    if "proj" in p:
+        x = L.conv2d(x, p["proj"], stride=stride)
+        x, ns["proj_bn"] = _bn(x, p["proj_bn"], s["proj_bn"], cfg, train,
+                               axis_name)
+    ns["conv1"] = ns["conv2"] = ns["conv3"] = None
+    if "proj" in p:
+        ns["proj"] = None
+    return jax.nn.relu(x + h), ns
+
+
+def apply(params: Params, state: State, images: jax.Array, cfg: ModelConfig,
+          train: bool = True, axis_name: Optional[str] = None
+          ) -> Tuple[jax.Array, State]:
+    """NHWC images → (logits [B, K], new running-stat state)."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    x = images.astype(cdt)
+    p = jax.tree.map(lambda a: a.astype(cdt), params)
+
+    imagenet_stem = p["stem"]["conv"].shape[0] == 7
+    block = (_bottleneck_block if "bn3" in p["stage1"][0]
+             else _basic_block)
+
+    # Mirror init_state's structure exactly: a treedef change between step 1
+    # and step 2 would silently retrigger compilation.
+    new_state: State = {"fc": {"kernel": None, "bias": None}}
+    x = L.conv2d(x, p["stem"]["conv"], stride=2 if imagenet_stem else 1)
+    x, stem_bn = _bn(x, p["stem"]["bn"], state["stem"]["bn"], cfg, train,
+                     axis_name)
+    new_state["stem"] = {"conv": None, "bn": stem_bn}
+    x = jax.nn.relu(x)
+    if imagenet_stem:
+        x = L.max_pool(x, window=3, stride=2)
+
+    for si in range(1, 5):
+        key = f"stage{si}"
+        if key not in p:
+            break
+        stage_state = []
+        for bi, bp in enumerate(p[key]):
+            stride = 2 if (bi == 0 and si > 1) else 1
+            x, bs = block(x, bp, state[key][bi], stride, cfg, train,
+                          axis_name)
+            stage_state.append(bs)
+        new_state[key] = stage_state
+
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    logits = L.dense(x, p["fc"]["kernel"], p["fc"]["bias"])
+    if cfg.logit_relu:
+        # Faithful-mode switch shared with the reference CNN
+        # (cifar10cnn.py:145); fixed_config turns it off.
+        logits = jax.nn.relu(logits)
+    return logits.astype(jnp.float32), new_state
+
+
+# Shared implementation: models.param_count
+from dml_cnn_cifar10_tpu.models import param_count  # noqa: E402,F401

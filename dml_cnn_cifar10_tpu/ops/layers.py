@@ -18,6 +18,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -59,6 +60,72 @@ def max_pool(x: jax.Array, window: int = 3, stride: int = 2,
 def dense(x: jax.Array, w: jax.Array, b: jax.Array) -> jax.Array:
     """x @ w + b — a single MXU matmul; keep inputs 2-D [B, D]."""
     return jnp.dot(x, w) + b
+
+
+def he_normal_init(key, shape, dtype=jnp.float32) -> jax.Array:
+    """He/Kaiming fan-in normal init for conv (HWIO) / dense (IO) weights.
+
+    Used by the ResNet/ViT configs (no reference counterpart — the reference
+    model predates normalized init, SURVEY §7 step 6).
+    """
+    fan_in = int(np.prod(shape[:-1]))
+    return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fan_in)
+
+
+def batch_norm(
+    x: jax.Array,
+    params,
+    state,
+    train: bool,
+    momentum: float = 0.9,
+    eps: float = 1e-5,
+    axis_name=None,
+):
+    """BatchNorm over NHWC (stats on N,H,W) with running-stat state.
+
+    Cross-replica semantics (SURVEY §2.3): under ``jit`` auto-partitioning
+    the batch axis is sharded over ``data`` and the ``jnp.mean`` below is a
+    *global* mean — XLA compiles the cross-replica reduction in. Under the
+    explicit ``shard_map`` step the batch the kernel sees is the local
+    shard, so ``axis_name`` triggers a literal ``lax.pmean`` of the
+    sufficient statistics (E[x], E[x²]) — the hand-written form of the same
+    collective.
+
+    Returns ``(y, new_state)``; ``new_state`` equals ``state`` in eval.
+    Stats and normalization run in f32 regardless of compute dtype (bf16
+    batch stats lose too much precision); output is cast back to
+    ``x.dtype`` so train and eval emit the same dtype downstream.
+    """
+    axes = tuple(range(x.ndim - 1))
+    xf = x.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(xf, axes)
+        mean_sq = jnp.mean(jnp.square(xf), axes)
+        if axis_name is not None:
+            mean = lax.pmean(mean, axis_name)
+            mean_sq = lax.pmean(mean_sq, axis_name)
+        # Clamp: E[x²]−E[x]² can go (slightly) negative from f32
+        # cancellation when mean² >> var (e.g. raw 0..255 faithful-mode
+        # pixels), and rsqrt would NaN.
+        var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+        new_state = {
+            "mean": momentum * state["mean"] + (1.0 - momentum) * mean,
+            "var": momentum * state["var"] + (1.0 - momentum) * var,
+        }
+    else:
+        mean, var = state["mean"], state["var"]
+        new_state = state
+    inv = lax.rsqrt(var + eps) * params["scale"].astype(jnp.float32)
+    y = (xf - mean) * inv + params["offset"].astype(jnp.float32)
+    return y.astype(x.dtype), new_state
+
+
+def bn_init(width: int, dtype=jnp.float32):
+    """Params for one BatchNorm layer. The running-stat state pytree is
+    derived structurally from the params (``resnet.init_state``) — one
+    source of truth for its shape/dtype."""
+    return {"scale": jnp.ones((width,), dtype),
+            "offset": jnp.zeros((width,), dtype)}
 
 
 def pooled_hw(h: int, w: int, n_pools: int, window: int = 3,

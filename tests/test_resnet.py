@@ -1,0 +1,175 @@
+"""ResNet-18/50 model tests: shapes, param counts, BN state semantics,
+cross-replica parity between auto-jit and explicit shard_map SPMD."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                        ParallelConfig)
+from dml_cnn_cifar10_tpu.models import resnet
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+
+def _cfgs(name="resnet18", classes=10):
+    return (ModelConfig(name=name, num_classes=classes, logit_relu=False),
+            DataConfig())
+
+
+def _batch(rng, n=16, hw=24):
+    images = rng.normal(0.0, 1.0, (n, hw, hw, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, n).astype(np.int32)
+    return images, labels
+
+
+@pytest.fixture(scope="module")
+def r18():
+    cfg, data = _cfgs()
+    params = resnet.init_params(jax.random.key(0), cfg, data, depth=18)
+    state = resnet.init_state(params)
+    return cfg, data, params, state
+
+
+def test_resnet18_shapes_and_params(r18):
+    cfg, data, params, state = r18
+    rng = np.random.default_rng(0)
+    images, _ = _batch(rng)
+    logits, new_state = resnet.apply(params, state, jnp.asarray(images), cfg,
+                                     train=True)
+    assert logits.shape == (16, 10)
+    assert logits.dtype == jnp.float32
+    # torchvision resnet18 is 11.69M with a 7x7 stem; the CIFAR 3x3 stem
+    # drops ~9.4k stem weights => ~11.18M
+    n = resnet.param_count(params)
+    assert 11_000_000 < n < 11_300_000, n
+    # state tree must be structurally identical in and out (no silent
+    # recompile on step 2)
+    assert (jax.tree.structure(state) == jax.tree.structure(new_state))
+
+
+def test_resnet50_bottleneck_shapes():
+    cfg, data = _cfgs("resnet50")
+    params = resnet.init_params(jax.random.key(0), cfg, data, depth=50)
+    state = resnet.init_state(params)
+    rng = np.random.default_rng(0)
+    images, _ = _batch(rng, n=4)
+    logits, _ = resnet.apply(params, state, jnp.asarray(images), cfg,
+                             train=True)
+    assert logits.shape == (4, 10)
+    n = resnet.param_count(params)
+    # torchvision resnet50 = 25.56M with a 1000-class head (2048x1000 =
+    # 2.05M); the 10-class head drops that to ~23.5M
+    assert 23_400_000 < n < 23_700_000, n
+
+
+def test_imagenet_stem_for_large_inputs():
+    cfg, _ = _cfgs("resnet50")
+    data = DataConfig(image_height=224, image_width=224, crop_height=224,
+                      crop_width=224)
+    params = resnet.init_params(jax.random.key(0), cfg, data, depth=50)
+    assert params["stem"]["conv"].shape == (7, 7, 3, 64)
+    state = resnet.init_state(params)
+    images = np.random.default_rng(0).normal(
+        0, 1, (2, 224, 224, 3)).astype(np.float32)
+    logits, _ = resnet.apply(params, state, jnp.asarray(images), cfg,
+                             train=False)
+    assert logits.shape == (2, 10)
+    assert resnet.param_count(params) > 23_400_000
+
+
+def test_bn_state_updates_in_train_frozen_in_eval(r18):
+    cfg, data, params, state = r18
+    rng = np.random.default_rng(1)
+    images, _ = _batch(rng)
+    _, ns_train = resnet.apply(params, state, jnp.asarray(images), cfg,
+                               train=True)
+    stem0 = state["stem"]["bn"]["mean"]
+    stem1 = ns_train["stem"]["bn"]["mean"]
+    assert not np.allclose(stem0, stem1), "train must move running stats"
+    _, ns_eval = resnet.apply(params, state, jnp.asarray(images), cfg,
+                              train=False)
+    chex_equal = jax.tree.map(
+        lambda a, b: np.array_equal(np.asarray(a), np.asarray(b)),
+        state, ns_eval)
+    assert all(jax.tree.leaves(chex_equal)), "eval must not touch stats"
+
+
+def test_eval_deterministic_batch_independent(r18):
+    """Eval uses running stats: each example's logits must not depend on the
+    rest of the batch."""
+    cfg, data, params, state = r18
+    rng = np.random.default_rng(2)
+    images, _ = _batch(rng, n=8)
+    full, _ = resnet.apply(params, state, jnp.asarray(images), cfg,
+                           train=False)
+    half, _ = resnet.apply(params, state, jnp.asarray(images[:4]), cfg,
+                           train=False)
+    np.testing.assert_allclose(np.asarray(full)[:4], np.asarray(half),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gamma_zero_blocks_start_as_identity(r18):
+    """Residual branches are gamma-zero-initialized, so at init the net is
+    stem + projections only — logits finite and loss ~= log(10)."""
+    cfg, data, params, state = r18
+    rng = np.random.default_rng(3)
+    images, labels = _batch(rng)
+    logits, _ = resnet.apply(params, state, jnp.asarray(images), cfg,
+                             train=True)
+    assert np.isfinite(np.asarray(logits)).all()
+    from dml_cnn_cifar10_tpu.train.loss import softmax_cross_entropy
+    loss = softmax_cross_entropy(logits, jnp.asarray(labels))
+    assert abs(float(loss) - np.log(10)) < 1.0
+
+
+def test_explicit_shard_map_matches_auto_jit():
+    """Cross-replica BN: shard_map with axis_name pmean of (E[x],E[x²]) must
+    produce the same update as jit auto-partitioning's global batch stats."""
+    model_def = get_model("resnet18")
+    cfg, data = _cfgs()
+    optim = OptimConfig(learning_rate=0.05, dead_lr_decay=False)
+    mesh = mesh_lib.build_mesh(ParallelConfig())
+    rng = np.random.default_rng(4)
+    images, labels = _batch(rng, n=32)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+
+    results = []
+    for explicit in (False, True):
+        st = step_lib.init_train_state(jax.random.key(0), model_def, cfg,
+                                       data, optim, mesh)
+        train = step_lib.make_train_step(model_def, cfg, optim, mesh,
+                                         explicit_collectives=explicit)
+        st, metrics = train(st, im, lb)
+        results.append((st, metrics))
+
+    (s_auto, m_auto), (s_exp, m_exp) = results
+    np.testing.assert_allclose(float(m_auto["loss"]), float(m_exp["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_auto.params),
+                    jax.tree.leaves(s_exp.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=5e-5)
+    # BN running stats must agree too (the pmean'd sufficient statistics)
+    for a, b in zip(jax.tree.leaves(s_auto.model_state),
+                    jax.tree.leaves(s_exp.model_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=5e-5)
+
+
+def test_two_steps_no_structure_change():
+    """Treedef stability: step 2 reuses the compiled step (same structure)."""
+    model_def = get_model("resnet18")
+    cfg, data = _cfgs()
+    optim = OptimConfig(learning_rate=0.05)
+    st = step_lib.init_train_state(jax.random.key(0), model_def, cfg, data,
+                                   optim)
+    train = step_lib.make_train_step(model_def, cfg, optim)
+    rng = np.random.default_rng(5)
+    for _ in range(2):
+        images, labels = _batch(rng)
+        st, metrics = train(st, jnp.asarray(images), jnp.asarray(labels))
+    assert int(st.step) == 2
+    assert np.isfinite(float(metrics["loss"]))
