@@ -1,0 +1,146 @@
+"""ViT-Tiny — the attention rung of the config ladder.
+
+No reference counterpart (SURVEY §2.3: the reference has no attention and
+fixed 24×24 inputs); this is the BASELINE.json config "ViT-Tiny/16 on
+CIFAR-10 (patch-embed + attention via Pallas)", sized by ``ModelConfig``:
+``patch_size=4`` (24×24 → 6×6 = 36 patches), ``vit_dim=192``,
+``vit_depth=12``, ``vit_heads=3`` — the standard ViT-Ti geometry.
+
+Architecture: conv patch embed → +cls token → learned positional embedding
+→ ``depth`` pre-LN transformer blocks (MHA + 4× GELU MLP) → final LN →
+linear head on the cls token. Attention goes through
+:func:`ops.attention.dispatch_attention` (Pallas flash kernel at long
+sequence lengths, fused XLA softmax-attention at ViT-on-CIFAR lengths).
+
+Functional pytrees like the other models; stateless (LayerNorm has no
+running stats), so the registry wires it like the CNN. The transformer
+stack is a ``lax.scan`` over stacked per-layer params: one compiled block
+body regardless of depth (compile time stays flat as depth grows — XLA
+sees a loop, not 12 inlined copies).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig
+from dml_cnn_cifar10_tpu.ops import attention as attn
+from dml_cnn_cifar10_tpu.ops import layers as L
+
+Params = Dict[str, Any]
+MLP_RATIO = 4
+
+
+def _ln_init(dim: int, dtype):
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def layer_norm(x: jax.Array, p, eps: float = 1e-6) -> jax.Array:
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def _init_block(key, dim: int, dtype) -> Params:
+    ks = jax.random.split(key, 4)
+    hidden = dim * MLP_RATIO
+    return {
+        "ln1": _ln_init(dim, dtype),
+        # fused qkv: one [dim, 3*dim] matmul keeps the MXU busy vs 3 skinny
+        # matmuls
+        "qkv": {"kernel": L.he_normal_init(ks[0], (dim, 3 * dim), dtype),
+                "bias": jnp.zeros((3 * dim,), dtype)},
+        "proj": {"kernel": L.he_normal_init(ks[1], (dim, dim), dtype),
+                 "bias": jnp.zeros((dim,), dtype)},
+        "ln2": _ln_init(dim, dtype),
+        "mlp1": {"kernel": L.he_normal_init(ks[2], (dim, hidden), dtype),
+                 "bias": jnp.zeros((hidden,), dtype)},
+        "mlp2": {"kernel": L.he_normal_init(ks[3], (hidden, dim), dtype),
+                 "bias": jnp.zeros((dim,), dtype)},
+    }
+
+
+def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
+    dtype = jnp.dtype(cfg.dtype)
+    dim, depth = cfg.vit_dim, cfg.vit_depth
+    ph = data.crop_height // cfg.patch_size
+    pw = data.crop_width // cfg.patch_size
+    if ph * cfg.patch_size != data.crop_height or \
+       pw * cfg.patch_size != data.crop_width:
+        raise ValueError(
+            f"input {data.crop_height}x{data.crop_width} not divisible by "
+            f"patch_size={cfg.patch_size}")
+    seq = ph * pw + 1  # +cls
+
+    ks = jax.random.split(key, depth + 4)
+    # One stacked pytree for all blocks: leaves get a leading [depth] axis,
+    # consumed by lax.scan in apply().
+    blocks = [_init_block(ks[i], dim, dtype) for i in range(depth)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+
+    return {
+        "patch": {"kernel": L.he_normal_init(
+                      ks[depth],
+                      (cfg.patch_size, cfg.patch_size, data.num_channels,
+                       dim), dtype),
+                  "bias": jnp.zeros((dim,), dtype)},
+        "cls": jnp.zeros((1, 1, dim), dtype),
+        "pos": 0.02 * jax.random.normal(ks[depth + 1], (1, seq, dim), dtype),
+        "blocks": stacked,
+        "ln_f": _ln_init(dim, dtype),
+        "head": {"kernel": 0.01 * jax.random.normal(
+                     ks[depth + 2], (dim, cfg.num_classes), dtype),
+                 "bias": jnp.zeros((cfg.num_classes,), dtype)},
+    }
+
+
+def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool
+           ) -> jax.Array:
+    b, s, dim = x.shape
+    h = layer_norm(x, p["ln1"])
+    qkv = L.dense(h, p["qkv"]["kernel"], p["qkv"]["bias"])
+    qkv = qkv.reshape(b, s, 3, heads, dim // heads)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    o = attn.dispatch_attention(q, k, v, use_pallas=use_pallas)
+    x = x + L.dense(o.reshape(b, s, dim), p["proj"]["kernel"],
+                    p["proj"]["bias"])
+    h = layer_norm(x, p["ln2"])
+    h = jax.nn.gelu(L.dense(h, p["mlp1"]["kernel"], p["mlp1"]["bias"]))
+    return x + L.dense(h, p["mlp2"]["kernel"], p["mlp2"]["bias"])
+
+
+def apply(params: Params, images: jax.Array, cfg: ModelConfig,
+          train: bool = True) -> jax.Array:
+    """NHWC images → logits [B, num_classes]."""
+    del train  # no dropout in the ladder config
+    cdt = jnp.dtype(cfg.compute_dtype)
+    p = jax.tree.map(lambda a: a.astype(cdt), params)
+    x = images.astype(cdt)
+
+    # Patch embed: stride=patch conv == per-patch linear, one MXU matmul.
+    x = L.conv2d(x, p["patch"]["kernel"], stride=cfg.patch_size,
+                 padding="VALID") + p["patch"]["bias"]
+    b = x.shape[0]
+    x = x.reshape(b, -1, cfg.vit_dim)
+    cls = jnp.broadcast_to(p["cls"], (b, 1, cfg.vit_dim))
+    x = jnp.concatenate([cls, x], axis=1) + p["pos"]
+
+    def body(carry, bp):
+        return _block(carry, bp, cfg.vit_heads,
+                      cfg.use_pallas_attention), None
+
+    x, _ = lax.scan(body, x, p["blocks"])
+    x = layer_norm(x, p["ln_f"])
+    logits = L.dense(x[:, 0], p["head"]["kernel"], p["head"]["bias"])
+    if cfg.logit_relu:
+        # Shared faithful-mode switch (cifar10cnn.py:145); fixed mode off.
+        logits = jax.nn.relu(logits)
+    return logits.astype(jnp.float32)
+
+
+# Shared implementation: models.param_count
+from dml_cnn_cifar10_tpu.models import param_count  # noqa: E402,F401

@@ -1,0 +1,53 @@
+"""Multi-head attention ops.
+
+No reference counterpart (the reference model is attention-free,
+``cifar10cnn.py:94-147``, SURVEY §2.3); this backs the ViT-Tiny ladder
+config (BASELINE.json) and the long-context machinery
+(:mod:`~dml_cnn_cifar10_tpu.parallel.ring_attention`).
+
+Two implementations with one contract::
+
+    attention(q, k, v) -> out          # [B, S, H, D] each
+
+- :func:`xla_attention` — the reference path: one fused
+  softmax(QKᵀ/√d)V in pure lax; XLA fuses it well at short sequence
+  lengths (ViT on CIFAR is 37 tokens — materializing S×S is optimal there).
+- :func:`flash_attention` (ops/flash_attention.py) — blocked online-softmax
+  Pallas kernel for long sequences where the S×S score matrix must never
+  hit HBM.
+
+``dispatch_attention`` picks per config + backend.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  scale: float | None = None) -> jax.Array:
+    """softmax(q kᵀ · scale) v over [B, S, H, D] tensors.
+
+    Computed in float32 regardless of input dtype (softmax in bf16 loses
+    mass at S large); output is cast back to q.dtype.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def dispatch_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                       use_pallas: bool = False) -> jax.Array:
+    """Pick the attention impl: Pallas flash kernel when asked for and the
+    sequence is long enough to benefit; XLA fused attention otherwise."""
+    seq = q.shape[1]
+    if use_pallas and seq >= 128:
+        from dml_cnn_cifar10_tpu.ops import flash_attention as fa
+        return fa.flash_attention(q, k, v)
+    return xla_attention(q, k, v)

@@ -1,0 +1,121 @@
+"""Blocked online-softmax attention — the Pallas TPU kernel.
+
+The long-sequence attention path (SURVEY §5 "long-context"; BASELINE.json
+ViT config "attention via Pallas"). The S×S score matrix never
+materializes in HBM: the kernel walks K/V blocks for each Q block keeping
+the FlashAttention running statistics (row max ``m``, normalizer ``l``,
+unnormalized accumulator ``acc``) in VMEM scratch.
+
+Grid = (batch·heads, q_blocks, k_blocks), k fastest-varying. On TPU the
+grid is executed sequentially per core, so VMEM scratch carries ``m/l/acc``
+across the k iterations of one q block; ``@pl.when(kb == 0)`` resets them
+and the last k iteration writes the normalized output tile. Scores and the
+accumulator are f32 (VPU/MXU accumulate dtype) regardless of input dtype.
+
+On non-TPU backends the same kernel runs under the Pallas interpreter
+(tests exercise it on CPU); ``ops.attention.dispatch_attention`` routes
+short sequences to the fused XLA path where materializing S×S is faster.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # not -inf: exp(-inf - -inf) would NaN the first block
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, kv_len: int, block_k: int):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]                      # [bq, d]
+    k = k_ref[0]                      # [bk, d]
+    v = v_ref[0]                      # [bk, d]
+
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    col = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < kv_len, s, NEG_INF)   # mask padded K/V rows
+
+    m_prev = m_scr[:, :1]                                   # [bq, 1]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_cur)
+    p = jnp.exp(s - m_cur)                                  # [bq, bk]
+    l_scr[:, :1] = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + lax.dot_general(
+        p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:, :1] = m_cur
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "block_q", "block_k",
+                                    "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    scale: float | None = None, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: bool | None = None) -> jax.Array:
+    """FlashAttention over [B, S, H, D] tensors → [B, S, H, D].
+
+    Contract-identical to :func:`ops.attention.xla_attention`; tests assert
+    numerical agreement. Sequence lengths that aren't multiples of the
+    block sizes are zero-padded and masked inside the kernel.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    b, s, h, d = q.shape
+    bq, bk = min(block_q, s), min(block_k, s)
+
+    import math
+    pad_to = math.lcm(bq, bk)  # q and k grids must both cover the padded S
+
+    def to_bh(x):  # [B,S,H,D] → [B*H, S_padded, D]
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+        pad = (-s) % pad_to
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        return x
+
+    qb, kb_, vb = to_bh(q), to_bh(k), to_bh(v)
+    sp = qb.shape[1]
+    nq, nk = sp // bq, sp // bk
+
+    from jax.experimental.pallas import tpu as pltpu
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, kv_len=s, block_k=bk),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # m (col 0 used)
+            pltpu.VMEM((bq, 128), jnp.float32),   # l (col 0 used)
+            pltpu.VMEM((bq, d), jnp.float32),     # acc
+        ],
+        interpret=interpret,
+    )(qb, kb_, vb)
+
+    out = out[:, :s].reshape(b, h, s, d)
+    return jnp.transpose(out, (0, 2, 1, 3))

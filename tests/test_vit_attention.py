@@ -1,0 +1,128 @@
+"""ViT-Tiny + attention-op tests: geometry, param counts, flash-kernel
+numerical parity with the fused XLA path (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig, OptimConfig
+from dml_cnn_cifar10_tpu.models import vit
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.ops import attention as attn
+from dml_cnn_cifar10_tpu.ops import flash_attention as fa
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+
+def _vit_cfgs():
+    # use_pallas_attention stays True: dispatch must still route the 37-token
+    # ViT sequence to the XLA path (short-seq cutoff).
+    return (ModelConfig(name="vit_tiny", logit_relu=False),
+            DataConfig())
+
+
+def test_vit_shapes_and_param_count():
+    cfg, data = _vit_cfgs()
+    params = vit.init_params(jax.random.key(0), cfg, data)
+    rng = np.random.default_rng(0)
+    images = rng.normal(0, 1, (8, 24, 24, 3)).astype(np.float32)
+    logits = vit.apply(params, jnp.asarray(images), cfg)
+    assert logits.shape == (8, 10)
+    # ViT-Ti geometry: 12 blocks x (4*192*192*3 qkv+proj + 8*192*192 mlp)
+    # ~= 5.3M + embeddings; well under 6M
+    n = vit.param_count(params)
+    assert 5_200_000 < n < 6_000_000, n
+    # stacked block leaves carry the depth axis
+    assert params["blocks"]["qkv"]["kernel"].shape == (12, 192, 3 * 192)
+
+
+def test_vit_rejects_indivisible_patch():
+    cfg, data = _vit_cfgs()
+    cfg.patch_size = 5
+    with pytest.raises(ValueError):
+        vit.init_params(jax.random.key(0), cfg, data)
+
+
+def test_vit_train_step_runs():
+    model_def = get_model("vit_tiny")
+    cfg, data = _vit_cfgs()
+    optim = OptimConfig(learning_rate=0.01)
+    st = step_lib.init_train_state(jax.random.key(0), model_def, cfg, data,
+                                   optim)
+    train = step_lib.make_train_step(model_def, cfg, optim)
+    rng = np.random.default_rng(1)
+    images = rng.normal(0, 1, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    st, metrics = train(st, jnp.asarray(images), jnp.asarray(labels))
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(st.step) == 1
+
+
+@pytest.mark.parametrize("s,d,h", [(128, 64, 2), (200, 64, 3), (384, 32, 1)])
+def test_flash_matches_xla(s, d, h):
+    """Online-softmax kernel == fused XLA attention, including non-multiple
+    -of-block sequence lengths (padding + in-kernel masking)."""
+    rng = np.random.default_rng(s)
+    shape = (2, s, h, d)
+    q = rng.normal(0, 1, shape).astype(np.float32)
+    k = rng.normal(0, 1, shape).astype(np.float32)
+    v = rng.normal(0, 1, shape).astype(np.float32)
+    ref = attn.xla_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    out = fa.flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                             interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_flash_mixed_block_sizes():
+    """block_q != block_k with S not a multiple of either: padding must
+    cover BOTH grids (lcm), or trailing keys silently vanish."""
+    rng = np.random.default_rng(9)
+    shape = (1, 96, 1, 32)
+    q = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    out = fa.flash_attention(q, k, v, block_q=128, block_k=64,
+                             interpret=True)
+    ref = attn.xla_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_flash_extreme_logits_stable():
+    """Large score magnitudes: the running-max rescale must not overflow."""
+    rng = np.random.default_rng(7)
+    shape = (1, 256, 1, 64)
+    q = (50 * rng.normal(0, 1, shape)).astype(np.float32)
+    k = (50 * rng.normal(0, 1, shape)).astype(np.float32)
+    v = rng.normal(0, 1, shape).astype(np.float32)
+    out = fa.flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                             interpret=True)
+    ref = attn.xla_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_flash_bfloat16_io():
+    rng = np.random.default_rng(3)
+    shape = (2, 160, 2, 64)
+    q = jnp.asarray(rng.normal(0, 1, shape), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(0, 1, shape), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(0, 1, shape), jnp.bfloat16)
+    out = fa.flash_attention(q, k, v, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = attn.xla_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), rtol=0.05,
+                               atol=0.05)
+
+
+def test_dispatch_routes_by_length():
+    rng = np.random.default_rng(4)
+    short = jnp.asarray(rng.normal(0, 1, (1, 37, 3, 64)), jnp.float32)
+    # short path == xla path bitwise (dispatch must not pad/alter)
+    np.testing.assert_array_equal(
+        np.asarray(attn.dispatch_attention(short, short, short,
+                                           use_pallas=True)),
+        np.asarray(attn.xla_attention(short, short, short)))
