@@ -1,0 +1,220 @@
+// Native record loader: fixed-length record reading + bounded random-shuffle
+// batching, off the Python GIL.
+//
+// TPU-native replacement for the reference's C++ input-queue runtime —
+// string_input_producer -> FixedLengthRecordReader -> RandomShuffleQueue fed
+// by queue-runner threads (cifar10cnn.py:82-90,223; SURVEY §2.2 "Queue
+// runtime"). Same semantics, same roles:
+//
+//   * a reader thread streams 3073-byte records from the shard files,
+//     reshuffling file order each epoch (string_input_producer's
+//     shuffle=True default),
+//   * a bounded shuffle pool of `capacity` records; dequeue picks uniformly
+//     at random among buffered records once at least `min_after` are
+//     present (RandomShuffleQueue semantics: min_after_dequeue=5000,
+//     capacity=5000+3*batch in the reference),
+//   * batch assembly decodes CHW uint8 -> HWC uint8 into a caller-provided
+//     buffer (the transpose runs here, not in NumPy).
+//
+// C ABI for ctypes (no pybind11 in the image). One Loader per iterator;
+// handles are opaque pointers. Thread-safety: one producer thread inside,
+// any single consumer thread outside (the Python iterator).
+//
+// Build: `make -C runtime` -> librecordio.so (see runtime/Makefile);
+// data/native.py auto-builds on first import if the .so is missing.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Loader {
+  // immutable config
+  std::vector<std::string> files;
+  int64_t record_bytes = 0;   // full record: label byte(s) + C*H*W
+  int64_t label_offset = 0;   // which label byte (CIFAR-100 fine = 1)
+  int64_t label_bytes = 0;    // 1 (CIFAR-10) or 2 (CIFAR-100)
+  int64_t height = 0, width = 0, channels = 0;
+  int64_t min_after = 0;      // min buffered records before dequeue
+  int64_t capacity = 0;       // shuffle pool capacity
+
+  // shuffle pool: flat record storage, swap-remove on dequeue
+  std::vector<uint8_t> pool;        // capacity * record_bytes
+  int64_t pool_count = 0;
+  std::mutex mu;
+  std::condition_variable can_produce, can_consume;
+  std::atomic<bool> stop{false};
+  std::string error;                 // sticky producer error, "" = ok
+  bool producer_done = false;
+
+  std::mt19937_64 rng;        // consumer-side (dequeue sampling) only
+  uint64_t file_seed = 0;     // producer-side file-order stream, fixed at
+                              // create time so the two threads never share
+                              // an engine
+  std::thread producer;
+
+  ~Loader() {
+    stop.store(true);
+    can_produce.notify_all();
+    can_consume.notify_all();  // wake any consumer blocked in next_batch
+    if (producer.joinable()) producer.join();
+  }
+};
+
+void producer_loop(Loader* L) {
+  std::mt19937_64 file_rng(L->file_seed);  // file-order shuffle stream
+  std::vector<size_t> order(L->files.size());
+  for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+  std::vector<uint8_t> rec(L->record_bytes);
+
+  while (!L->stop.load()) {  // endless epochs (string_input_producer loop)
+    std::shuffle(order.begin(), order.end(), file_rng);
+    size_t produced_this_epoch = 0;
+    for (size_t fi : order) {
+      if (L->stop.load()) return;
+      FILE* f = std::fopen(L->files[fi].c_str(), "rb");
+      if (!f) {
+        std::lock_guard<std::mutex> g(L->mu);
+        L->error = "cannot open " + L->files[fi];
+        L->producer_done = true;
+        L->can_consume.notify_all();
+        return;
+      }
+      while (std::fread(rec.data(), 1, rec.size(), f) == rec.size()) {
+        std::unique_lock<std::mutex> lk(L->mu);
+        L->can_produce.wait(lk, [L] {
+          return L->stop.load() || L->pool_count < L->capacity;
+        });
+        if (L->stop.load()) { std::fclose(f); return; }
+        std::memcpy(L->pool.data() + L->pool_count * L->record_bytes,
+                    rec.data(), L->record_bytes);
+        ++L->pool_count;
+        ++produced_this_epoch;
+        lk.unlock();
+        L->can_consume.notify_one();
+      }
+      // trailing partial record (corrupt tail) is dropped, matching the
+      // fixed-length reader's behavior
+      std::fclose(f);
+    }
+    if (produced_this_epoch == 0) {
+      // Every file exists but holds zero complete records: spinning epochs
+      // forever would starve the consumer silently. Surface it instead.
+      std::lock_guard<std::mutex> g(L->mu);
+      L->error = "no complete records in input files";
+      L->producer_done = true;
+      L->can_consume.notify_all();
+      return;
+    }
+  }
+}
+
+// Decode one record from the pool into batch slot b: CHW uint8 -> HWC.
+void decode_into(const Loader* L, const uint8_t* rec, uint8_t* images,
+                 int32_t* labels, int64_t b) {
+  labels[b] = static_cast<int32_t>(rec[L->label_offset]);
+  const uint8_t* img = rec + L->label_bytes;
+  const int64_t H = L->height, W = L->width, C = L->channels;
+  uint8_t* out = images + b * H * W * C;
+  for (int64_t c = 0; c < C; ++c) {
+    const uint8_t* plane = img + c * H * W;
+    for (int64_t hw = 0; hw < H * W; ++hw) {
+      out[hw * C + c] = plane[hw];
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// paths: NUL-separated concatenation of n_files file paths.
+void* recordio_create(const char* paths, int64_t n_files,
+                      int64_t record_bytes, int64_t label_bytes,
+                      int64_t label_offset, int64_t height, int64_t width,
+                      int64_t channels, int64_t min_after, int64_t capacity,
+                      uint64_t seed) {
+  if (n_files <= 0 || record_bytes <= 0 || capacity <= 0 ||
+      min_after <= 0 || min_after > capacity ||
+      label_bytes + height * width * channels != record_bytes) {
+    return nullptr;
+  }
+  Loader* L = new Loader();
+  const char* p = paths;
+  for (int64_t i = 0; i < n_files; ++i) {
+    L->files.emplace_back(p);
+    p += L->files.back().size() + 1;
+  }
+  L->record_bytes = record_bytes;
+  L->label_bytes = label_bytes;
+  L->label_offset = label_offset;
+  L->height = height;
+  L->width = width;
+  L->channels = channels;
+  L->min_after = min_after;
+  L->capacity = capacity;
+  L->pool.resize(capacity * record_bytes);
+  L->rng.seed(seed);
+  L->file_seed = L->rng();  // drawn before the producer thread exists
+  L->producer = std::thread(producer_loop, L);
+  return L;
+}
+
+// Fill a [batch, H, W, C] uint8 image buffer + [batch] int32 labels.
+// Returns 0 on success, -1 on producer error (recordio_error has details).
+int recordio_next_batch(void* handle, int64_t batch, uint8_t* images,
+                        int32_t* labels) {
+  Loader* L = static_cast<Loader*>(handle);
+  std::uniform_int_distribution<int64_t> dist;
+  for (int64_t b = 0; b < batch; ++b) {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->can_consume.wait(lk, [L] {
+      return L->pool_count >= L->min_after || L->producer_done ||
+             L->stop.load();
+    });
+    if (L->stop.load()) return -1;      // destroy() raced a blocked consumer
+    if (!L->error.empty()) return -1;
+    if (L->pool_count == 0) return -1;  // producer died with empty pool
+    int64_t idx = dist(L->rng,
+                       decltype(dist)::param_type(0, L->pool_count - 1));
+    decode_into(L, L->pool.data() + idx * L->record_bytes, images, labels,
+                b);
+    // swap-remove: O(1) dequeue, uniform over the pool
+    --L->pool_count;
+    if (idx != L->pool_count) {
+      std::memcpy(L->pool.data() + idx * L->record_bytes,
+                  L->pool.data() + L->pool_count * L->record_bytes,
+                  L->record_bytes);
+    }
+    lk.unlock();
+    L->can_produce.notify_one();
+  }
+  return 0;
+}
+
+const char* recordio_error(void* handle) {
+  Loader* L = static_cast<Loader*>(handle);
+  std::lock_guard<std::mutex> g(L->mu);
+  return L->error.c_str();  // valid until destroy
+}
+
+int64_t recordio_buffered(void* handle) {
+  Loader* L = static_cast<Loader*>(handle);
+  std::lock_guard<std::mutex> g(L->mu);
+  return L->pool_count;
+}
+
+void recordio_destroy(void* handle) {
+  delete static_cast<Loader*>(handle);
+}
+
+}  // extern "C"
