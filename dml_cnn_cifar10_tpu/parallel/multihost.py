@@ -37,7 +37,9 @@ def initialize(cfg: ParallelConfig) -> None:
     """Idempotent ``jax.distributed.initialize`` from config."""
     if cfg.num_processes <= 1:
         return
-    if jax.process_count() > 1:  # already initialized
+    # NB: must not touch jax.process_count() here — it initializes the XLA
+    # backend, after which jax.distributed.initialize refuses to run.
+    if jax.distributed.is_initialized():
         return
     jax.distributed.initialize(
         coordinator_address=cfg.coordinator_address,

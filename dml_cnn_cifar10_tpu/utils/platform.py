@@ -1,0 +1,42 @@
+"""Backend-platform selection helpers.
+
+This box's sitecustomize pins ``JAX_PLATFORMS`` to the TPU plugin and
+overrides the env var, so forcing the CPU backend requires BOTH the env var
+(for code that reads it before jax loads) and ``jax.config.update`` after
+import. Used by the test suite, the multichip dry run, and multi-process
+worker scripts; importing ``jax`` (without touching devices) is safe here —
+the backend only initializes on first use.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+_COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def force_cpu(virtual_devices: Optional[int] = None) -> None:
+    """Pin the CPU backend, optionally with N virtual devices.
+
+    Must be called before anything initializes the XLA backend
+    (``jax.devices()``, any computation, ``jax.distributed.initialize``).
+    A pre-existing device-count flag with a DIFFERENT value is an error —
+    silently keeping it would strand callers on the wrong mesh size.
+    """
+    if virtual_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = _COUNT_RE.search(flags)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{virtual_devices}").strip()
+        elif int(m.group(1)) != virtual_devices:
+            raise RuntimeError(
+                f"XLA_FLAGS already pins "
+                f"{m.group(1)} host-platform devices; caller asked for "
+                f"{virtual_devices}. Unset XLA_FLAGS or reconcile.")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")

@@ -4,18 +4,11 @@ import os
 
 # Force CPU even when the environment pins a TPU platform (JAX_PLATFORMS=axon
 # on the bench box): the test suite runs on the 8-virtual-device CPU mesh.
-# The axon sitecustomize overrides the env var, so set jax.config directly
-# (before any backend initialization).
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from dml_cnn_cifar10_tpu.utils.platform import force_cpu
+
+force_cpu(virtual_devices=8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
