@@ -51,7 +51,10 @@ def _init_block(key, dim: int, dtype) -> Params:
     return {
         "ln1": _ln_init(dim, dtype),
         # fused qkv: one [dim, 3*dim] matmul keeps the MXU busy vs 3 skinny
-        # matmuls
+        # matmuls. Output features are HEADS-MAJOR ([head][q|k|v][hd]) so
+        # column-sharding over the ``model`` mesh axis splits whole heads
+        # (parallel/shardings.py) and the attention tensors stay
+        # head-sharded with no resharding.
         "qkv": {"kernel": L.he_normal_init(ks[0], (dim, 3 * dim), dtype),
                 "bias": jnp.zeros((3 * dim,), dtype)},
         "proj": {"kernel": L.he_normal_init(ks[1], (dim, dim), dtype),
@@ -103,8 +106,8 @@ def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool
     b, s, dim = x.shape
     h = layer_norm(x, p["ln1"])
     qkv = L.dense(h, p["qkv"]["kernel"], p["qkv"]["bias"])
-    qkv = qkv.reshape(b, s, 3, heads, dim // heads)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    qkv = qkv.reshape(b, s, heads, 3, dim // heads)  # heads-major
+    q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
     o = attn.dispatch_attention(q, k, v, use_pallas=use_pallas)
     x = x + L.dense(o.reshape(b, s, dim), p["proj"]["kernel"],
                     p["proj"]["bias"])

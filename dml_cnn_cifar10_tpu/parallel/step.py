@@ -28,6 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig, OptimConfig
 from dml_cnn_cifar10_tpu.models.registry import ModelDef
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import shardings as shardings_lib
 from dml_cnn_cifar10_tpu.train import loss as loss_lib
 from dml_cnn_cifar10_tpu.train import metrics as metrics_lib
 from dml_cnn_cifar10_tpu.train import optim as optim_lib
@@ -55,6 +56,7 @@ def init_train_state(
     data_cfg: DataConfig,
     optim_cfg: OptimConfig,
     mesh: Optional[Mesh] = None,
+    state_sharding: Optional[TrainState] = None,
 ) -> TrainState:
     """Initialize params/opt/model-state; replicate over the mesh.
 
@@ -69,9 +71,30 @@ def init_train_state(
         opt=optim_lib.sgd_init(params, optim_cfg),
         model_state=model_def.init_state(params),
     )
-    if mesh is not None:
-        state = jax.device_put(state, mesh_lib.replicated(mesh))
+    if state_sharding is not None:
+        state = jax.device_put(state, state_sharding)
+    elif mesh is not None:
+        state = jax.device_put(
+            state, shardings_lib.state_shardings(mesh, model_cfg.name, state))
     return state
+
+
+def train_state_shardings(
+    mesh: Mesh,
+    model_def: ModelDef,
+    model_cfg: ModelConfig,
+    data_cfg: DataConfig,
+    optim_cfg: OptimConfig,
+) -> TrainState:
+    """The ``TrainState`` sharding tree (tensor-parallel rules applied) for
+    a model config, computed shape-only via ``eval_shape``. Compute it ONCE
+    and hand the same tree to ``make_train_step`` / ``make_eval_step`` /
+    ``restore_checkpoint`` — it is the single currency for state layout."""
+    abstract = jax.eval_shape(
+        lambda k: init_train_state(k, model_def, model_cfg, data_cfg,
+                                   optim_cfg),
+        jax.random.key(0))
+    return shardings_lib.state_shardings(mesh, model_cfg.name, abstract)
 
 
 def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,
@@ -99,12 +122,23 @@ def make_train_step(
     optim_cfg: OptimConfig,
     mesh: Optional[Mesh] = None,
     explicit_collectives: bool = False,
+    state_sharding: Optional[TrainState] = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array],
               Tuple[TrainState, dict]]:
     """Build the jitted train step:
-    ``(state, images, labels) -> (new_state, {"loss", "accuracy"})``."""
+    ``(state, images, labels) -> (new_state, {"loss", "accuracy"})``.
+
+    ``state_sharding`` (a ``train_state_shardings`` tree) keeps weights
+    partitioned per the model's tensor-parallel rules
+    (:mod:`~dml_cnn_cifar10_tpu.parallel.shardings`); ``None`` means
+    replicated state — identical layout when the ``model`` axis is 1.
+    """
 
     if explicit_collectives and mesh is not None:
+        if mesh.shape["model"] * mesh.shape["seq"] > 1:
+            raise ValueError(
+                "explicit_collectives is the pedagogical dp-only path; "
+                "tensor/sequence axes need the GSPMD (default) step")
         return _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh)
 
     loss_fn = _forward_loss(model_def, model_cfg)
@@ -122,12 +156,13 @@ def make_train_step(
     if mesh is None:
         return jax.jit(step, donate_argnums=0)
     repl = mesh_lib.replicated(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
     data = mesh_lib.batch_sharding(mesh, 4)
     lab = mesh_lib.batch_sharding(mesh, 1)
     return jax.jit(
         step,
-        in_shardings=(repl, data, lab),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, data, lab),
+        out_shardings=(state_sh, repl),
         donate_argnums=0,
     )
 
@@ -171,6 +206,7 @@ def make_eval_step(
     model_def: ModelDef,
     model_cfg: ModelConfig,
     mesh: Optional[Mesh] = None,
+    state_sharding: Optional[TrainState] = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], dict]:
     """Jitted eval: ``(state, images, labels) -> {"accuracy", "correct"}`` —
     single-batch accuracy for faithful parity eval (``cifar10cnn.py:
@@ -192,9 +228,10 @@ def make_eval_step(
     if mesh is None:
         return jax.jit(step)
     repl = mesh_lib.replicated(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
     return jax.jit(
         step,
-        in_shardings=(repl, mesh_lib.batch_sharding(mesh, 4),
+        in_shardings=(state_sh, mesh_lib.batch_sharding(mesh, 4),
                       mesh_lib.batch_sharding(mesh, 1)),
         out_shardings=repl,
     )

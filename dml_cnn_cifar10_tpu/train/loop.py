@@ -51,20 +51,30 @@ class Trainer:
         self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(
             cfg.parallel)
         self.model_def = get_model(cfg.model.name)
+        # One sharding tree, computed once, used everywhere state is placed
+        # (init, restore, train/eval in_shardings). The explicit-collectives
+        # path is dp-only and expects replicated state.
+        self.state_sharding = None if cfg.parallel.explicit_collectives \
+            else step_lib.train_state_shardings(
+                self.mesh, self.model_def, cfg.model, cfg.data, cfg.optim)
         self.train_step = step_lib.make_train_step(
             self.model_def, cfg.model, cfg.optim, self.mesh,
-            explicit_collectives=cfg.parallel.explicit_collectives)
-        self.eval_step = step_lib.make_eval_step(self.model_def, cfg.model,
-                                                 self.mesh)
+            explicit_collectives=cfg.parallel.explicit_collectives,
+            state_sharding=self.state_sharding)
+        self.eval_step = step_lib.make_eval_step(
+            self.model_def, cfg.model, self.mesh,
+            state_sharding=self.state_sharding)
         self.logger = MetricsLogger(cfg.metrics_jsonl, task_index=task_index)
 
     def init_or_restore(self) -> step_lib.TrainState:
         key = jax.random.key(self.cfg.seed)
+        sharding = self.state_sharding if self.state_sharding is not None \
+            else mesh_lib.replicated(self.mesh)
         state = step_lib.init_train_state(
             key, self.model_def, self.cfg.model, self.cfg.data,
-            self.cfg.optim, self.mesh)
+            self.cfg.optim, self.mesh, state_sharding=sharding)
         return ckpt_lib.restore_checkpoint(
-            self.cfg.log_dir, state, sharding=mesh_lib.replicated(self.mesh))
+            self.cfg.log_dir, state, sharding=sharding)
 
     def _placed(self, batch: pipe.Batch):
         return mesh_lib.shard_batch(self.mesh, batch.images, batch.labels)
