@@ -34,11 +34,29 @@ def _ckpt_path(ckpt_dir: str, step: int) -> str:
     return os.path.join(ckpt_dir, f"ckpt_{step}.msgpack")
 
 
+def fetch_to_host(state: Any) -> Any:
+    """Device→host fetch that is safe for sharded state.
+
+    Tensor-parallel leaves on a multi-host mesh are not fully addressable;
+    ``process_allgather`` reassembles the global value (a collective — EVERY
+    process must call this, even when only the chief writes; see
+    ``CheckpointManager.maybe_save``). Fully-addressable leaves (single-host
+    or replicated) take the plain ``device_get`` path.
+    """
+    def to_host(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return multihost_utils.process_allgather(x, tiled=True)
+        return jax.device_get(x)
+
+    return jax.tree.map(to_host, state)
+
+
 def save_checkpoint(ckpt_dir: str, state: Any, step: int,
                     keep: int = 3) -> str:
     """Atomically write ``ckpt_<step>.msgpack``; prune to ``keep`` newest."""
     os.makedirs(ckpt_dir, exist_ok=True)
-    host_state = jax.tree.map(lambda x: jax.device_get(x), state)
+    host_state = fetch_to_host(state)
     data = serialization.to_bytes(host_state)
     path = _ckpt_path(ckpt_dir, step)
     tmp = path + ".tmp"
@@ -78,7 +96,7 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
         return target
     with open(path, "rb") as f:
         data = f.read()
-    host_target = jax.tree.map(lambda x: jax.device_get(x), target)
+    host_target = fetch_to_host(target)
     restored = serialization.from_bytes(host_target, data)
     if sharding is not None:
         restored = jax.device_put(restored, sharding)
@@ -97,9 +115,13 @@ class CheckpointManager:
             else is_chief
 
     def maybe_save(self, state: Any, step: int, force: bool = False) -> bool:
-        if not self.is_chief:
-            return False
         if not force and step % self.every_steps != 0:
             return False
-        save_checkpoint(self.ckpt_dir, state, step, keep=self.keep)
+        # Collective fetch BEFORE the chief check: with tensor-parallel
+        # state on a multi-host mesh the gather is a collective, so every
+        # process participates; only the chief touches the filesystem.
+        host_state = fetch_to_host(state)
+        if not self.is_chief:
+            return False
+        save_checkpoint(self.ckpt_dir, host_state, step, keep=self.keep)
         return True

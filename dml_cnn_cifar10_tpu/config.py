@@ -90,6 +90,11 @@ class ModelConfig:
     vit_depth: int = 12
     vit_heads: int = 3
     use_pallas_attention: bool = True     # Pallas flash-attention on TPU
+    # "cls" = prepend a class token (standard ViT head). "mean" = no class
+    # token, mean-pool the tokens — the long-context/sequence-parallel mode,
+    # where the token count must divide the ``seq`` mesh axis and a lone
+    # cls token would break the even sharding.
+    pool: str = "cls"                     # cls | mean
 
 
 @dataclasses.dataclass

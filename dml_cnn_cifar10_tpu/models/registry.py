@@ -12,6 +12,9 @@ class ModelDef(NamedTuple):
                             #           -> (logits, new_state)
     init_state: Callable    # (params) -> mutable state pytree ({} if none)
     has_state: bool
+    # apply accepts a ``mesh=`` kwarg and uses it for sequence-parallel
+    # (ring-attention) routing when the mesh's ``seq`` axis is >1.
+    wants_mesh: bool = False
 
 
 def _cnn() -> ModelDef:
@@ -33,7 +36,8 @@ def _resnet(depth: int) -> Callable[[], ModelDef]:
 
 def _vit() -> ModelDef:
     from dml_cnn_cifar10_tpu.models import vit
-    return ModelDef(vit.init_params, vit.apply, lambda p: {}, False)
+    return ModelDef(vit.init_params, vit.apply, lambda p: {}, False,
+                    wants_mesh=True)
 
 
 MODELS = {

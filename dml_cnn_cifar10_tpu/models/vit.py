@@ -26,6 +26,7 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig
 from dml_cnn_cifar10_tpu.ops import attention as attn
@@ -77,7 +78,7 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
         raise ValueError(
             f"input {data.crop_height}x{data.crop_width} not divisible by "
             f"patch_size={cfg.patch_size}")
-    seq = ph * pw + 1  # +cls
+    seq = ph * pw + (1 if cfg.pool == "cls" else 0)
 
     ks = jax.random.split(key, depth + 4)
     # One stacked pytree for all blocks: leaves get a leading [depth] axis,
@@ -85,13 +86,12 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
     blocks = [_init_block(ks[i], dim, dtype) for i in range(depth)]
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
 
-    return {
+    params = {
         "patch": {"kernel": L.he_normal_init(
                       ks[depth],
                       (cfg.patch_size, cfg.patch_size, data.num_channels,
                        dim), dtype),
                   "bias": jnp.zeros((dim,), dtype)},
-        "cls": jnp.zeros((1, 1, dim), dtype),
         "pos": 0.02 * jax.random.normal(ks[depth + 1], (1, seq, dim), dtype),
         "blocks": stacked,
         "ln_f": _ln_init(dim, dtype),
@@ -99,16 +99,28 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
                      ks[depth + 2], (dim, cfg.num_classes), dtype),
                  "bias": jnp.zeros((cfg.num_classes,), dtype)},
     }
+    if cfg.pool == "cls":
+        params["cls"] = jnp.zeros((1, 1, dim), dtype)
+    elif cfg.pool != "mean":
+        raise ValueError(f"pool must be 'cls' or 'mean', got {cfg.pool!r}")
+    return params
 
 
-def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool
-           ) -> jax.Array:
+def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
+           mesh=None) -> jax.Array:
     b, s, dim = x.shape
     h = layer_norm(x, p["ln1"])
     qkv = L.dense(h, p["qkv"]["kernel"], p["qkv"]["bias"])
     qkv = qkv.reshape(b, s, heads, 3, dim // heads)  # heads-major
     q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
-    o = attn.dispatch_attention(q, k, v, use_pallas=use_pallas)
+    if mesh is not None:
+        # Sequence-parallel path: blockwise ring attention over the ``seq``
+        # mesh axis — each device holds S/seq tokens, K/V shards walk the
+        # ring over ICI (parallel/ring_attention.py).
+        from dml_cnn_cifar10_tpu.parallel import ring_attention as ring
+        o = ring.ring_attention(q, k, v, mesh)
+    else:
+        o = attn.dispatch_attention(q, k, v, use_pallas=use_pallas)
     x = x + L.dense(o.reshape(b, s, dim), p["proj"]["kernel"],
                     p["proj"]["bias"])
     h = layer_norm(x, p["ln2"])
@@ -117,9 +129,16 @@ def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool
 
 
 def apply(params: Params, images: jax.Array, cfg: ModelConfig,
-          train: bool = True) -> jax.Array:
-    """NHWC images → logits [B, num_classes]."""
+          train: bool = True, mesh=None) -> jax.Array:
+    """NHWC images → logits [B, num_classes].
+
+    ``mesh`` with a ``seq`` axis >1 switches attention to the ring
+    (sequence-parallel) kernel and keeps token activations sharded
+    [data, seq] between blocks; requires ``pool='mean'`` (no cls token) and
+    a token count divisible by the ``seq`` axis.
+    """
     del train  # no dropout in the ladder config
+    seq_parallel = mesh is not None and mesh.shape.get("seq", 1) > 1
     cdt = jnp.dtype(cfg.compute_dtype)
     p = jax.tree.map(lambda a: a.astype(cdt), params)
     x = images.astype(cdt)
@@ -129,16 +148,33 @@ def apply(params: Params, images: jax.Array, cfg: ModelConfig,
                  padding="VALID") + p["patch"]["bias"]
     b = x.shape[0]
     x = x.reshape(b, -1, cfg.vit_dim)
-    cls = jnp.broadcast_to(p["cls"], (b, 1, cfg.vit_dim))
-    x = jnp.concatenate([cls, x], axis=1) + p["pos"]
+    if cfg.pool == "cls":
+        cls = jnp.broadcast_to(p["cls"], (b, 1, cfg.vit_dim))
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + p["pos"]
+
+    if seq_parallel:
+        if cfg.pool != "mean":
+            raise ValueError(
+                "sequence parallelism needs pool='mean' (a cls token breaks "
+                "even seq sharding)")
+        if x.shape[1] % mesh.shape["seq"]:
+            raise ValueError(
+                f"{x.shape[1]} tokens not divisible by seq axis "
+                f"{mesh.shape['seq']}")
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("data", "seq", None)))
+
+    attn_mesh = mesh if seq_parallel else None
 
     def body(carry, bp):
         return _block(carry, bp, cfg.vit_heads,
-                      cfg.use_pallas_attention), None
+                      cfg.use_pallas_attention, mesh=attn_mesh), None
 
     x, _ = lax.scan(body, x, p["blocks"])
     x = layer_norm(x, p["ln_f"])
-    logits = L.dense(x[:, 0], p["head"]["kernel"], p["head"]["bias"])
+    pooled = jnp.mean(x, axis=1) if cfg.pool == "mean" else x[:, 0]
+    logits = L.dense(pooled, p["head"]["kernel"], p["head"]["bias"])
     if cfg.logit_relu:
         # Shared faithful-mode switch (cifar10cnn.py:145); fixed mode off.
         logits = jax.nn.relu(logits)

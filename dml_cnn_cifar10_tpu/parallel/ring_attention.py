@@ -112,7 +112,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         raise ValueError(
             f"sequence length {q.shape[1]} not divisible by seq axis "
             f"{nseq}")
-    spec = P("data", axis_name, None, None)
+    # Heads are batch-like inside the ring body, so when the mesh also has
+    # a nontrivial ``model`` (tensor-parallel) axis the heads dim shards
+    # over it — sp × tp compose with zero resharding at the kernel edge.
+    # When the head count doesn't divide the axis (e.g. default ViT-Ti's 3
+    # heads on model=2), fall back to replicated heads: correct, just an
+    # all-gather at the kernel edge instead of a free composition.
+    nmodel = mesh.shape.get("model", 1)
+    head_axis = "model" if nmodel > 1 and q.shape[2] % nmodel == 0 else None
+    spec = P("data", axis_name, head_axis, None)
     fn = jax.shard_map(
         functools.partial(ring_attention_local, axis_name=axis_name,
                           scale=scale),

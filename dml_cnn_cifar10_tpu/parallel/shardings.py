@@ -130,11 +130,15 @@ def state_shardings(mesh: Mesh, model_name: str, state: Any) -> Any:
 
 
 def assert_some_leaf_sharded(state: Any, axis: str = "model") -> bool:
-    """True iff at least one leaf is actually partitioned over ``axis`` —
-    used by tests and the driver dry run to prove tp is real, not declared."""
+    """True iff at least one leaf is actually partitioned over ``axis``
+    (spec names the axis AND the axis has >1 devices, i.e. the leaf really
+    has multiple distinct shards) — used by tests and the driver dry run to
+    prove tp is real, not declared."""
     for leaf in jax.tree.leaves(state):
         sharding = getattr(leaf, "sharding", None)
         if sharding is None or not isinstance(sharding, NamedSharding):
+            continue
+        if sharding.mesh.shape.get(axis, 1) <= 1:
             continue
         if any(axis in (p if isinstance(p, tuple) else (p,))
                for p in sharding.spec if p is not None):

@@ -58,12 +58,16 @@ def init_train_state(
     mesh: Optional[Mesh] = None,
     state_sharding: Optional[TrainState] = None,
 ) -> TrainState:
-    """Initialize params/opt/model-state; replicate over the mesh.
+    """Initialize params/opt/model-state and place them on the mesh.
 
     Replaces chief-initializes-variables-on-PS + workers-wait
     (``cifar10cnn.py:222`` via MonitoredTrainingSession): under SPMD every
     process runs the same deterministic init from the same seed, and the
-    replicated sharding guarantees identical values on every chip.
+    mesh placement guarantees consistent values on every chip.
+
+    Placement defaults to replicated — symmetric with ``make_train_step``'s
+    default in_shardings. For tensor parallelism pass the SAME
+    ``train_state_shardings`` tree to both (as ``Trainer`` does).
     """
     params = model_def.init(key, model_cfg, data_cfg)
     state = TrainState(
@@ -74,8 +78,7 @@ def init_train_state(
     if state_sharding is not None:
         state = jax.device_put(state, state_sharding)
     elif mesh is not None:
-        state = jax.device_put(
-            state, shardings_lib.state_shardings(mesh, model_cfg.name, state))
+        state = jax.device_put(state, mesh_lib.replicated(mesh))
     return state
 
 
@@ -98,9 +101,12 @@ def train_state_shardings(
 
 
 def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,
-                  axis_name: Optional[str] = None):
+                  axis_name: Optional[str] = None,
+                  mesh: Optional[Mesh] = None):
     """loss_fn(params, model_state, images, labels) →
     (loss, (logits, new_model_state))."""
+    mesh_kwargs = {"mesh": mesh} if (model_def.wants_mesh and
+                                     mesh is not None) else {}
 
     def loss_fn(params, model_state, images, labels):
         if model_def.has_state:
@@ -108,7 +114,8 @@ def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,
             logits, new_state = model_def.apply(
                 params, model_state, images, model_cfg, train=True, **kwargs)
         else:
-            logits = model_def.apply(params, images, model_cfg, train=True)
+            logits = model_def.apply(params, images, model_cfg, train=True,
+                                     **mesh_kwargs)
             new_state = model_state
         return loss_lib.softmax_cross_entropy(logits, labels), (logits,
                                                                 new_state)
@@ -141,7 +148,7 @@ def make_train_step(
                 "tensor/sequence axes need the GSPMD (default) step")
         return _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh)
 
-    loss_fn = _forward_loss(model_def, model_cfg)
+    loss_fn = _forward_loss(model_def, model_cfg, mesh=mesh)
 
     def step(state: TrainState, images, labels):
         (loss, (logits, new_model_state)), grads = jax.value_and_grad(
@@ -213,13 +220,16 @@ def make_eval_step(
     237-241``); ``correct`` is the global summable count for full-test-set
     eval (pad rows labeled -1 contribute 0)."""
 
+    mesh_kwargs = {"mesh": mesh} if (model_def.wants_mesh and
+                                     mesh is not None) else {}
+
     def step(state: TrainState, images, labels):
         if model_def.has_state:
             logits, _ = model_def.apply(state.params, state.model_state,
                                         images, model_cfg, train=False)
         else:
             logits = model_def.apply(state.params, images, model_cfg,
-                                     train=False)
+                                     train=False, **mesh_kwargs)
         return {
             "accuracy": metrics_lib.batch_accuracy(logits, labels),
             "correct": metrics_lib.correct_count(logits, labels),

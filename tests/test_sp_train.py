@@ -1,0 +1,107 @@
+"""Sequence parallelism in the TRAINING step: ring attention wired into the
+ViT forward/backward under jit, composed with dp and tp on one mesh.
+
+Complements test_ring_attention.py (op-level correctness) — here the whole
+train step runs sequence-sharded and must match the dp-only run.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                        ParallelConfig)
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+# 32x32 inputs, patch 4 -> 8x8 = 64 tokens: divisible by seq axes 2 and 4.
+DATA = DataConfig(crop_height=32, crop_width=32, normalize="scale")
+VIT = ModelConfig(name="vit_tiny", pool="mean", logit_relu=False,
+                  vit_depth=2, vit_dim=64, vit_heads=2, patch_size=4)
+
+
+def _mesh(data, model=1, seq=1):
+    return mesh_lib.build_mesh(
+        ParallelConfig(data_axis=data, model_axis=model, seq_axis=seq))
+
+
+def _run(model_cfg, mesh, images, labels, nsteps=2):
+    model_def = get_model(model_cfg.name)
+    optim = OptimConfig(learning_rate=0.01)
+    sh = step_lib.train_state_shardings(mesh, model_def, model_cfg, DATA,
+                                        optim)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, model_cfg, DATA, optim, mesh,
+        state_sharding=sh)
+    train = step_lib.make_train_step(model_def, model_cfg, optim, mesh,
+                                     state_sharding=sh)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    losses = []
+    for _ in range(nsteps):
+        state, metrics = train(state, im, lb)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    return state, losses
+
+
+def _batch(rng, n=8):
+    images = rng.normal(0.5, 0.25, (n, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, n).astype(np.int32)
+    return images, labels
+
+
+@pytest.mark.parametrize("axes", [(2, 1, 4), (4, 1, 2), (2, 2, 2)])
+def test_sp_train_matches_dp(axes, rng):
+    """dp×tp×sp must be a pure layout change vs the dp-only mesh."""
+    images, labels = _batch(rng)
+    _, loss_dp = _run(VIT, _mesh(8), images, labels)
+    st, loss_sp = _run(VIT, _mesh(*axes), images, labels)
+    np.testing.assert_allclose(loss_dp, loss_sp, rtol=2e-5, atol=2e-6)
+    assert np.isfinite(loss_sp).all()
+
+
+def test_sp_eval_step(rng):
+    mesh = _mesh(2, 1, 4)
+    model_def = get_model("vit_tiny")
+    optim = OptimConfig()
+    sh = step_lib.train_state_shardings(mesh, model_def, VIT, DATA, optim)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, VIT, DATA, optim, mesh,
+        state_sharding=sh)
+    ev = step_lib.make_eval_step(model_def, VIT, mesh, state_sharding=sh)
+    images, labels = _batch(rng)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    m = ev(state, im, lb)
+    assert 0.0 <= float(m["accuracy"]) <= 1.0
+
+
+def test_sp_requires_mean_pool(rng):
+    cfg = dataclasses.replace(VIT, pool="cls")
+    images, labels = _batch(rng)
+    with pytest.raises(ValueError, match="mean"):
+        _run(cfg, _mesh(2, 1, 4), images, labels, nsteps=1)
+
+
+def test_sp_rejects_indivisible_tokens(rng):
+    # 24x24 / patch 4 -> 36 tokens; seq axis 8 does not divide 36.
+    data = dataclasses.replace(DATA, crop_height=24, crop_width=24)
+    mesh = _mesh(1, 1, 8)
+    model_def = get_model("vit_tiny")
+    optim = OptimConfig()
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, VIT, data, optim, mesh)
+    train = step_lib.make_train_step(model_def, VIT, optim, mesh)
+    rng2 = np.random.default_rng(0)
+    images = rng2.normal(0.5, 0.25, (8, 24, 24, 3)).astype(np.float32)
+    labels = rng2.integers(0, 10, 8).astype(np.int32)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    with pytest.raises(ValueError, match="divisible"):
+        train(state, im, lb)
+
+
+def test_mean_pool_vit_no_cls_param():
+    params = get_model("vit_tiny").init(jax.random.key(0), VIT, DATA)
+    assert "cls" not in params
+    assert params["pos"].shape == (1, 64, 64)

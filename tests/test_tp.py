@@ -83,8 +83,11 @@ def test_cnn_params_actually_sharded():
     mesh = _mesh()
     model_def = get_model("cnn")
     cfg = ModelConfig(logit_relu=False)
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, DATA,
+                                        OptimConfig())
     state = step_lib.init_train_state(
-        jax.random.key(0), model_def, cfg, DATA, OptimConfig(), mesh)
+        jax.random.key(0), model_def, cfg, DATA, OptimConfig(), mesh,
+        state_sharding=sh)
     k = state.params["full1"]["kernel"]
     assert k.sharding.spec == P(None, "model")
     # each model-shard holds half of the 384 output features
@@ -122,8 +125,11 @@ def test_tp_heads_sharded_vit():
     cfg = ModelConfig(name="vit_tiny", vit_depth=2, vit_dim=64, vit_heads=2,
                       patch_size=8, logit_relu=False)
     model_def = get_model("vit_tiny")
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, DATA,
+                                        OptimConfig())
     state = step_lib.init_train_state(
-        jax.random.key(0), model_def, cfg, DATA, OptimConfig(), mesh)
+        jax.random.key(0), model_def, cfg, DATA, OptimConfig(), mesh,
+        state_sharding=sh)
     k = state.params["blocks"]["qkv"]["kernel"]
     assert k.shape == (2, 64, 3 * 64)
     assert k.addressable_shards[0].data.shape == (2, 64, 3 * 32)
