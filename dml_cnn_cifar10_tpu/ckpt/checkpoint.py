@@ -54,9 +54,14 @@ def fetch_to_host(state: Any) -> Any:
 
 def save_checkpoint(ckpt_dir: str, state: Any, step: int,
                     keep: int = 3) -> str:
-    """Atomically write ``ckpt_<step>.msgpack``; prune to ``keep`` newest."""
+    """Fetch (collective-safe) + atomically write ``ckpt_<step>.msgpack``."""
+    return _write_checkpoint(ckpt_dir, fetch_to_host(state), step, keep)
+
+
+def _write_checkpoint(ckpt_dir: str, host_state: Any, step: int,
+                      keep: int) -> str:
+    """Write an already-on-host state; prune to ``keep`` newest."""
     os.makedirs(ckpt_dir, exist_ok=True)
-    host_state = fetch_to_host(state)
     data = serialization.to_bytes(host_state)
     path = _ckpt_path(ckpt_dir, step)
     tmp = path + ".tmp"
@@ -123,5 +128,5 @@ class CheckpointManager:
         host_state = fetch_to_host(state)
         if not self.is_chief:
             return False
-        save_checkpoint(self.ckpt_dir, host_state, step, keep=self.keep)
+        _write_checkpoint(self.ckpt_dir, host_state, step, keep=self.keep)
         return True

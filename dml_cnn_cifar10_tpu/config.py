@@ -128,6 +128,7 @@ class ParallelConfig:
     data_axis: int = -1                   # -1 => all remaining devices
     model_axis: int = 1                   # tensor-parallel degree
     seq_axis: int = 1                     # sequence/context-parallel degree
+    pipe_axis: int = 1                    # pipeline-parallel degree (stages)
     # Multi-host bootstrap (replaces ClusterSpec/Server, cifar10cnn.py:188-189)
     coordinator_address: Optional[str] = None
     num_processes: int = 1

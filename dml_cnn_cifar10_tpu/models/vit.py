@@ -139,6 +139,17 @@ def apply(params: Params, images: jax.Array, cfg: ModelConfig,
     """
     del train  # no dropout in the ladder config
     seq_parallel = mesh is not None and mesh.shape.get("seq", 1) > 1
+    pipe_parallel = mesh is not None and mesh.shape.get("pipe", 1) > 1
+    if seq_parallel and pipe_parallel:
+        raise ValueError(
+            "seq and pipe parallelism cannot both be active in one stack "
+            "(ring attention's shard_map cannot nest inside the pipeline's)")
+    if pipe_parallel and mesh.shape.get("model", 1) > 1:
+        raise ValueError(
+            "pipe and model (tensor) parallelism cannot combine: the "
+            "pipeline stage body is a shard_map, so tensor-parallel matmuls "
+            "inside it would need hand-written collectives "
+            "(parallel/pipeline.py). Use pipe x data, or model x data.")
     cdt = jnp.dtype(cfg.compute_dtype)
     p = jax.tree.map(lambda a: a.astype(cdt), params)
     x = images.astype(cdt)
@@ -167,11 +178,19 @@ def apply(params: Params, images: jax.Array, cfg: ModelConfig,
 
     attn_mesh = mesh if seq_parallel else None
 
-    def body(carry, bp):
-        return _block(carry, bp, cfg.vit_heads,
-                      cfg.use_pallas_attention, mesh=attn_mesh), None
+    if pipe_parallel:
+        from dml_cnn_cifar10_tpu.parallel import pipeline
+        x = pipeline.pipeline_blocks(
+            x, p["blocks"],
+            lambda h, bp: _block(h, bp, cfg.vit_heads,
+                                 cfg.use_pallas_attention),
+            mesh)
+    else:
+        def body(carry, bp):
+            return _block(carry, bp, cfg.vit_heads,
+                          cfg.use_pallas_attention, mesh=attn_mesh), None
 
-    x, _ = lax.scan(body, x, p["blocks"])
+        x, _ = lax.scan(body, x, p["blocks"])
     x = layer_norm(x, p["ln_f"])
     pooled = jnp.mean(x, axis=1) if cfg.pool == "mean" else x[:, 0]
     logits = L.dense(pooled, p["head"]["kernel"], p["head"]["bias"])

@@ -7,6 +7,9 @@ Axes:
   wide FCs elsewhere). Degree 1 for reference parity.
 - ``seq``   — sequence/context parallelism (ring attention) for long-context
   configs. Degree 1 for image models at CIFAR scale.
+- ``pipe``  — pipeline parallelism (GPipe microbatch schedule over the ViT
+  block stack, :mod:`~dml_cnn_cifar10_tpu.parallel.pipeline`). Degree 1
+  unless pipelining.
 
 Collectives ride ICI when the mesh axes are laid out over the physical
 torus; DCN is only used for the multi-host bootstrap
@@ -23,24 +26,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dml_cnn_cifar10_tpu.config import ParallelConfig
 
-AXES = ("data", "model", "seq")
+AXES = ("data", "model", "seq", "pipe")
 
 
 def build_mesh(cfg: Optional[ParallelConfig] = None,
                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a ``(data, model, seq)`` mesh over the given (default: all)
-    devices. ``data_axis=-1`` absorbs every device not claimed by
-    model/seq."""
+    """Build a ``(data, model, seq, pipe)`` mesh over the given (default:
+    all) devices. ``data_axis=-1`` absorbs every device not claimed by
+    model/seq/pipe."""
     cfg = cfg or ParallelConfig()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     model, seq = max(1, cfg.model_axis), max(1, cfg.seq_axis)
-    data = cfg.data_axis if cfg.data_axis > 0 else n // (model * seq)
-    if data * model * seq != n:
+    pipe = max(1, getattr(cfg, "pipe_axis", 1))
+    data = cfg.data_axis if cfg.data_axis > 0 else n // (model * seq * pipe)
+    if data * model * seq * pipe != n:
         raise ValueError(
-            f"mesh {data}x{model}x{seq} != {n} devices "
-            f"(data_axis={cfg.data_axis}, model_axis={model}, seq_axis={seq})")
-    arr = np.asarray(devices).reshape(data, model, seq)
+            f"mesh {data}x{model}x{seq}x{pipe} != {n} devices "
+            f"(data_axis={cfg.data_axis}, model_axis={model}, "
+            f"seq_axis={seq}, pipe_axis={pipe})")
+    arr = np.asarray(devices).reshape(data, model, seq, pipe)
     return Mesh(arr, AXES)
 
 

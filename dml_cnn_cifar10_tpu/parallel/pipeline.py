@@ -1,0 +1,114 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the ``pipe`` axis.
+
+No reference counterpart (SURVEY §2.3: pipeline parallelism absent), but a
+first-class axis of this framework's mesh. The design is the idiomatic TPU
+pipelining recipe: the layer stack's leading ``[depth]`` axis is sharded
+over ``pipe`` (each stage holds ``depth/P`` contiguous layers resident in
+HBM), activations flow stage→stage with neighbor ``lax.ppermute`` over ICI,
+and a ``lax.scan`` over ``M + P - 1`` ticks runs the classic GPipe
+schedule: microbatch ``m`` occupies stage ``s`` at tick ``t = s + m``.
+
+Everything is one compiled SPMD program — the schedule is data-flow inside
+``shard_map``, not host-side orchestration, so XLA overlaps the ppermute
+transfers with the per-stage compute (the same latency-hiding that makes
+ring attention cheap). Autodiff just works: the backward pass of the
+scan-of-ppermute is the reverse pipeline.
+
+Composition: ``pipe`` composes with ``data`` (batch stays sharded outside).
+Tensor/sequence axes inside a pipelined stack would need hand-written
+collectives in the stage body (shard_map does not nest); the step guards
+reject that combination rather than silently replicating.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_blocks(
+    x: jax.Array,
+    stacked_params: Any,
+    block_fn: Callable[[jax.Array, Any], jax.Array],
+    mesh: Mesh,
+    num_microbatches: Optional[int] = None,
+) -> jax.Array:
+    """Run a stacked layer sequence as a GPipe pipeline over ``pipe``.
+
+    x: global ``[B, S, D]`` activations (batch sharded over ``data``).
+    stacked_params: pytree whose leaves have a leading ``[depth]`` axis.
+    block_fn: ``(x_microbatch, one_layer_params) -> x_microbatch``.
+
+    Returns the global ``[B, S, D]`` output (same sharding as ``x``).
+    """
+    nstages = mesh.shape["pipe"]
+    if nstages == 1:
+        def seq_body(c, p):
+            return block_fn(c, p), None
+        return lax.scan(seq_body, x, stacked_params)[0]
+
+    depth = jax.tree.leaves(stacked_params)[0].shape[0]
+    if depth % nstages:
+        raise ValueError(
+            f"depth {depth} not divisible by pipe axis {nstages}")
+    m = num_microbatches or nstages
+    ndata = mesh.shape["data"]
+    if x.shape[0] % (ndata * m):
+        raise ValueError(
+            f"global batch {x.shape[0]} not divisible by data axis * "
+            f"microbatches = {ndata}*{m}")
+
+    def local_fn(xl: jax.Array, pl: Any) -> jax.Array:
+        # xl: [B_local, S, D] (this data-shard's batch, replicated over
+        # pipe); pl: leaves [depth/P, ...] (this stage's layers).
+        stage_idx = lax.axis_index("pipe")
+        bl, s, d = xl.shape
+        mb = xl.reshape(m, bl // m, s, d)
+
+        def stage(h):
+            return lax.scan(lambda c, p: (block_fn(c, p), None), h, pl)[0]
+
+        perm = [(i, (i + 1) % nstages) for i in range(nstages)]
+        zeros = jnp.zeros_like(mb[0])
+
+        def tick(carry, t):
+            inflight, out_buf = carry
+            # Stage 0 injects microbatch t (clamped; ticks >= M push
+            # garbage that no valid slot ever reads). Other stages consume
+            # what the previous stage sent last tick.
+            feed = lax.dynamic_index_in_dim(
+                mb, jnp.clip(t, 0, m - 1), keepdims=False)
+            h = jnp.where(stage_idx == 0, feed, inflight)
+            h = stage(h)
+            # The last stage owns microbatch t-(P-1) at tick t. Early ticks
+            # write garbage to slot 0, overwritten when the real microbatch
+            # 0 arrives at t = P-1 (writes happen in slot order).
+            write = jnp.clip(t - (nstages - 1), 0, m - 1)
+            out_buf = lax.dynamic_update_index_in_dim(
+                out_buf, h, write, axis=0)
+            inflight = lax.ppermute(h, "pipe", perm)
+            return (inflight, out_buf), None
+
+        (_, out_buf), _ = lax.scan(
+            tick, (zeros, jnp.zeros_like(mb)),
+            jnp.arange(m + nstages - 1))
+        out = out_buf.reshape(bl, s, d)
+        # Only the last stage holds real outputs; broadcast to every stage
+        # so downstream (head/loss) math is replicated over pipe.
+        out = jnp.where(stage_idx == nstages - 1, out, 0)
+        return lax.psum(out, "pipe")
+
+    spec_x = P("data", None, None)
+    spec_p = jax.tree.map(lambda _: P("pipe"), stacked_params)
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec_x, spec_p),
+        out_specs=spec_x,
+        check_vma=False,
+    )
+    return fn(x, stacked_params)

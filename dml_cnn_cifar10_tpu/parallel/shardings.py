@@ -76,6 +76,16 @@ def _vit_rule(path: str, ndim: int) -> P:
     return P()
 
 
+def _vit_pipe_rule(path: str, ndim: int) -> P:
+    # Pipelined stack: each stage owns depth/P contiguous layers — the
+    # stacked [depth, ...] leaves shard their LEADING axis over ``pipe``.
+    # Tensor-parallel specs are dropped (shard_map stages would need
+    # hand-written collectives; parallel/pipeline.py docstring).
+    if path.startswith("blocks/"):
+        return P("pipe")
+    return P()
+
+
 _RULES = {
     "cnn": _cnn_rule,
     "resnet18": _replicated,
@@ -83,8 +93,18 @@ _RULES = {
     "vit_tiny": _vit_rule,
 }
 
+_PIPE_RULES = {
+    "vit_tiny": _vit_pipe_rule,
+}
 
-def rule_for(model_name: str) -> Rule:
+
+def rule_for(model_name: str, pipe: bool = False) -> Rule:
+    if pipe:
+        if model_name not in _PIPE_RULES:
+            raise ValueError(
+                f"pipeline parallelism is not supported for {model_name!r} "
+                f"(supported: {sorted(_PIPE_RULES)})")
+        return _PIPE_RULES[model_name]
     return _RULES.get(model_name, _replicated)
 
 
@@ -100,32 +120,36 @@ def _path_str(key_path) -> str:
     return "/".join(parts)
 
 
-def param_pspecs(model_name: str, params: Any) -> Any:
+def param_pspecs(model_name: str, params: Any, pipe: bool = False) -> Any:
     """Pytree of ``PartitionSpec`` matching ``params`` (arrays or
     ShapeDtypeStructs)."""
-    rule = rule_for(model_name)
+    rule = rule_for(model_name, pipe=pipe)
     return jax.tree_util.tree_map_with_path(
         lambda kp, leaf: rule(_path_str(kp), leaf.ndim), params)
 
 
-def state_pspecs(model_name: str, state: Any) -> Any:
+def state_pspecs(model_name: str, state: Any, pipe: bool = False) -> Any:
     """Specs for a full ``TrainState``: params by model rule, optimizer
     momentum mirrors the params (same tree paths), scalar step + BN state
     replicated."""
-    opt = {k: (param_pspecs(model_name, v) if k == "momentum"
+    opt = {k: (param_pspecs(model_name, v, pipe=pipe) if k == "momentum"
                else jax.tree.map(lambda _: P(), v))
            for k, v in state.opt.items()}
     return type(state)(
-        params=param_pspecs(model_name, state.params),
+        params=param_pspecs(model_name, state.params, pipe=pipe),
         opt=opt,
         model_state=jax.tree.map(lambda _: P(), state.model_state),
     )
 
 
 def state_shardings(mesh: Mesh, model_name: str, state: Any) -> Any:
-    """``state_pspecs`` bound to a mesh → pytree of ``NamedSharding``."""
+    """``state_pspecs`` bound to a mesh → pytree of ``NamedSharding``.
+
+    A mesh with a nontrivial ``pipe`` axis selects the pipeline layout
+    (stage-sharded layer stacks) instead of the tensor-parallel one."""
+    pipe = mesh.shape.get("pipe", 1) > 1
     return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
-                        state_pspecs(model_name, state),
+                        state_pspecs(model_name, state, pipe=pipe),
                         is_leaf=lambda x: isinstance(x, P))
 
 

@@ -142,10 +142,11 @@ def make_train_step(
     """
 
     if explicit_collectives and mesh is not None:
-        if mesh.shape["model"] * mesh.shape["seq"] > 1:
+        if (mesh.shape["model"] * mesh.shape["seq"]
+                * mesh.shape.get("pipe", 1)) > 1:
             raise ValueError(
                 "explicit_collectives is the pedagogical dp-only path; "
-                "tensor/sequence axes need the GSPMD (default) step")
+                "tensor/sequence/pipeline axes need the GSPMD (default) step")
         return _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh)
 
     loss_fn = _forward_loss(model_def, model_cfg, mesh=mesh)

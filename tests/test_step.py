@@ -33,9 +33,9 @@ def test_eight_devices_available():
 
 def test_mesh_shapes():
     mesh = mesh_lib.build_mesh(ParallelConfig())
-    assert mesh.shape == {"data": 8, "model": 1, "seq": 1}
+    assert mesh.shape == {"data": 8, "model": 1, "seq": 1, "pipe": 1}
     mesh2 = mesh_lib.build_mesh(ParallelConfig(model_axis=2))
-    assert mesh2.shape == {"data": 4, "model": 2, "seq": 1}
+    assert mesh2.shape == {"data": 4, "model": 2, "seq": 1, "pipe": 1}
     with pytest.raises(ValueError):
         mesh_lib.build_mesh(ParallelConfig(data_axis=3, model_axis=3))
 
