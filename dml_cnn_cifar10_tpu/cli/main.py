@@ -54,7 +54,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Checkpoint/log directory")
     # --- framework flags ---
     p.add_argument("--model", type=str, default="cnn",
-                   choices=["cnn", "resnet18", "resnet50", "vit_tiny"])
+                   choices=["cnn", "resnet18", "resnet50", "vit_tiny",
+                            "vit_moe"])
     p.add_argument("--dataset", type=str, default="cifar10",
                    choices=["cifar10", "cifar100", "synthetic"])
     p.add_argument("--batch_size", type=int, default=128)
@@ -74,6 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tensor-parallel mesh degree")
     p.add_argument("--seq_axis", type=int, default=1,
                    help="sequence-parallel mesh degree")
+    p.add_argument("--pipe_axis", type=int, default=1,
+                   help="pipeline-parallel mesh degree (GPipe stages)")
+    p.add_argument("--moe_experts", type=int, default=0,
+                   help="experts per MoE block (vit_moe); sharded over "
+                        "the model axis (expert parallelism)")
     p.add_argument("--explicit_collectives", type="bool", default=False,
                    help="use the shard_map+psum step instead of jit "
                         "auto-partitioning")
@@ -108,6 +114,13 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.optim.learning_rate = args.learning_rate
     cfg.parallel.model_axis = args.model_axis
     cfg.parallel.seq_axis = args.seq_axis
+    cfg.parallel.pipe_axis = args.pipe_axis
+    if args.moe_experts and args.model != "vit_moe":
+        raise SystemExit(
+            f"--moe_experts requires --model vit_moe (got {args.model})")
+    cfg.model.moe_experts = args.moe_experts
+    if args.model == "vit_moe" and args.moe_experts == 0:
+        cfg.model.moe_experts = 8
     cfg.parallel.explicit_collectives = args.explicit_collectives
     return cfg
 

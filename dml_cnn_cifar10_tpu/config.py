@@ -95,6 +95,12 @@ class ModelConfig:
     # where the token count must divide the ``seq`` mesh axis and a lone
     # cls token would break the even sharding.
     pool: str = "cls"                     # cls | mean
+    # Mixture-of-Experts (model name "vit_moe"): every block's MLP becomes
+    # a top-1-routed expert bank (ops/moe.py), experts sharded over the
+    # ``model`` mesh axis (expert parallelism).
+    moe_experts: int = 0                  # 0 = dense MLP
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01            # load-balance loss weight
 
 
 @dataclasses.dataclass

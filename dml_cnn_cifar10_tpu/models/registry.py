@@ -15,6 +15,9 @@ class ModelDef(NamedTuple):
     # apply accepts a ``mesh=`` kwarg and uses it for sequence-parallel
     # (ring-attention) routing when the mesh's ``seq`` axis is >1.
     wants_mesh: bool = False
+    # apply returns ``(logits, aux_loss)``; the step adds
+    # ``model_cfg.moe_aux_coef * aux_loss`` to the training loss.
+    has_aux: bool = False
 
 
 def _cnn() -> ModelDef:
@@ -36,8 +39,29 @@ def _resnet(depth: int) -> Callable[[], ModelDef]:
 
 def _vit() -> ModelDef:
     from dml_cnn_cifar10_tpu.models import vit
-    return ModelDef(vit.init_params, vit.apply, lambda p: {}, False,
-                    wants_mesh=True)
+
+    def init(key, model_cfg, data_cfg):
+        if model_cfg.moe_experts:
+            raise ValueError(
+                "vit_tiny is the dense ViT; moe_experts > 0 needs model "
+                "name 'vit_moe' (its aux loss and expert sharding rules)")
+        return vit.init_params(key, model_cfg, data_cfg)
+
+    return ModelDef(init, vit.apply, lambda p: {}, False, wants_mesh=True)
+
+
+def _vit_moe() -> ModelDef:
+    from dml_cnn_cifar10_tpu.models import vit
+
+    def init(key, model_cfg, data_cfg):
+        if model_cfg.moe_experts < 2:
+            raise ValueError(
+                "vit_moe needs moe_experts >= 2 "
+                f"(got {model_cfg.moe_experts}); set ModelConfig.moe_experts")
+        return vit.init_params(key, model_cfg, data_cfg)
+
+    return ModelDef(init, vit.apply_with_aux, lambda p: {}, False,
+                    wants_mesh=True, has_aux=True)
 
 
 MODELS = {
@@ -45,6 +69,7 @@ MODELS = {
     "resnet18": _resnet(18),
     "resnet50": _resnet(50),
     "vit_tiny": _vit,
+    "vit_moe": _vit_moe,
 }
 
 

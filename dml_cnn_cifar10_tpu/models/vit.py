@@ -31,6 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig
 from dml_cnn_cifar10_tpu.ops import attention as attn
 from dml_cnn_cifar10_tpu.ops import layers as L
+from dml_cnn_cifar10_tpu.ops import moe as moe_ops
 
 Params = Dict[str, Any]
 MLP_RATIO = 4
@@ -46,10 +47,10 @@ def layer_norm(x: jax.Array, p, eps: float = 1e-6) -> jax.Array:
     return (x - mean) * lax.rsqrt(var + eps) * p["scale"] + p["bias"]
 
 
-def _init_block(key, dim: int, dtype) -> Params:
+def _init_block(key, dim: int, dtype, moe_experts: int = 0) -> Params:
     ks = jax.random.split(key, 4)
     hidden = dim * MLP_RATIO
-    return {
+    block = {
         "ln1": _ln_init(dim, dtype),
         # fused qkv: one [dim, 3*dim] matmul keeps the MXU busy vs 3 skinny
         # matmuls. Output features are HEADS-MAJOR ([head][q|k|v][hd]) so
@@ -61,11 +62,18 @@ def _init_block(key, dim: int, dtype) -> Params:
         "proj": {"kernel": L.he_normal_init(ks[1], (dim, dim), dtype),
                  "bias": jnp.zeros((dim,), dtype)},
         "ln2": _ln_init(dim, dtype),
-        "mlp1": {"kernel": L.he_normal_init(ks[2], (dim, hidden), dtype),
-                 "bias": jnp.zeros((hidden,), dtype)},
-        "mlp2": {"kernel": L.he_normal_init(ks[3], (hidden, dim), dtype),
-                 "bias": jnp.zeros((dim,), dtype)},
     }
+    if moe_experts:
+        block["moe"] = moe_ops.init_moe_params(ks[2], dim, hidden,
+                                               moe_experts, dtype)
+    else:
+        block["mlp1"] = {"kernel": L.he_normal_init(ks[2], (dim, hidden),
+                                                    dtype),
+                         "bias": jnp.zeros((hidden,), dtype)}
+        block["mlp2"] = {"kernel": L.he_normal_init(ks[3], (hidden, dim),
+                                                    dtype),
+                         "bias": jnp.zeros((dim,), dtype)}
+    return block
 
 
 def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
@@ -83,7 +91,8 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
     ks = jax.random.split(key, depth + 4)
     # One stacked pytree for all blocks: leaves get a leading [depth] axis,
     # consumed by lax.scan in apply().
-    blocks = [_init_block(ks[i], dim, dtype) for i in range(depth)]
+    blocks = [_init_block(ks[i], dim, dtype, moe_experts=cfg.moe_experts)
+              for i in range(depth)]
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
 
     params = {
@@ -107,7 +116,8 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
 
 
 def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
-           mesh=None) -> jax.Array:
+           capacity_factor: float, mesh=None):
+    """One transformer block → ``(x, aux_loss)`` (aux 0.0 for dense MLP)."""
     b, s, dim = x.shape
     h = layer_norm(x, p["ln1"])
     qkv = L.dense(h, p["qkv"]["kernel"], p["qkv"]["bias"])
@@ -124,16 +134,27 @@ def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
     x = x + L.dense(o.reshape(b, s, dim), p["proj"]["kernel"],
                     p["proj"]["bias"])
     h = layer_norm(x, p["ln2"])
+    if "moe" in p:
+        y, aux = moe_ops.moe_mlp(h, p["moe"], capacity_factor)
+        return x + y, aux
     h = jax.nn.gelu(L.dense(h, p["mlp1"]["kernel"], p["mlp1"]["bias"]))
-    return x + L.dense(h, p["mlp2"]["kernel"], p["mlp2"]["bias"])
+    return x + L.dense(h, p["mlp2"]["kernel"], p["mlp2"]["bias"]), \
+        jnp.zeros((), jnp.float32)
 
 
 def apply(params: Params, images: jax.Array, cfg: ModelConfig,
           train: bool = True, mesh=None) -> jax.Array:
-    """NHWC images → logits [B, num_classes].
+    """NHWC images → logits [B, num_classes] (dense-MLP models)."""
+    return apply_with_aux(params, images, cfg, train=train, mesh=mesh)[0]
 
-    ``mesh`` with a ``seq`` axis >1 switches attention to the ring
-    (sequence-parallel) kernel and keeps token activations sharded
+
+def apply_with_aux(params: Params, images: jax.Array, cfg: ModelConfig,
+                   train: bool = True, mesh=None):
+    """NHWC images → ``(logits [B, num_classes], aux_loss scalar)``.
+
+    ``aux_loss`` is the summed MoE load-balance loss over blocks (0 for
+    dense MLPs). ``mesh`` with a ``seq`` axis >1 switches attention to the
+    ring (sequence-parallel) kernel and keeps token activations sharded
     [data, seq] between blocks; requires ``pool='mean'`` (no cls token) and
     a token count divisible by the ``seq`` axis.
     """
@@ -150,6 +171,10 @@ def apply(params: Params, images: jax.Array, cfg: ModelConfig,
             "pipeline stage body is a shard_map, so tensor-parallel matmuls "
             "inside it would need hand-written collectives "
             "(parallel/pipeline.py). Use pipe x data, or model x data.")
+    if pipe_parallel and cfg.moe_experts:
+        raise ValueError(
+            "pipe parallelism does not compose with MoE (expert dispatch "
+            "inside a pipeline stage would need hand-written all-to-all)")
     cdt = jnp.dtype(cfg.compute_dtype)
     p = jax.tree.map(lambda a: a.astype(cdt), params)
     x = images.astype(cdt)
@@ -178,26 +203,31 @@ def apply(params: Params, images: jax.Array, cfg: ModelConfig,
 
     attn_mesh = mesh if seq_parallel else None
 
+    aux = jnp.zeros((), jnp.float32)
     if pipe_parallel:
         from dml_cnn_cifar10_tpu.parallel import pipeline
         x = pipeline.pipeline_blocks(
             x, p["blocks"],
             lambda h, bp: _block(h, bp, cfg.vit_heads,
-                                 cfg.use_pallas_attention),
+                                 cfg.use_pallas_attention,
+                                 cfg.moe_capacity_factor)[0],
             mesh)
     else:
         def body(carry, bp):
-            return _block(carry, bp, cfg.vit_heads,
-                          cfg.use_pallas_attention, mesh=attn_mesh), None
+            h, aux_sum = carry
+            h, block_aux = _block(h, bp, cfg.vit_heads,
+                                  cfg.use_pallas_attention,
+                                  cfg.moe_capacity_factor, mesh=attn_mesh)
+            return (h, aux_sum + block_aux), None
 
-        x, _ = lax.scan(body, x, p["blocks"])
+        (x, aux), _ = lax.scan(body, (x, aux), p["blocks"])
     x = layer_norm(x, p["ln_f"])
     pooled = jnp.mean(x, axis=1) if cfg.pool == "mean" else x[:, 0]
     logits = L.dense(pooled, p["head"]["kernel"], p["head"]["bias"])
     if cfg.logit_relu:
         # Shared faithful-mode switch (cifar10cnn.py:145); fixed mode off.
         logits = jax.nn.relu(logits)
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32), aux
 
 
 # Shared implementation: models.param_count

@@ -1,0 +1,95 @@
+"""Mixture-of-Experts MLP — expert parallelism over the ``model`` mesh axis.
+
+No reference counterpart (SURVEY §2.3: expert parallelism absent), built
+TPU-first as the framework's ``ep`` capability:
+
+- **Switch-style top-1 routing** with a **static capacity**: every shape is
+  known at trace time (tokens = B*S, capacity = ceil(T/E · factor)), so the
+  whole layer is dense einsums XLA can tile onto the MXU — no dynamic
+  gather/scatter, no data-dependent shapes (the TPU-idiomatic formulation
+  from the Switch/GShard line of work).
+- **Dispatch/combine as one-hot einsum contractions**: routing becomes
+  ``[T,E,C]`` tensors contracted against tokens. With the expert-major
+  weights (``w1 [E,D,H]``, ``w2 [E,H,D]``) sharded over ``model`` on the
+  leading expert dim (parallel/shardings.py), GSPMD compiles the dispatch
+  contraction into the all-to-all over ICI — expert parallelism falls out
+  of the sharding annotation, exactly like tp/sp elsewhere in this repo.
+- **Load-balancing aux loss** (Switch eq. 4): E · Σ_e f_e·p_e, where f_e is
+  the routed-token fraction and p_e the mean router probability. Scaled by
+  the caller (``ModelConfig.moe_aux_coef``).
+
+Tokens that overflow an expert's capacity are dropped (combine weight 0);
+with the residual connection around the layer they pass through unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def init_moe_params(key: jax.Array, dim: int, hidden: int, num_experts: int,
+                    dtype=jnp.float32) -> Params:
+    """Expert-major MoE MLP params: gate [D,E], w1 [E,D,H], w2 [E,H,D]."""
+    kg, k1, k2 = jax.random.split(key, 3)
+    scale1 = math.sqrt(2.0 / dim)
+    scale2 = math.sqrt(2.0 / hidden)
+    return {
+        "gate": {"kernel": 0.02 * jax.random.normal(kg, (dim, num_experts),
+                                                    dtype)},
+        "w1": scale1 * jax.random.normal(k1, (num_experts, dim, hidden),
+                                         dtype),
+        "b1": jnp.zeros((num_experts, hidden), dtype),
+        "w2": scale2 * jax.random.normal(k2, (num_experts, hidden, dim),
+                                         dtype),
+        "b2": jnp.zeros((num_experts, dim), dtype),
+    }
+
+
+def moe_mlp(x: jax.Array, params: Params, capacity_factor: float
+            ) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 MoE MLP: ``[B,S,D] -> ([B,S,D], aux_loss scalar)``.
+
+    All shapes static; the expert dim of every einsum below is the sharded
+    (``model``) axis under expert parallelism.
+    """
+    b, s, d = x.shape
+    e = params["w1"].shape[0]
+    t = b * s
+    capacity = max(1, math.ceil(t / e * capacity_factor))
+
+    tokens = x.reshape(t, d)
+    gate_logits = tokens.astype(jnp.float32) @ \
+        params["gate"]["kernel"].astype(jnp.float32)          # [T,E]
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                   # [T]
+    expert_prob = jnp.max(probs, axis=-1)                     # [T]
+    expert_1h = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T,E]
+
+    # Position of each token within its expert's queue (first-come order);
+    # tokens beyond capacity are dropped.
+    position = jnp.cumsum(expert_1h, axis=0) * expert_1h - 1.0    # [T,E]
+    keep = (position >= 0) & (position < capacity)
+    pos_1h = jax.nn.one_hot(position.astype(jnp.int32), capacity,
+                            dtype=jnp.float32) * keep[..., None]
+    dispatch = pos_1h                                          # [T,E,C]
+    combine = dispatch * expert_prob[:, None, None]            # [T,E,C]
+
+    cdt = x.dtype
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(cdt), tokens)  # [E,C,D]
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xe, params["w1"])
+                    + params["b1"][:, None, :])
+    ye = jnp.einsum("ech,ehd->ecd", h, params["w2"]) \
+        + params["b2"][:, None, :]                             # [E,C,D]
+    y = jnp.einsum("tec,ecd->td", combine.astype(cdt), ye)     # [T,D]
+
+    # Switch load-balance loss: E * sum_e f_e * p_e (scalar, f32).
+    f = jnp.mean(expert_1h, axis=0)                            # [E]
+    p = jnp.mean(probs, axis=0)                                # [E]
+    aux = e * jnp.sum(f * p)
+    return y.reshape(b, s, d), aux

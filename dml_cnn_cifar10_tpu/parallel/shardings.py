@@ -76,6 +76,27 @@ def _vit_rule(path: str, ndim: int) -> P:
     return P()
 
 
+def _expert(ndim: int, offset: int) -> P:
+    """Shard the expert dim (``offset`` positions from the trailing end:
+    w [.., E, D, H] → 3, b [.., E, H] → 2) over ``model``."""
+    spec = [None] * ndim
+    spec[ndim - offset] = "model"
+    return P(*spec)
+
+
+def _vit_moe_rule(path: str, ndim: int) -> P:
+    # Expert parallelism: expert-major MoE weights shard their E dim over
+    # ``model`` (ops/moe.py); the router gate stays replicated. Attention
+    # follows the dense ViT rules.
+    if path.endswith(("moe/w1", "moe/w2")):
+        return _expert(ndim, 3)
+    if path.endswith(("moe/b1", "moe/b2")):
+        return _expert(ndim, 2)
+    if "moe/gate" in path:
+        return P()
+    return _vit_rule(path, ndim)
+
+
 def _vit_pipe_rule(path: str, ndim: int) -> P:
     # Pipelined stack: each stage owns depth/P contiguous layers — the
     # stacked [depth, ...] leaves shard their LEADING axis over ``pipe``.
@@ -91,6 +112,7 @@ _RULES = {
     "resnet18": _replicated,
     "resnet50": _replicated,
     "vit_tiny": _vit_rule,
+    "vit_moe": _vit_moe_rule,
 }
 
 _PIPE_RULES = {

@@ -113,12 +113,19 @@ def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,
             kwargs = {"axis_name": axis_name} if axis_name else {}
             logits, new_state = model_def.apply(
                 params, model_state, images, model_cfg, train=True, **kwargs)
+            loss = loss_lib.softmax_cross_entropy(logits, labels)
+        elif model_def.has_aux:
+            logits, aux = model_def.apply(params, images, model_cfg,
+                                          train=True, **mesh_kwargs)
+            new_state = model_state
+            loss = loss_lib.softmax_cross_entropy(logits, labels) \
+                + model_cfg.moe_aux_coef * aux
         else:
             logits = model_def.apply(params, images, model_cfg, train=True,
                                      **mesh_kwargs)
             new_state = model_state
-        return loss_lib.softmax_cross_entropy(logits, labels), (logits,
-                                                                new_state)
+            loss = loss_lib.softmax_cross_entropy(logits, labels)
+        return loss, (logits, new_state)
 
     return loss_fn
 
@@ -228,6 +235,9 @@ def make_eval_step(
         if model_def.has_state:
             logits, _ = model_def.apply(state.params, state.model_state,
                                         images, model_cfg, train=False)
+        elif model_def.has_aux:
+            logits, _ = model_def.apply(state.params, images, model_cfg,
+                                        train=False, **mesh_kwargs)
         else:
             logits = model_def.apply(state.params, images, model_cfg,
                                      train=False, **mesh_kwargs)

@@ -1,0 +1,155 @@
+"""Expert parallelism: Switch-style top-1 MoE (ops/moe.py) + vit_moe.
+
+Op-level: routing/capacity/aux-loss semantics against a hand-computed
+dense-per-expert reference. Step-level: ep (experts over ``model``) matches
+the dp-only run; expert shards are real.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                        ParallelConfig)
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.ops import moe
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import shardings
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+DATA = DataConfig(normalize="scale")
+VIT_MOE = ModelConfig(name="vit_moe", pool="mean", logit_relu=False,
+                      vit_depth=2, vit_dim=64, vit_heads=2, patch_size=8,
+                      moe_experts=4)
+
+
+def _moe_params(dim=8, hidden=16, e=4):
+    return moe.init_moe_params(jax.random.key(0), dim, hidden, e)
+
+
+def _dense_expert(params, e_idx, x):
+    h = jax.nn.gelu(x @ params["w1"][e_idx] + params["b1"][e_idx])
+    return h @ params["w2"][e_idx] + params["b2"][e_idx]
+
+
+def test_moe_routes_to_argmax_expert():
+    """Ample capacity: each token's output == its argmax expert's MLP
+    scaled by the router prob."""
+    params = _moe_params()
+    x = jax.random.normal(jax.random.key(1), (2, 3, 8))
+    y, aux = moe.moe_mlp(x, params, capacity_factor=4.0)  # capacity >= T
+    tokens = x.reshape(-1, 8)
+    probs = jax.nn.softmax(
+        tokens @ params["gate"]["kernel"], axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    expect = jnp.stack([
+        probs[t, idx[t]] * _dense_expert(params, idx[t], tokens[t])
+        for t in range(tokens.shape[0])])
+    np.testing.assert_allclose(np.asarray(y.reshape(-1, 8)),
+                               np.asarray(expect), rtol=1e-5, atol=1e-6)
+    assert float(aux) > 0
+
+
+def test_moe_capacity_drops_overflow():
+    """Capacity 1 with all tokens routed to one expert: only the first
+    token gets expert output, the rest emit exactly zero."""
+    params = _moe_params()
+    # Huge gate bias towards expert 0 via inputs aligned to gate column 0.
+    g = np.zeros((8, 4), np.float32)
+    g[:, 0] = 10.0
+    params = dict(params)
+    params["gate"] = {"kernel": jnp.asarray(g)}
+    x = jnp.ones((1, 4, 8))
+    y, _ = moe.moe_mlp(x, params, capacity_factor=0.25)  # capacity = 1
+    out = np.asarray(y.reshape(4, 8))
+    assert np.abs(out[0]).sum() > 0
+    np.testing.assert_array_equal(out[1:], 0.0)
+
+
+def test_moe_aux_loss_balanced_vs_collapsed():
+    """Aux loss is minimal (≈1) under uniform routing, larger when the
+    router collapses onto one expert."""
+    params = _moe_params()
+    t, e = 64, 4
+    # positive inputs so the +10 gate column dominates every token's logits
+    x = 0.5 + 0.1 * jnp.abs(jax.random.normal(jax.random.key(2), (1, t, 8)))
+    _, aux_learned = moe.moe_mlp(x, params, 1.25)
+    collapsed = dict(params)
+    g = np.zeros((8, e), np.float32)
+    g[:, 0] = 10.0
+    collapsed["gate"] = {"kernel": jnp.asarray(g)}
+    _, aux_collapsed = moe.moe_mlp(x, collapsed, 1.25)
+    assert float(aux_collapsed) > float(aux_learned)
+    assert float(aux_collapsed) > 3.0  # ~E for full collapse
+
+
+def _run(model_cfg, mesh, images, labels, nsteps=2):
+    model_def = get_model(model_cfg.name)
+    optim = OptimConfig(learning_rate=0.01)
+    sh = step_lib.train_state_shardings(mesh, model_def, model_cfg, DATA,
+                                        optim)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, model_cfg, DATA, optim, mesh,
+        state_sharding=sh)
+    train = step_lib.make_train_step(model_def, model_cfg, optim, mesh,
+                                     state_sharding=sh)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    losses = []
+    for _ in range(nsteps):
+        state, metrics = train(state, im, lb)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    return state, losses
+
+
+def _mesh(data, model=1):
+    return mesh_lib.build_mesh(
+        ParallelConfig(data_axis=data, model_axis=model))
+
+
+def test_moe_rules_shard_experts():
+    model_def = get_model("vit_moe")
+    params = jax.eval_shape(
+        lambda k: model_def.init(k, VIT_MOE, DATA), jax.random.key(0))
+    specs = shardings.param_pspecs("vit_moe", params)
+    # stacked [depth, E, D, H] -> expert dim over model
+    assert specs["blocks"]["moe"]["w1"] == P(None, "model", None, None)
+    assert specs["blocks"]["moe"]["w2"] == P(None, "model", None, None)
+    assert specs["blocks"]["moe"]["b1"] == P(None, "model", None)
+    assert specs["blocks"]["moe"]["gate"]["kernel"] == P()
+    assert specs["blocks"]["qkv"]["kernel"] == P(None, None, "model")
+
+
+def test_ep_train_matches_dp(rng):
+    """Experts sharded over model axis == pure layout change."""
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    _, loss_dp = _run(VIT_MOE, _mesh(8), images, labels)
+    st_ep, loss_ep = _run(VIT_MOE, _mesh(2, 4), images, labels)
+    np.testing.assert_allclose(loss_dp, loss_ep, rtol=2e-5, atol=2e-6)
+    w1 = st_ep.params["blocks"]["moe"]["w1"]
+    assert w1.shape[1] == 4  # 4 experts
+    assert w1.addressable_shards[0].data.shape[1] == 1  # 1 expert per shard
+    assert shardings.assert_some_leaf_sharded(st_ep.params)
+
+
+def test_vit_moe_requires_experts():
+    with pytest.raises(ValueError, match="moe_experts"):
+        get_model("vit_moe").init(
+            jax.random.key(0),
+            ModelConfig(name="vit_moe", moe_experts=0), DATA)
+
+
+def test_moe_aux_loss_reaches_training_loss(rng):
+    """The train loss must include the aux term: zeroing moe_aux_coef
+    changes the loss by exactly coef * aux > 0."""
+    import dataclasses
+    images = rng.normal(0.5, 0.25, (8, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 8).astype(np.int32)
+    mesh = _mesh(8)
+    cfg_on = VIT_MOE
+    cfg_off = dataclasses.replace(VIT_MOE, moe_aux_coef=0.0)
+    _, loss_on = _run(cfg_on, mesh, images, labels, nsteps=1)
+    _, loss_off = _run(cfg_off, mesh, images, labels, nsteps=1)
+    assert loss_on[0] > loss_off[0]
