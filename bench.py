@@ -22,8 +22,6 @@ from __future__ import annotations
 import json
 import time
 
-import numpy as np
-
 NORTH_STAR_IMAGES_PER_SEC_PER_CHIP = 20000 * 128 / 120.0 / 8.0  # 2666.7
 
 
@@ -42,27 +40,51 @@ def main() -> None:
     cfg.batch_size = 128
     cfg.log_dir = "/tmp/bench_logs_unused"
     cfg.checkpoint_every = 10**9             # no checkpoint I/O in the loop
+    # The raw-chunk path reads the base iterator's in-memory permutation
+    # directly; the native loader's C++ shuffle pool would be dead weight.
+    cfg.data.use_native_loader = False
+
+    from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
 
     trainer = Trainer(cfg)
     state = trainer.init_or_restore()
     n_chips = len(jax.devices())
 
-    train_it = pipe.input_pipeline(cfg.data, cfg.batch_size, train=True)
-    prefetch = pipe.PrefetchIterator(train_it, depth=cfg.data.prefetch,
-                                     place=trainer._placed)
+    # Chunked stepping (lax.scan over K steps per dispatch) + device-side
+    # decode (host ships raw uint8; cast/crop fused into the step): the
+    # reference CNN is ~1 ms of MXU work per step, so per-step dispatch and
+    # host float32 decode dominate otherwise (ops/preprocess.py).
+    chunk_k = 20
+    chunk = step_lib.make_train_chunk(
+        trainer.model_def, cfg.model, cfg.optim, trainer.mesh,
+        state_sharding=trainer.state_sharding, data_cfg=cfg.data)
 
-    # Warmup: first call compiles (~20-40s), a few more to fill the pipeline.
-    for _ in range(8):
-        state, metrics = trainer.train_step(state, *next(prefetch))
+    train_it = pipe.input_pipeline(cfg.data, cfg.batch_size, train=True)
+
+    def next_chunk():
+        b = train_it.next_raw_chunk(chunk_k)
+        # Shard batch dim over `data` at placement time so jit's
+        # in_shardings don't force a device-side reshard on the timed path.
+        return mesh_lib.shard_batch(trainer.mesh, b.images, b.labels,
+                                    leading_dims=1)
+
+    prefetch = pipe.PrefetchIterator(
+        iter(next_chunk, None), depth=cfg.data.prefetch, place=None)
+
+    # Warmup: first call compiles (~20-40s), more to fill the pipeline.
+    for _ in range(3):
+        state, metrics = chunk(state, *next(prefetch))
     jax.block_until_ready(metrics["loss"])
 
     # Timed steady state.
-    steps = 300
+    chunks = 50
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = trainer.train_step(state, *next(prefetch))
+    for _ in range(chunks):
+        state, metrics = chunk(state, *next(prefetch))
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
+    steps = chunks * chunk_k
     prefetch.close()
 
     images_per_sec = steps * cfg.batch_size / dt

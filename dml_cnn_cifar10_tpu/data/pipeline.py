@@ -134,6 +134,16 @@ class ShuffleBatchIterator:
         idx = self._next_indices(self.batch_size)
         return Batch(self._finish(self.images[idx]), self.labels[idx])
 
+    def next_raw_chunk(self, k: int) -> Batch:
+        """``k`` stacked shuffled batches of RAW uint8 full-size images
+        ([k, B, H, W, C] — no crop/cast/normalize) for device-side
+        preprocessing (``ops/preprocess.py``). One fancy-index gather per
+        chunk: the host's only per-chunk work is a byte memcpy."""
+        idx = self._next_indices(self.batch_size * k)
+        ims = self.images[idx].reshape(
+            k, self.batch_size, *self.images.shape[1:])
+        return Batch(ims, self.labels[idx].reshape(k, self.batch_size))
+
     def full_sweep(self) -> Iterator[Batch]:
         """Deterministic single pass over the local shard (variable-size
         final batch). For multi-process collective eval use

@@ -1,0 +1,59 @@
+"""Device-side input preprocessing (cast / crop / normalize) as XLA ops.
+
+The reference does all decode work on host CPU threads
+(``cifar10cnn.py:54-70``: reader → transpose → cast → crop inside the
+queue-runner graph). On TPU the roles invert: the tiny reference CNN is
+~1 ms of MXU work per step, so a host that also casts to float32 and crops
+cannot keep up (measured: host-decoded pipeline tops out ~2 orders of
+magnitude below device compute). The TPU-native split is **host does IO
+and shuffling of raw uint8 bytes; the device does the math** — uint8 H2D
+is 4x less PCIe/ICI traffic than float32, and the cast/crop/normalize fuse
+into the training step for free.
+
+Used by the chunked training path (``parallel/step.py:make_train_chunk``
+with ``data_cfg=``); augmented (random crop/flip) training keeps the host
+path, deterministic center-crop pipelines (faithful parity + bench) take
+this one.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dml_cnn_cifar10_tpu.config import DataConfig
+
+
+def device_preprocess(images_u8: jax.Array, cfg: DataConfig) -> jax.Array:
+    """uint8 ``[..., H, W, C]`` full-size images → float32
+    ``[..., crop_h, crop_w, C]``, center-cropped and normalized per
+    ``cfg.normalize`` — the device-side mirror of the host pipeline's
+    ``_finish`` (deterministic path)."""
+    if cfg.random_crop or cfg.random_flip:
+        raise ValueError(
+            "device_preprocess is the deterministic path; random crop/flip "
+            "run on the host pipeline")
+    x = images_u8.astype(jnp.float32)
+    h, w = x.shape[-3], x.shape[-2]
+    if cfg.crop_height > h or cfg.crop_width > w:
+        # Pad-if-smaller, same as the host records.center_crop (parity with
+        # tf.image.resize_image_with_crop_or_pad).
+        ph, pw = max(cfg.crop_height - h, 0), max(cfg.crop_width - w, 0)
+        pad = ([(0, 0)] * (x.ndim - 3)
+               + [(ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0)])
+        x = jnp.pad(x, pad)
+        h, w = x.shape[-3], x.shape[-2]
+    oh, ow = (h - cfg.crop_height) // 2, (w - cfg.crop_width) // 2
+    x = x[..., oh:oh + cfg.crop_height, ow:ow + cfg.crop_width, :]
+    if cfg.normalize == "scale":
+        x = x / 255.0
+    elif cfg.normalize == "standardize":
+        axes = tuple(range(x.ndim - 3, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        std = jnp.std(x, axis=axes, keepdims=True)
+        # tf.image.per_image_standardization's min stddev guard
+        n = cfg.crop_height * cfg.crop_width * x.shape[-1]
+        x = (x - mean) / jnp.maximum(std, 1.0 / jnp.sqrt(float(n)))
+    elif cfg.normalize != "none":
+        raise ValueError(f"unknown normalize mode {cfg.normalize!r}")
+    return x

@@ -53,12 +53,16 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
-    """Leading (batch) dim over ``data``; rest replicated."""
-    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+def batch_sharding(mesh: Mesh, ndim: int = 4,
+                   leading_dims: int = 0) -> NamedSharding:
+    """Batch dim over ``data``, preceded by ``leading_dims`` replicated axes
+    (the K axis of a ``[K, B, ...]`` step chunk); rest replicated."""
+    spec = [None] * leading_dims + ["data"]
+    spec += [None] * (ndim - len(spec))
+    return NamedSharding(mesh, P(*spec))
 
 
-def shard_batch(mesh: Mesh, images, labels):
+def shard_batch(mesh: Mesh, images, labels, leading_dims: int = 0):
     """Place a host batch on the mesh, batch dim sharded over ``data``.
 
     Single-process: a plain ``device_put`` with a NamedSharding. Multi-host:
@@ -67,8 +71,8 @@ def shard_batch(mesh: Mesh, images, labels):
     every worker feeding its own queue in the reference
     (``cifar10cnn.py:201``).
     """
-    img_s = batch_sharding(mesh, images.ndim)
-    lab_s = batch_sharding(mesh, labels.ndim)
+    img_s = batch_sharding(mesh, images.ndim, leading_dims)
+    lab_s = batch_sharding(mesh, labels.ndim, leading_dims)
     if jax.process_count() == 1:
         return (jax.device_put(images, img_s), jax.device_put(labels, lab_s))
     return (

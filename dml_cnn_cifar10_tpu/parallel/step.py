@@ -130,6 +130,24 @@ def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,
     return loss_fn
 
 
+def _step_body(loss_fn, optim_cfg: OptimConfig):
+    """``(state, images, labels) -> (new_state, metrics)`` — the shared
+    grad/update/metrics math of ``make_train_step`` and
+    ``make_train_chunk`` (one source of truth for both)."""
+
+    def step(state: TrainState, images, labels):
+        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, state.model_state, images,
+                                   labels)
+        new_params, new_opt = optim_lib.sgd_update(grads, state.opt,
+                                                   state.params, optim_cfg)
+        metrics = {"loss": loss,
+                   "accuracy": metrics_lib.batch_accuracy(logits, labels)}
+        return TrainState(new_params, new_opt, new_model_state), metrics
+
+    return step
+
+
 def make_train_step(
     model_def: ModelDef,
     model_cfg: ModelConfig,
@@ -157,16 +175,7 @@ def make_train_step(
         return _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh)
 
     loss_fn = _forward_loss(model_def, model_cfg, mesh=mesh)
-
-    def step(state: TrainState, images, labels):
-        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params, state.model_state, images,
-                                   labels)
-        new_params, new_opt = optim_lib.sgd_update(grads, state.opt,
-                                                   state.params, optim_cfg)
-        metrics = {"loss": loss,
-                   "accuracy": metrics_lib.batch_accuracy(logits, labels)}
-        return TrainState(new_params, new_opt, new_model_state), metrics
+    step = _step_body(loss_fn, optim_cfg)
 
     if mesh is None:
         return jax.jit(step, donate_argnums=0)
@@ -176,6 +185,62 @@ def make_train_step(
     lab = mesh_lib.batch_sharding(mesh, 1)
     return jax.jit(
         step,
+        in_shardings=(state_sh, data, lab),
+        out_shardings=(state_sh, repl),
+        donate_argnums=0,
+    )
+
+
+def make_train_chunk(
+    model_def: ModelDef,
+    model_cfg: ModelConfig,
+    optim_cfg: OptimConfig,
+    mesh: Optional[Mesh] = None,
+    state_sharding: Optional[TrainState] = None,
+    data_cfg: Optional[DataConfig] = None,
+) -> Callable[[TrainState, jax.Array, jax.Array],
+              Tuple[TrainState, dict]]:
+    """K training steps per dispatch: ``(state, images [K,B,...], labels
+    [K,B]) -> (new_state, metrics of the LAST step)``.
+
+    A ``lax.scan`` over stacked batches amortizes per-step host dispatch —
+    the small-model regime (the reference CNN is ~1 ms of MXU work per
+    step) is dispatch-bound otherwise. Same math as ``make_train_step``
+    applied K times; the chunk is the unit the driver hands to the device,
+    metrics cadence stays per-chunk.
+
+    With ``data_cfg`` the chunk takes RAW uint8 full-size images
+    ([K, B, H, W, C]) and runs cast/crop/normalize on device
+    (:func:`~dml_cnn_cifar10_tpu.ops.preprocess.device_preprocess`) — the
+    host only shuffles bytes, H2D moves uint8.
+    """
+    loss_fn = _forward_loss(model_def, model_cfg, mesh=mesh)
+    if data_cfg is not None:
+        from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
+
+    one_step = _step_body(loss_fn, optim_cfg)
+
+    def chunk(state: TrainState, images, labels):
+        if data_cfg is not None:
+            # One vectorized cast/crop over the whole [K,B,...] chunk BEFORE
+            # the scan: uint8 stays a single layout-friendly op, the scan
+            # then slices float32.
+            images = device_preprocess(images, data_cfg)
+
+        def body(st, batch):
+            return one_step(st, batch[0], batch[1])
+
+        state, ms = lax.scan(body, state, (images, labels))
+        return state, jax.tree.map(lambda x: x[-1], ms)
+
+    if mesh is None:
+        return jax.jit(chunk, donate_argnums=0)
+    repl = mesh_lib.replicated(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
+    data = mesh_lib.batch_sharding(mesh, 5, leading_dims=1)
+    lab = mesh_lib.batch_sharding(mesh, 2, leading_dims=1)
+    return jax.jit(
+        chunk,
         in_shardings=(state_sh, data, lab),
         out_shardings=(state_sh, repl),
         donate_argnums=0,

@@ -1,0 +1,48 @@
+"""Device-side preprocess == the host decode path (crop/pad/normalize)."""
+
+import numpy as np
+import pytest
+
+from dml_cnn_cifar10_tpu.config import DataConfig
+from dml_cnn_cifar10_tpu.data import records as rec
+from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
+
+
+def _host(images_u8: np.ndarray, cfg: DataConfig) -> np.ndarray:
+    """The deterministic host path (pipeline._finish without augmentation)."""
+    x = images_u8.astype(np.float32)
+    x = rec.center_crop(x, cfg.crop_height, cfg.crop_width)
+    return rec.normalize(x, cfg.normalize)
+
+
+@pytest.mark.parametrize("normalize", ["none", "scale", "standardize"])
+def test_matches_host_path(rng, normalize):
+    cfg = DataConfig(normalize=normalize)  # 32x32 -> 24x24 center crop
+    images = rng.integers(0, 256, (16, 32, 32, 3)).astype(np.uint8)
+    np.testing.assert_allclose(
+        np.asarray(device_preprocess(images, cfg)), _host(images, cfg),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_pad_if_smaller_matches_host(rng):
+    cfg = DataConfig(crop_height=40, crop_width=36, normalize="scale")
+    images = rng.integers(0, 256, (4, 32, 32, 3)).astype(np.uint8)
+    out = np.asarray(device_preprocess(images, cfg))
+    assert out.shape == (4, 40, 36, 3)
+    np.testing.assert_allclose(out, _host(images, cfg), rtol=1e-5, atol=1e-5)
+
+
+def test_chunked_leading_dims(rng):
+    cfg = DataConfig(normalize="standardize")
+    chunk = rng.integers(0, 256, (3, 8, 32, 32, 3)).astype(np.uint8)
+    out = np.asarray(device_preprocess(chunk, cfg))
+    assert out.shape == (3, 8, 24, 24, 3)
+    flat = _host(chunk.reshape(-1, 32, 32, 3), cfg)
+    np.testing.assert_allclose(out.reshape(-1, 24, 24, 3), flat,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_rejects_augmented_config():
+    with pytest.raises(ValueError):
+        device_preprocess(np.zeros((1, 32, 32, 3), np.uint8),
+                          DataConfig(random_crop=True))
