@@ -75,11 +75,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tensor-parallel mesh degree")
     p.add_argument("--seq_axis", type=int, default=1,
                    help="sequence-parallel mesh degree")
+    p.add_argument("--sp_mode", type=str, default="ring",
+                   choices=["ring", "ulysses"],
+                   help="sequence-parallel attention strategy: ring "
+                        "(K/V ppermute walk) or ulysses (seq<->head "
+                        "all-to-all; needs heads %% seq_axis == 0)")
+    p.add_argument("--pool", type=str, default=None,
+                   choices=["cls", "mean"],
+                   help="ViT head pooling; defaults to cls, or mean when "
+                        "seq_axis > 1 (sequence sharding excludes a lone "
+                        "cls token)")
+    p.add_argument("--vit_heads", type=int, default=None,
+                   help="ViT attention heads (default 3; ulysses sp needs "
+                        "heads divisible by seq_axis)")
+    p.add_argument("--vit_dim", type=int, default=None,
+                   help="ViT embed dim (default 192)")
+    p.add_argument("--vit_depth", type=int, default=None,
+                   help="ViT blocks (default 12)")
     p.add_argument("--pipe_axis", type=int, default=1,
                    help="pipeline-parallel mesh degree (GPipe stages)")
     p.add_argument("--moe_experts", type=int, default=0,
                    help="experts per MoE block (vit_moe); sharded over "
                         "the model axis (expert parallelism)")
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help="microbatches per optimizer update (gradient "
+                        "accumulation inside the compiled step)")
     p.add_argument("--explicit_collectives", type="bool", default=False,
                    help="use the shard_map+psum step instead of jit "
                         "auto-partitioning")
@@ -112,6 +132,15 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.model.name = args.model
     cfg.model.compute_dtype = args.compute_dtype
     cfg.optim.learning_rate = args.learning_rate
+    cfg.optim.grad_accum = args.grad_accum
+    cfg.model.sp_mode = args.sp_mode
+    if args.pool is not None:
+        cfg.model.pool = args.pool
+    elif args.seq_axis > 1:
+        cfg.model.pool = "mean"
+    for f in ("vit_heads", "vit_dim", "vit_depth"):
+        if getattr(args, f) is not None:
+            setattr(cfg.model, f, getattr(args, f))
     cfg.parallel.model_axis = args.model_axis
     cfg.parallel.seq_axis = args.seq_axis
     cfg.parallel.pipe_axis = args.pipe_axis

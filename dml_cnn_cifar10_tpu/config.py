@@ -95,6 +95,12 @@ class ModelConfig:
     # where the token count must divide the ``seq`` mesh axis and a lone
     # cls token would break the even sharding.
     pool: str = "cls"                     # cls | mean
+    # Sequence-parallel attention strategy when the mesh's ``seq`` axis >1:
+    # "ring" walks K/V shards around the ring (no head-count constraint,
+    # best at very long S); "ulysses" all-to-alls seq→heads and runs one
+    # dense full-sequence kernel per head slice (needs heads % seq_axis
+    # == 0, best MXU utilization at moderate seq degree).
+    sp_mode: str = "ring"                 # ring | ulysses
     # Mixture-of-Experts (model name "vit_moe"): every block's MLP becomes
     # a top-1-routed expert bank (ops/moe.py), experts sharded over the
     # ``model`` mesh axis (expert parallelism).
@@ -119,6 +125,12 @@ class OptimConfig:
     momentum: float = 0.0                 # reference uses plain SGD
     weight_decay: float = 0.0
     grad_clip_norm: Optional[float] = None
+    # Gradient accumulation: split each global batch into this many
+    # microbatches inside the compiled step (lax.scan), average the grads,
+    # apply ONE optimizer update. Trains large effective batches in bounded
+    # activation memory (no reference counterpart — the reference's batch
+    # always fits; this is a scale capability).
+    grad_accum: int = 1
 
 
 @dataclasses.dataclass
@@ -157,6 +169,11 @@ class TrainConfig:
     log_dir: str = "/tmp/train_logs"      # checkpoint dir (cifar10cnn.py:269-272)
     checkpoint_every: int = 1000          # steps; MTS default was 600s wall-clock
     keep_checkpoints: int = 3
+    # Multi-host runs agree on the preemption flag every this many steps
+    # (a host-level allgather over DCN): under synchronous SPMD no process
+    # may leave the step loop alone or the peers hang in the next
+    # collective. Single-process runs react to the signal immediately.
+    preempt_sync_every: int = 10
     metrics_jsonl: Optional[str] = None   # structured metrics sink
     seed: int = 0
     profile_dir: Optional[str] = None     # jax.profiler trace output

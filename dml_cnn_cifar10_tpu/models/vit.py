@@ -116,7 +116,7 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
 
 
 def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
-           capacity_factor: float, mesh=None):
+           capacity_factor: float, mesh=None, sp_mode: str = "ring"):
     """One transformer block → ``(x, aux_loss)`` (aux 0.0 for dense MLP)."""
     b, s, dim = x.shape
     h = layer_norm(x, p["ln1"])
@@ -124,11 +124,22 @@ def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
     qkv = qkv.reshape(b, s, heads, 3, dim // heads)  # heads-major
     q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
     if mesh is not None:
-        # Sequence-parallel path: blockwise ring attention over the ``seq``
-        # mesh axis — each device holds S/seq tokens, K/V shards walk the
-        # ring over ICI (parallel/ring_attention.py).
-        from dml_cnn_cifar10_tpu.parallel import ring_attention as ring
-        o = ring.ring_attention(q, k, v, mesh)
+        # Sequence-parallel path over the ``seq`` mesh axis. Two strategies
+        # with the same sharded-activation contract:
+        # - "ring": each device holds S/seq tokens, K/V shards walk the
+        #   ring over ICI (parallel/ring_attention.py);
+        # - "ulysses": all-to-all re-partitions seq→heads, full-sequence
+        #   attention on a head slice, all-to-all back
+        #   (parallel/ulysses.py; needs heads % seq_axis == 0).
+        if sp_mode == "ulysses":
+            from dml_cnn_cifar10_tpu.parallel import ulysses
+            o = ulysses.ulysses_attention(q, k, v, mesh,
+                                          use_pallas=use_pallas)
+        elif sp_mode == "ring":
+            from dml_cnn_cifar10_tpu.parallel import ring_attention as ring
+            o = ring.ring_attention(q, k, v, mesh)
+        else:
+            raise ValueError(f"unknown sp_mode {sp_mode!r}")
     else:
         o = attn.dispatch_attention(q, k, v, use_pallas=use_pallas)
     x = x + L.dense(o.reshape(b, s, dim), p["proj"]["kernel"],
@@ -217,7 +228,8 @@ def apply_with_aux(params: Params, images: jax.Array, cfg: ModelConfig,
             h, aux_sum = carry
             h, block_aux = _block(h, bp, cfg.vit_heads,
                                   cfg.use_pallas_attention,
-                                  cfg.moe_capacity_factor, mesh=attn_mesh)
+                                  cfg.moe_capacity_factor, mesh=attn_mesh,
+                                  sp_mode=cfg.sp_mode)
             return (h, aux_sum + block_aux), None
 
         (x, aux), _ = lax.scan(body, (x, aux), p["blocks"])

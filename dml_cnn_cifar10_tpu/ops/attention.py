@@ -43,11 +43,12 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def dispatch_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                       use_pallas: bool = False) -> jax.Array:
+                       use_pallas: bool = False,
+                       scale: float | None = None) -> jax.Array:
     """Pick the attention impl: Pallas flash kernel when asked for and the
     sequence is long enough to benefit; XLA fused attention otherwise."""
     seq = q.shape[1]
     if use_pallas and seq >= 128:
         from dml_cnn_cifar10_tpu.ops import flash_attention as fa
-        return fa.flash_attention(q, k, v)
-    return xla_attention(q, k, v)
+        return fa.flash_attention(q, k, v, scale=scale)
+    return xla_attention(q, k, v, scale=scale)

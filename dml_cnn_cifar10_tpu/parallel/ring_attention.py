@@ -98,6 +98,44 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
+def sp_partition_spec(mesh: Mesh, axis_name: str, seq_len: int,
+                      num_heads: int):
+    """The shared sequence-parallel layout rule → ``(spec, head_axis)``.
+
+    ``[B, S, H, D]`` partition spec for any SP attention kernel (ring or
+    Ulysses): batch over ``data``, sequence over ``axis_name``. Heads are
+    batch-like inside the local bodies, so when the mesh also has a
+    nontrivial ``model`` (tensor-parallel) axis the heads dim shards over
+    it — sp × tp compose with zero resharding at the kernel edge. When the
+    head count doesn't divide the axis (e.g. default ViT-Ti's 3 heads on
+    model=2), fall back to replicated heads: correct, just an all-gather
+    at the kernel edge instead of a free composition. Raises on a sequence
+    length the ``seq`` axis can't split.
+    """
+    nseq = mesh.shape[axis_name]
+    if seq_len % nseq:
+        raise ValueError(
+            f"sequence length {seq_len} not divisible by seq axis {nseq}")
+    nmodel = mesh.shape.get("model", 1)
+    head_axis = "model" if nmodel > 1 and num_heads % nmodel == 0 else None
+    return P("data", axis_name, head_axis, None), head_axis
+
+
+def sp_shard_map(local_fn, mesh: Mesh, axis_name: str, seq_len: int,
+                 num_heads: int):
+    """Wrap an SP-local attention body in the standard shard_map: one
+    ``(q, k, v) -> out`` callable with all tensors laid out per
+    :func:`sp_partition_spec`."""
+    spec, _ = sp_partition_spec(mesh, axis_name, seq_len, num_heads)
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    scale: Optional[float] = None,
                    axis_name: str = "seq") -> jax.Array:
@@ -107,28 +145,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     divisible by the ``seq`` axis size. Batch stays sharded on ``data`` so
     dp × sp compose.
     """
-    nseq = mesh.shape[axis_name]
-    if q.shape[1] % nseq:
-        raise ValueError(
-            f"sequence length {q.shape[1]} not divisible by seq axis "
-            f"{nseq}")
-    # Heads are batch-like inside the ring body, so when the mesh also has
-    # a nontrivial ``model`` (tensor-parallel) axis the heads dim shards
-    # over it — sp × tp compose with zero resharding at the kernel edge.
-    # When the head count doesn't divide the axis (e.g. default ViT-Ti's 3
-    # heads on model=2), fall back to replicated heads: correct, just an
-    # all-gather at the kernel edge instead of a free composition.
-    nmodel = mesh.shape.get("model", 1)
-    head_axis = "model" if nmodel > 1 and q.shape[2] % nmodel == 0 else None
-    spec = P("data", axis_name, head_axis, None)
-    fn = jax.shard_map(
+    fn = sp_shard_map(
         functools.partial(ring_attention_local, axis_name=axis_name,
                           scale=scale),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )
+        mesh, axis_name, q.shape[1], q.shape[2])
     return fn(q, k, v)
 
 

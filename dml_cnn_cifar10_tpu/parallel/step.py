@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -133,16 +134,51 @@ def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,
 def _step_body(loss_fn, optim_cfg: OptimConfig):
     """``(state, images, labels) -> (new_state, metrics)`` — the shared
     grad/update/metrics math of ``make_train_step`` and
-    ``make_train_chunk`` (one source of truth for both)."""
+    ``make_train_chunk`` (one source of truth for both).
+
+    ``optim_cfg.grad_accum > 1`` scans over that many microbatches,
+    averaging grads/metrics, then applies ONE optimizer update — the same
+    math as the full batch (equal-sized microbatches ⇒ mean of means) in
+    1/accum of the activation memory.
+    """
+    accum = max(1, optim_cfg.grad_accum)
+
+    def grad_and_metrics(params, model_state, images, labels):
+        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, model_state, images, labels)
+        acc = metrics_lib.batch_accuracy(logits, labels)
+        return grads, loss, acc, new_model_state
 
     def step(state: TrainState, images, labels):
-        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params, state.model_state, images,
-                                   labels)
+        if accum == 1:
+            grads, loss, acc, new_model_state = grad_and_metrics(
+                state.params, state.model_state, images, labels)
+        else:
+            b = images.shape[0]
+            if b % accum:
+                raise ValueError(
+                    f"batch {b} not divisible by grad_accum {accum}")
+            ims = images.reshape(accum, b // accum, *images.shape[1:])
+            lbs = labels.reshape(accum, b // accum)
+
+            def micro(carry, xs):
+                gsum, lsum, asum, mstate = carry
+                g, l, a, mstate = grad_and_metrics(state.params, mstate,
+                                                   xs[0], xs[1])
+                return (jax.tree.map(jnp.add, gsum, g), lsum + l, asum + a,
+                        mstate), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (gsum, lsum, asum, new_model_state), _ = lax.scan(
+                micro,
+                (zeros, jnp.zeros((), jnp.float32),
+                 jnp.zeros((), jnp.float32), state.model_state),
+                (ims, lbs))
+            grads = jax.tree.map(lambda g: g / accum, gsum)
+            loss, acc = lsum / accum, asum / accum
         new_params, new_opt = optim_lib.sgd_update(grads, state.opt,
                                                    state.params, optim_cfg)
-        metrics = {"loss": loss,
-                   "accuracy": metrics_lib.batch_accuracy(logits, labels)}
+        metrics = {"loss": loss, "accuracy": acc}
         return TrainState(new_params, new_opt, new_model_state), metrics
 
     return step
@@ -172,6 +208,10 @@ def make_train_step(
             raise ValueError(
                 "explicit_collectives is the pedagogical dp-only path; "
                 "tensor/sequence/pipeline axes need the GSPMD (default) step")
+        if optim_cfg.grad_accum > 1:
+            raise ValueError(
+                "grad_accum > 1 is not implemented on the "
+                "explicit_collectives path; use the GSPMD (default) step")
         return _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh)
 
     loss_fn = _forward_loss(model_def, model_cfg, mesh=mesh)

@@ -32,6 +32,7 @@ from dml_cnn_cifar10_tpu.models.registry import get_model
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+from dml_cnn_cifar10_tpu.utils.preemption import PreemptionGuard
 from dml_cnn_cifar10_tpu.utils.profiling import StepTimer, profile_trace
 
 
@@ -42,6 +43,7 @@ class TrainResult:
     test_accuracy: list
     images_per_sec: float
     state: step_lib.TrainState
+    preempted: bool = False
 
 
 class Trainer:
@@ -126,8 +128,9 @@ class Trainer:
         print("Starting Training")  # parity: cifar10cnn.py:225
         i = 0  # local step, like the reference's `i` (cifar10cnn.py:224)
         global_step = start_step
-        with profile_trace(cfg.profile_dir):
-            while global_step < total_steps:
+        stop = False
+        with PreemptionGuard() as preempt, profile_trace(cfg.profile_dir):
+            while global_step < total_steps and not stop:
                 images, labels = next(prefetch)
                 state, metrics = self.train_step(state, images, labels)
                 global_step += 1
@@ -151,13 +154,35 @@ class Trainer:
                                     test_accuracy=ta)
                 ckpt_mgr.maybe_save(state, global_step)
                 i += 1
+                # Preemption: a single process reacts immediately; a
+                # multi-host job must AGREE first — under synchronous SPMD
+                # no process may leave the step loop alone (its peers would
+                # hang in the next collective), so the flag is allgathered
+                # at a shared step boundary and every process exits on the
+                # same iteration.
+                if num_shards == 1:
+                    stop = preempt.requested
+                elif i % cfg.preempt_sync_every == 0:
+                    from jax.experimental import multihost_utils
+                    stop = bool(multihost_utils.process_allgather(
+                        np.asarray(preempt.requested)).any())
 
-        ckpt_mgr.maybe_save(state, global_step, force=True)
-        prefetch.close()
-        self.logger.log("done", step=global_step,
-                        images_per_sec=timer.images_per_sec)
+            # Final save covers both normal completion and preemption: the
+            # in-flight step finished, so the checkpoint loses zero work.
+            # It runs INSIDE the guard so a second signal during the
+            # write (Ctrl-C twice, pool re-sending SIGTERM) can't kill the
+            # process before the atomic rename lands.
+            ckpt_mgr.maybe_save(state, global_step, force=True)
+            prefetch.close()
+            if stop:
+                print(f"[preempt] signal {preempt.signum}: checkpointed at "
+                      f"step {global_step}, exiting cleanly")
+                self.logger.log("preempt", step=global_step,
+                                signum=preempt.signum)
+            self.logger.log("done", step=global_step,
+                            images_per_sec=timer.images_per_sec)
         return TrainResult(global_step, train_loss, test_accuracy,
-                           timer.images_per_sec, state)
+                           timer.images_per_sec, state, preempted=stop)
 
 
 def _current_lr(cfg: TrainConfig, step: int) -> float:

@@ -1,0 +1,51 @@
+"""Graceful preemption: SIGTERM/SIGINT → finish the step, checkpoint, exit.
+
+The reference's only fault story is "restart the worker and
+MonitoredTrainingSession restores the latest checkpoint"
+(``cifar10cnn.py:222``, SURVEY §5 "Failure detection") — fine under async
+PS where a dead worker doesn't block the others, but it loses up to
+``checkpoint_every`` steps of work. Under synchronous SPMD every preemption
+kills the whole job, so the framework adds the missing half: a signal
+guard the training loop polls each step. On SIGTERM (the standard
+preemption warning on managed TPU/K8s pools) or SIGINT the loop completes
+the in-flight step, force-saves a checkpoint, and exits cleanly; the next
+start restores and resumes. Works per-process in multi-host runs — each
+process saves/exits on its own signal, and restart re-forms the SPMD set.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+
+class PreemptionGuard:
+    """Context manager: installs SIGTERM/SIGINT handlers that set a flag
+    instead of killing the process. Poll ``requested`` from the training
+    loop. No-ops (flag stays False, no handlers touched) when not in the
+    main thread, where Python forbids ``signal.signal``."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._saved = {}
+
+    def _handle(self, signum, frame):
+        del frame
+        self.requested = True
+        self.signum = signum
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is threading.main_thread():
+            for s in self.SIGNALS:
+                self._saved[s] = signal.signal(s, self._handle)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for s, old in self._saved.items():
+            signal.signal(s, old)
+        self._saved.clear()
+        return None
