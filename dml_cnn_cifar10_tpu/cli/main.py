@@ -97,6 +97,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe_experts", type=int, default=0,
                    help="experts per MoE block (vit_moe); sharded over "
                         "the model axis (expert parallelism)")
+    p.add_argument("--steps_per_dispatch", type=int, default=1,
+                   help="train steps per device dispatch (lax.scan chunk; "
+                        "output/eval/checkpoint cadences must be "
+                        "multiples)")
     p.add_argument("--grad_accum", type=int, default=1,
                    help="microbatches per optimizer update (gradient "
                         "accumulation inside the compiled step)")
@@ -133,6 +137,10 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.model.compute_dtype = args.compute_dtype
     cfg.optim.learning_rate = args.learning_rate
     cfg.optim.grad_accum = args.grad_accum
+    cfg.steps_per_dispatch = args.steps_per_dispatch
+    # Seed the data stream (shuffle + device-side augmentation draws) from
+    # the run seed too — otherwise --seed would not vary augmentation.
+    cfg.data.seed = args.seed
     cfg.model.sp_mode = args.sp_mode
     if args.pool is not None:
         cfg.model.pool = args.pool

@@ -169,6 +169,13 @@ class TrainConfig:
     log_dir: str = "/tmp/train_logs"      # checkpoint dir (cifar10cnn.py:269-272)
     checkpoint_every: int = 1000          # steps; MTS default was 600s wall-clock
     keep_checkpoints: int = 3
+    # Steps per device dispatch. >1 switches the Trainer to the chunked
+    # path (parallel/step.py:make_train_chunk): lax.scan over K stacked
+    # batches per dispatch, host ships raw uint8, decode/augment fused on
+    # device — the dispatch-bound small-model regime needs this to keep
+    # the MXU fed. output/eval/checkpoint cadences and total_steps must be
+    # multiples of K so every observable boundary falls on a dispatch edge.
+    steps_per_dispatch: int = 1
     # Multi-host runs agree on the preemption flag every this many steps
     # (a host-level allgather over DCN): under synchronous SPMD no process
     # may leave the step loop alone or the peers hang in the next

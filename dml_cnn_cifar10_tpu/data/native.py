@@ -112,19 +112,37 @@ class NativeShuffleBatchIterator(pipe.ShuffleBatchIterator):
              cfg.num_channels), np.uint8)
         self._lab_buf = np.empty((batch_size,), np.int32)
 
-    def __next__(self) -> pipe.Batch:
+    def _fill(self, img_buf: np.ndarray, lab_buf: np.ndarray) -> None:
+        """One ``recordio_next_batch`` into caller buffers (shared by the
+        per-batch and raw-chunk paths)."""
         if not self._handle:
             raise RuntimeError("native loader is closed")
         ret = self._lib.recordio_next_batch(
             self._handle, self.batch_size,
-            self._img_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            self._lab_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            img_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            lab_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
         if ret != 0:
             raise RuntimeError(
                 "native loader: "
                 + self._lib.recordio_error(self._handle).decode())
+
+    def __next__(self) -> pipe.Batch:
+        self._fill(self._img_buf, self._lab_buf)
         return pipe.Batch(self._finish(self._img_buf),
                           self._lab_buf.copy())
+
+    def next_raw_chunk(self, k: int) -> pipe.Batch:
+        """``k`` stacked raw uint8 batches straight from the native bounded
+        shuffle pool (same stream as ``__next__``, no decode) — the chunked
+        training path's input, keeping the reference's bounded-shuffle
+        semantics instead of the base class's in-memory permutation."""
+        cfg = self.cfg
+        ims = np.empty((k, self.batch_size, cfg.image_height,
+                        cfg.image_width, cfg.num_channels), np.uint8)
+        lbs = np.empty((k, self.batch_size), np.int32)
+        for j in range(k):
+            self._fill(ims[j], lbs[j])
+        return pipe.Batch(ims, lbs)
 
     def buffered(self) -> int:
         """Records currently in the native shuffle pool (observability)."""

@@ -1,4 +1,4 @@
-"""Device-side input preprocessing (cast / crop / normalize) as XLA ops.
+"""Device-side input preprocessing (cast / crop / augment / normalize).
 
 The reference does all decode work on host CPU threads
 (``cifar10cnn.py:54-70``: reader → transpose → cast → crop inside the
@@ -11,29 +11,46 @@ is 4x less PCIe/ICI traffic than float32, and the cast/crop/normalize fuse
 into the training step for free.
 
 Used by the chunked training path (``parallel/step.py:make_train_chunk``
-with ``data_cfg=``); augmented (random crop/flip) training keeps the host
-path, deterministic center-crop pipelines (faithful parity + bench) take
-this one.
+with ``data_cfg=``). Deterministic center-crop pipelines (faithful parity
++ bench) need no key; augmented configs (``random_crop``/``random_flip``,
+fixed mode) pass a PRNG ``key`` and the augmentation runs on device too —
+per-image random windows via ``dynamic_slice`` under ``vmap``, flips via a
+mask select, all fused into the step.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from dml_cnn_cifar10_tpu.config import DataConfig
 
 
-def device_preprocess(images_u8: jax.Array, cfg: DataConfig) -> jax.Array:
+def device_preprocess(images_u8: jax.Array, cfg: DataConfig,
+                      key: Optional[jax.Array] = None) -> jax.Array:
     """uint8 ``[..., H, W, C]`` full-size images → float32
-    ``[..., crop_h, crop_w, C]``, center-cropped and normalized per
-    ``cfg.normalize`` — the device-side mirror of the host pipeline's
-    ``_finish`` (deterministic path)."""
-    if cfg.random_crop or cfg.random_flip:
+    ``[..., crop_h, crop_w, C]``, cropped/augmented and normalized per
+    ``cfg`` — the device-side mirror of the host pipeline's ``_finish``.
+    Random crop/flip require ``key``."""
+    if (cfg.random_crop or cfg.random_flip) and key is None:
         raise ValueError(
-            "device_preprocess is the deterministic path; random crop/flip "
-            "run on the host pipeline")
+            "random crop/flip on device need a PRNG key; pass key= or use "
+            "the host pipeline")
     x = images_u8.astype(jnp.float32)
+    if cfg.random_crop:
+        kc, key = jax.random.split(key)
+        x = _random_crop(x, cfg, kc)
+    else:
+        x = _center_crop(x, cfg)
+    if cfg.random_flip:
+        x = _random_flip(x, key)
+    return _normalize(x, cfg)
+
+
+def _center_crop(x: jax.Array, cfg: DataConfig) -> jax.Array:
     h, w = x.shape[-3], x.shape[-2]
     if cfg.crop_height > h or cfg.crop_width > w:
         # Pad-if-smaller, same as the host records.center_crop (parity with
@@ -44,16 +61,47 @@ def device_preprocess(images_u8: jax.Array, cfg: DataConfig) -> jax.Array:
         x = jnp.pad(x, pad)
         h, w = x.shape[-3], x.shape[-2]
     oh, ow = (h - cfg.crop_height) // 2, (w - cfg.crop_width) // 2
-    x = x[..., oh:oh + cfg.crop_height, ow:ow + cfg.crop_width, :]
+    return x[..., oh:oh + cfg.crop_height, ow:ow + cfg.crop_width, :]
+
+
+def _random_crop(x: jax.Array, cfg: DataConfig, key: jax.Array) -> jax.Array:
+    """Per-image random window (the augmentation the reference's comment
+    at ``cifar10cnn.py:67`` intended). ``dynamic_slice`` under ``vmap``
+    keeps every slice the same static shape — XLA-friendly."""
+    lead = x.shape[:-3]
+    h, w, c = x.shape[-3:]
+    ch, cw = cfg.crop_height, cfg.crop_width
+    flat = x.reshape((-1, h, w, c))
+    n = flat.shape[0]
+    kt, kl = jax.random.split(key)
+    tops = jax.random.randint(kt, (n,), 0, h - ch + 1)
+    lefts = jax.random.randint(kl, (n,), 0, w - cw + 1)
+    out = jax.vmap(
+        lambda img, t, l: lax.dynamic_slice(img, (t, l, 0), (ch, cw, c))
+    )(flat, tops, lefts)
+    return out.reshape(lead + (ch, cw, c))
+
+
+def _random_flip(x: jax.Array, key: jax.Array) -> jax.Array:
+    """Per-image horizontal flip with p=0.5 (mirrors records.random_flip)."""
+    lead = x.shape[:-3]
+    h, w, c = x.shape[-3:]
+    flat = x.reshape((-1, h, w, c))
+    flip = jax.random.bernoulli(key, 0.5, (flat.shape[0],))
+    out = jnp.where(flip[:, None, None, None], flat[:, :, ::-1, :], flat)
+    return out.reshape(lead + (h, w, c))
+
+
+def _normalize(x: jax.Array, cfg: DataConfig) -> jax.Array:
     if cfg.normalize == "scale":
-        x = x / 255.0
-    elif cfg.normalize == "standardize":
+        return x / 255.0
+    if cfg.normalize == "standardize":
         axes = tuple(range(x.ndim - 3, x.ndim))
         mean = jnp.mean(x, axis=axes, keepdims=True)
         std = jnp.std(x, axis=axes, keepdims=True)
         # tf.image.per_image_standardization's min stddev guard
         n = cfg.crop_height * cfg.crop_width * x.shape[-1]
-        x = (x - mean) / jnp.maximum(std, 1.0 / jnp.sqrt(float(n)))
-    elif cfg.normalize != "none":
+        return (x - mean) / jnp.maximum(std, 1.0 / jnp.sqrt(float(n)))
+    if cfg.normalize != "none":
         raise ValueError(f"unknown normalize mode {cfg.normalize!r}")
     return x

@@ -264,8 +264,15 @@ def make_train_chunk(
         if data_cfg is not None:
             # One vectorized cast/crop over the whole [K,B,...] chunk BEFORE
             # the scan: uint8 stays a single layout-friendly op, the scan
-            # then slices float32.
-            images = device_preprocess(images, data_cfg)
+            # then slices float32. Augmented configs fold the global step
+            # into the data seed so every chunk draws fresh crops/flips,
+            # deterministically per (seed, step).
+            if data_cfg.random_crop or data_cfg.random_flip:
+                key = jax.random.fold_in(jax.random.key(data_cfg.seed),
+                                         state.step)
+                images = device_preprocess(images, data_cfg, key)
+            else:
+                images = device_preprocess(images, data_cfg)
 
         def body(st, batch):
             return one_step(st, batch[0], batch[1])

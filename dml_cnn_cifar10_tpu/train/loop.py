@@ -63,6 +63,24 @@ class Trainer:
             self.model_def, cfg.model, cfg.optim, self.mesh,
             explicit_collectives=cfg.parallel.explicit_collectives,
             state_sharding=self.state_sharding)
+        self.steps_per_dispatch = max(1, cfg.steps_per_dispatch)
+        if self.steps_per_dispatch > 1:
+            k = self.steps_per_dispatch
+            # total_steps is validated in fit() against the actual resume
+            # point (fit can override it).
+            for name in ("output_every", "eval_every", "checkpoint_every"):
+                if getattr(cfg, name) % k:
+                    raise ValueError(
+                        f"{name}={getattr(cfg, name)} must be a multiple "
+                        f"of steps_per_dispatch={k} so every observable "
+                        f"boundary lands on a dispatch edge")
+            if cfg.parallel.explicit_collectives:
+                raise ValueError(
+                    "steps_per_dispatch > 1 needs the GSPMD (default) "
+                    "step, not explicit_collectives")
+            self.train_chunk = step_lib.make_train_chunk(
+                self.model_def, cfg.model, cfg.optim, self.mesh,
+                state_sharding=self.state_sharding, data_cfg=cfg.data)
         self.eval_step = step_lib.make_eval_step(
             self.model_def, cfg.model, self.mesh,
             state_sharding=self.state_sharding)
@@ -104,6 +122,16 @@ class Trainer:
         total_steps = total_steps or cfg.total_steps
         state = state if state is not None else self.init_or_restore()
         start_step = int(jax.device_get(state.step))
+        if self.steps_per_dispatch > 1 and \
+                (total_steps - start_step) % self.steps_per_dispatch:
+            # Covers fit(total_steps=...) overrides and resumes from
+            # checkpoints written at non-multiple steps — the loop advances
+            # k at a time and must land exactly on the stop step
+            # (StopAtStepHook parity, cifar10cnn.py:219).
+            raise ValueError(
+                f"remaining steps {total_steps - start_step} (stop "
+                f"{total_steps}, resume {start_step}) must be a multiple "
+                f"of steps_per_dispatch={self.steps_per_dispatch}")
 
         num_shards = jax.process_count()
         shard = jax.process_index()
@@ -117,52 +145,72 @@ class Trainer:
         # Fresh-batch train accuracy (cifar10cnn.py:235) — an independent
         # stream over the same decoded arrays (no second decode).
         acc_it = train_it.clone(seed=cfg.seed + 7 + shard)
-        prefetch = pipe.PrefetchIterator(
-            train_it, depth=cfg.data.prefetch, place=self._placed)
+        k = self.steps_per_dispatch
+        if k > 1:
+            # Chunked path: the host's only per-dispatch work is gathering
+            # raw uint8 bytes; decode/augment runs on device inside the
+            # compiled chunk (ops/preprocess.py).
+            def produce():
+                b = train_it.next_raw_chunk(k)
+                return mesh_lib.shard_batch(self.mesh, b.images, b.labels,
+                                            leading_dims=1)
+
+            prefetch = pipe.PrefetchIterator(
+                iter(produce, None), depth=cfg.data.prefetch, place=None)
+            step_fn = self.train_chunk
+        else:
+            prefetch = pipe.PrefetchIterator(
+                train_it, depth=cfg.data.prefetch, place=self._placed)
+            step_fn = self.train_step
 
         ckpt_mgr = ckpt_lib.CheckpointManager(
             cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints)
-        timer = StepTimer(cfg.batch_size)
+        timer = StepTimer(cfg.batch_size * k)
         train_loss, test_accuracy = [], []
 
         print("Starting Training")  # parity: cifar10cnn.py:225
         i = 0  # local step, like the reference's `i` (cifar10cnn.py:224)
         global_step = start_step
         stop = False
+        # Dispatches between preemption allgathers: ~preempt_sync_every
+        # STEPS regardless of chunk size (at least every dispatch).
+        sync_stride = max(1, cfg.preempt_sync_every // k)
+        n_dispatch = 0
         with PreemptionGuard() as preempt, profile_trace(cfg.profile_dir):
             while global_step < total_steps and not stop:
                 images, labels = next(prefetch)
-                state, metrics = self.train_step(state, images, labels)
-                global_step += 1
+                state, metrics = step_fn(state, images, labels)
+                global_step += k
                 timer.tick()
 
-                if (i + 1) % cfg.output_every == 0:
+                if (i + k) % cfg.output_every == 0:
                     loss = float(jax.device_get(metrics["loss"]))
                     train_loss.append(loss)
                     acc = float(self.eval_step(
                         state, *self._placed(next(acc_it)))["accuracy"])
-                    self.logger.train_print(global_step, i, acc)
+                    self.logger.train_print(global_step, i + k - 1, acc)
                     self.logger.log("train", step=global_step, loss=loss,
                                     train_accuracy=acc,
                                     images_per_sec=timer.images_per_sec,
                                     lr=_current_lr(cfg, global_step))
-                if (i + 1) % cfg.eval_every == 0:
+                if (i + k) % cfg.eval_every == 0:
                     ta = self.evaluate(state, test_it)
                     test_accuracy.append(ta)
                     self.logger.eval_print(ta)
                     self.logger.log("eval", step=global_step,
                                     test_accuracy=ta)
                 ckpt_mgr.maybe_save(state, global_step)
-                i += 1
+                i += k
+                n_dispatch += 1
                 # Preemption: a single process reacts immediately; a
                 # multi-host job must AGREE first — under synchronous SPMD
                 # no process may leave the step loop alone (its peers would
                 # hang in the next collective), so the flag is allgathered
-                # at a shared step boundary and every process exits on the
-                # same iteration.
+                # at a shared dispatch boundary and every process exits on
+                # the same iteration.
                 if num_shards == 1:
                     stop = preempt.requested
-                elif i % cfg.preempt_sync_every == 0:
+                elif n_dispatch % sync_stride == 0:
                     from jax.experimental import multihost_utils
                     stop = bool(multihost_utils.process_allgather(
                         np.asarray(preempt.requested)).any())

@@ -42,7 +42,73 @@ def test_chunked_leading_dims(rng):
                                rtol=1e-5, atol=1e-5)
 
 
-def test_rejects_augmented_config():
+def test_augmented_requires_key():
     with pytest.raises(ValueError):
         device_preprocess(np.zeros((1, 32, 32, 3), np.uint8),
                           DataConfig(random_crop=True))
+
+
+def test_device_random_crop(rng):
+    import jax
+
+    cfg = DataConfig(random_crop=True, normalize="none")
+    images = rng.integers(0, 256, (64, 32, 32, 3)).astype(np.uint8)
+    k = jax.random.key(0)
+    out = np.asarray(device_preprocess(images, cfg, k))
+    assert out.shape == (64, 24, 24, 3)
+    # Deterministic per key; different keys give different windows.
+    again = np.asarray(device_preprocess(images, cfg, k))
+    np.testing.assert_array_equal(out, again)
+    other = np.asarray(device_preprocess(images, cfg, jax.random.key(1)))
+    assert (out != other).any()
+    # Every crop is a contiguous window: check via a coordinate image whose
+    # value encodes (row, col) — the window must be row/col-translates.
+    coord = (np.arange(32)[:, None] * 32 + np.arange(32)[None, :])
+    coord_img = np.repeat(coord[None, :, :, None], 3, axis=3).astype(np.uint8)
+    w = np.asarray(device_preprocess(
+        np.broadcast_to(coord_img, (4, 32, 32, 3)), cfg, k))
+    for i in range(4):
+        d = w[i, :, :, 0]
+        assert (np.diff(d, axis=1) % 256 == 1).all()  # contiguous cols
+
+
+def test_device_random_flip(rng):
+    import jax
+
+    cfg = DataConfig(random_crop=False, random_flip=True, normalize="none")
+    images = rng.integers(0, 256, (512, 32, 32, 3)).astype(np.uint8)
+    out = np.asarray(device_preprocess(images, cfg, jax.random.key(0)))
+    center = _host(images, cfg)  # flip disabled on host path here
+    flipped = (out != center).any(axis=(1, 2, 3))
+    # ~half flipped, and flipped images are exact mirrors.
+    assert 0.3 < flipped.mean() < 0.7
+    np.testing.assert_array_equal(out[flipped], center[flipped][:, :, ::-1])
+
+
+def test_augmented_chunk_trains(rng):
+    """make_train_chunk with an augmented data config: fresh crops per
+    chunk, deterministic per (seed, step)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dml_cnn_cifar10_tpu.config import (ModelConfig, OptimConfig,
+                                            ParallelConfig)
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+    cfg = DataConfig(random_crop=True, random_flip=True, normalize="scale")
+    model_def = get_model("cnn")
+    model_cfg = ModelConfig(logit_relu=False)
+    optim_cfg = OptimConfig(learning_rate=0.02)
+    mesh = mesh_lib.build_mesh(ParallelConfig())
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, model_cfg, cfg, optim_cfg, mesh)
+    chunk = step_lib.make_train_chunk(model_def, model_cfg, optim_cfg, mesh,
+                                      data_cfg=cfg)
+    raw = rng.integers(0, 256, (2, 16, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, (2, 16)).astype(np.int32)
+    im, lb = mesh_lib.shard_batch(mesh, raw, labels, leading_dims=1)
+    state, m = chunk(state, im, lb)
+    assert np.isfinite(float(m["loss"]))
+    assert int(jax.device_get(state.step)) == 2
