@@ -109,15 +109,45 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
 
 
 class CheckpointManager:
-    """Periodic chief-only saver (the CheckpointSaverHook role)."""
+    """Periodic chief-only saver (the CheckpointSaverHook role).
+
+    ``async_save=True`` overlaps serialize+disk-write with training: the
+    device→host fetch still happens synchronously at the call (the arrays
+    must be read before the next donated step reuses their buffers), but
+    the msgpack encode and file IO run on a single background writer
+    thread. Saves stay ordered (a new save first drains the previous one);
+    writer exceptions surface at the next ``maybe_save``/``flush``.
+    """
 
     def __init__(self, ckpt_dir: str, every_steps: int, keep: int = 3,
-                 is_chief: Optional[bool] = None):
+                 is_chief: Optional[bool] = None, async_save: bool = False):
         self.ckpt_dir = ckpt_dir
         self.every_steps = max(1, every_steps)
         self.keep = keep
         self.is_chief = (jax.process_index() == 0) if is_chief is None \
             else is_chief
+        self.async_save = async_save
+        self._pool = None
+        self._pending = None
+        if async_save:
+            import concurrent.futures
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-writer")
+
+    def flush(self) -> None:
+        """Wait for an in-flight async write; re-raise its error if any."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
+
+    def close(self) -> None:
+        """Drain the writer and shut the thread down (idempotent)."""
+        try:
+            self.flush()
+        finally:
+            if self._pool is not None:
+                self._pool.shutdown(wait=True)
+                self._pool = None
 
     def maybe_save(self, state: Any, step: int, force: bool = False) -> bool:
         if not force and step % self.every_steps != 0:
@@ -128,5 +158,12 @@ class CheckpointManager:
         host_state = fetch_to_host(state)
         if not self.is_chief:
             return False
-        _write_checkpoint(self.ckpt_dir, host_state, step, keep=self.keep)
+        if self.async_save:
+            self.flush()  # ordered writes + surface prior errors
+            self._pending = self._pool.submit(
+                _write_checkpoint, self.ckpt_dir, host_state, step,
+                self.keep)
+        else:
+            _write_checkpoint(self.ckpt_dir, host_state, step,
+                              keep=self.keep)
         return True

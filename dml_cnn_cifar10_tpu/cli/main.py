@@ -109,6 +109,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "auto-partitioning")
     p.add_argument("--compute_dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--async_checkpoint", type="bool", default=False,
+                   help="serialize+write checkpoints on a background "
+                        "thread (training overlaps the disk IO)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--profile_dir", type=str, default=None)
     p.add_argument("--seed", type=int, default=0)
@@ -141,6 +144,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     # Seed the data stream (shuffle + device-side augmentation draws) from
     # the run seed too — otherwise --seed would not vary augmentation.
     cfg.data.seed = args.seed
+    cfg.async_checkpoint = args.async_checkpoint
     cfg.model.sp_mode = args.sp_mode
     if args.pool is not None:
         cfg.model.pool = args.pool

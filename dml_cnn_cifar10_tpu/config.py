@@ -169,6 +169,10 @@ class TrainConfig:
     log_dir: str = "/tmp/train_logs"      # checkpoint dir (cifar10cnn.py:269-272)
     checkpoint_every: int = 1000          # steps; MTS default was 600s wall-clock
     keep_checkpoints: int = 3
+    # Overlap checkpoint serialize+write with training on a background
+    # writer thread (the device->host fetch stays synchronous — donated
+    # step buffers would otherwise race the reader).
+    async_checkpoint: bool = False
     # Steps per device dispatch. >1 switches the Trainer to the chunked
     # path (parallel/step.py:make_train_chunk): lax.scan over K stacked
     # batches per dispatch, host ships raw uint8, decode/augment fused on

@@ -164,7 +164,8 @@ class Trainer:
             step_fn = self.train_step
 
         ckpt_mgr = ckpt_lib.CheckpointManager(
-            cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints)
+            cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints,
+            async_save=cfg.async_checkpoint)
         timer = StepTimer(cfg.batch_size * k)
         train_loss, test_accuracy = [], []
 
@@ -221,6 +222,7 @@ class Trainer:
             # write (Ctrl-C twice, pool re-sending SIGTERM) can't kill the
             # process before the atomic rename lands.
             ckpt_mgr.maybe_save(state, global_step, force=True)
+            ckpt_mgr.close()  # drain + stop the async writer thread
             prefetch.close()
             if stop:
                 print(f"[preempt] signal {preempt.signum}: checkpointed at "
