@@ -109,6 +109,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "auto-partitioning")
     p.add_argument("--compute_dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--schedule", type=str, default="exponential",
+                   choices=["exponential", "cosine", "constant"],
+                   help="LR schedule family (exponential = reference "
+                        "parity; cosine for the ViT/ResNet ladder)")
+    p.add_argument("--warmup_steps", type=int, default=0,
+                   help="linear LR warmup prepended to any schedule")
+    p.add_argument("--cosine_decay_steps", type=int, default=0,
+                   help="cosine horizon (defaults to total_steps when "
+                        "--schedule cosine and this is 0)")
     p.add_argument("--async_checkpoint", type="bool", default=False,
                    help="serialize+write checkpoints on a background "
                         "thread (training overlaps the disk IO)")
@@ -140,6 +149,11 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.model.compute_dtype = args.compute_dtype
     cfg.optim.learning_rate = args.learning_rate
     cfg.optim.grad_accum = args.grad_accum
+    cfg.optim.schedule = args.schedule
+    cfg.optim.warmup_steps = args.warmup_steps
+    cfg.optim.cosine_decay_steps = args.cosine_decay_steps
+    if args.schedule == "cosine" and not args.cosine_decay_steps:
+        cfg.optim.cosine_decay_steps = cfg.total_steps
     cfg.steps_per_dispatch = args.steps_per_dispatch
     # Seed the data stream (shuffle + device-side augmentation draws) from
     # the run seed too — otherwise --seed would not vary augmentation.

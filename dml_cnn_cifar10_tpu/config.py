@@ -124,6 +124,14 @@ class OptimConfig:
     dead_lr_decay: bool = True
     momentum: float = 0.0                 # reference uses plain SGD
     weight_decay: float = 0.0
+    # Schedule family: "exponential" is the reference's (with the
+    # dead_lr_decay fidelity switch above); "cosine" (half-cosine to 0
+    # over cosine_decay_steps) is the ViT/ResNet ladder standard;
+    # "constant" is flat. warmup_steps prepends a linear ramp to any of
+    # them.
+    schedule: str = "exponential"         # exponential | cosine | constant
+    warmup_steps: int = 0
+    cosine_decay_steps: int = 0
     grad_clip_norm: Optional[float] = None
     # Gradient accumulation: split each global batch into this many
     # microbatches inside the compiled step (lax.scan), average the grads,

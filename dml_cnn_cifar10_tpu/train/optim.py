@@ -26,16 +26,40 @@ OptState = Dict[str, Any]
 
 
 def learning_rate(cfg: OptimConfig, step: jax.Array) -> jax.Array:
-    """Exponential staircase decay (``tf.train.exponential_decay`` parity).
+    """LR schedule at ``step``.
 
-    faithful (dead_lr_decay): the decay argument is frozen at 0 →
-    constant base LR, exactly the reference's runtime behavior.
+    ``exponential`` (reference parity): ``tf.train.exponential_decay``
+    staircase; faithful (dead_lr_decay) freezes the decay argument at 0 →
+    constant base LR, exactly the reference's runtime behavior
+    (``cifar10cnn.py:161,216``).
+    ``cosine``: half-cosine from base LR to 0 over ``cosine_decay_steps``
+    (the ViT/ResNet ladder standard). ``constant``: base LR.
+    Any schedule composes with a linear ``warmup_steps`` ramp.
     """
-    decay_steps = jnp.where(cfg.dead_lr_decay, 0, step).astype(jnp.float32)
-    exponent = decay_steps / cfg.decay_every
-    if cfg.staircase:
-        exponent = jnp.floor(exponent)
-    return cfg.learning_rate * cfg.lr_decay ** exponent
+    stepf = step.astype(jnp.float32)
+    if cfg.schedule == "exponential":
+        decay_steps = jnp.where(cfg.dead_lr_decay, 0.0, stepf)
+        exponent = decay_steps / cfg.decay_every
+        if cfg.staircase:
+            exponent = jnp.floor(exponent)
+        lr = cfg.learning_rate * cfg.lr_decay ** exponent
+    elif cfg.schedule == "cosine":
+        if cfg.cosine_decay_steps <= cfg.warmup_steps:
+            raise ValueError(
+                f"cosine schedule needs cosine_decay_steps "
+                f"({cfg.cosine_decay_steps}) > warmup_steps "
+                f"({cfg.warmup_steps}); otherwise the LR collapses to 0 "
+                f"right after warmup")
+        horizon = cfg.cosine_decay_steps - cfg.warmup_steps
+        prog = jnp.clip((stepf - cfg.warmup_steps) / horizon, 0.0, 1.0)
+        lr = cfg.learning_rate * 0.5 * (1.0 + jnp.cos(jnp.pi * prog))
+    elif cfg.schedule == "constant":
+        lr = jnp.asarray(cfg.learning_rate, jnp.float32)
+    else:
+        raise ValueError(f"unknown schedule {cfg.schedule!r}")
+    if cfg.warmup_steps > 0:
+        lr = lr * jnp.clip((stepf + 1.0) / cfg.warmup_steps, 0.0, 1.0)
+    return lr
 
 
 def sgd_init(params: Any, cfg: OptimConfig) -> OptState:

@@ -23,6 +23,7 @@ from dml_cnn_cifar10_tpu.utils.platform import force_cpu
 force_cpu()
 task_index, n_procs, port, data_dir, log_dir = (
     int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4], sys.argv[5])
+steps_per_dispatch = int(sys.argv[6]) if len(sys.argv) > 6 else 1
 import jax
 
 from dml_cnn_cifar10_tpu.config import TrainConfig, DataConfig
@@ -36,6 +37,7 @@ assert jax.process_count() == n_procs
 cfg = TrainConfig(
     batch_size=32, total_steps=8, output_every=4, eval_every=8,
     checkpoint_every=8, log_dir=log_dir,
+    steps_per_dispatch=steps_per_dispatch,
     data=DataConfig(dataset="synthetic", data_dir=data_dir,
                     synthetic_train_records=256, synthetic_test_records=64,
                     normalize="scale", use_native_loader=False),
@@ -67,6 +69,17 @@ def _free_port() -> int:
 def test_two_process_distributed_training(tmp_path, data_cfg):
     """Two OS processes, one SPMD program: both finish all steps, agree on
     the (replicated) loss, and the chief writes the only checkpoint."""
+    _run_two_process(tmp_path, data_cfg, steps_per_dispatch=1)
+
+
+def test_two_process_chunked_dispatch(tmp_path, data_cfg):
+    """Same, on the chunked path: each process feeds raw uint8 chunk
+    shards via make_array_from_process_local_data with a leading K dim,
+    decode runs on device."""
+    _run_two_process(tmp_path, data_cfg, steps_per_dispatch=4)
+
+
+def _run_two_process(tmp_path, data_cfg, steps_per_dispatch):
     n = 2
     port = _free_port()
     data_dir = str(tmp_path / "data")
@@ -87,7 +100,7 @@ def test_two_process_distributed_training(tmp_path, data_cfg):
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(i), str(n), str(port),
-             data_dir, log_dir],
+             data_dir, log_dir, str(steps_per_dispatch)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=REPO)
         for i in range(n)

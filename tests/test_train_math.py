@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dml_cnn_cifar10_tpu.config import OptimConfig
 from dml_cnn_cifar10_tpu.train import loss as loss_lib
@@ -104,3 +105,37 @@ def test_optax_equivalence(rng):
                                    params, cfg)
     np.testing.assert_allclose(np.asarray(via_optax["w"]),
                                np.asarray(ours["w"]), rtol=1e-6)
+
+
+def test_cosine_schedule_with_warmup():
+    cfg = OptimConfig(learning_rate=1.0, schedule="cosine",
+                      warmup_steps=10, cosine_decay_steps=110)
+    lr = lambda s: float(optim_lib.learning_rate(cfg, jnp.asarray(s)))
+    assert lr(0) == pytest.approx(0.1)            # ramp: (0+1)/10
+    assert lr(9) == pytest.approx(1.0)            # warmup done
+    assert lr(10) == pytest.approx(1.0)           # cosine start
+    assert lr(60) == pytest.approx(0.5, abs=0.02) # halfway
+    assert lr(110) == pytest.approx(0.0, abs=1e-6)
+    assert lr(200) == pytest.approx(0.0, abs=1e-6)  # clamps past horizon
+
+
+def test_constant_and_exponential_schedules_unchanged():
+    const = OptimConfig(learning_rate=0.3, schedule="constant",
+                        warmup_steps=0)
+    assert float(optim_lib.learning_rate(const, jnp.asarray(999))) == \
+        pytest.approx(0.3)
+    # Reference faithful mode: dead decay -> constant 0.1 at any step.
+    ref = OptimConfig()
+    assert float(optim_lib.learning_rate(ref, jnp.asarray(5000))) == \
+        pytest.approx(0.1)
+    # Fixed mode: staircase decay really decays.
+    fixed = OptimConfig(dead_lr_decay=False)
+    assert float(optim_lib.learning_rate(fixed, jnp.asarray(250))) == \
+        pytest.approx(0.09)
+    with pytest.raises(ValueError, match="cosine_decay_steps"):
+        bad = OptimConfig(schedule="cosine")
+        optim_lib.learning_rate(bad, jnp.asarray(0))
+    with pytest.raises(ValueError, match="warmup"):
+        bad = OptimConfig(schedule="cosine", warmup_steps=500,
+                          cosine_decay_steps=400)
+        optim_lib.learning_rate(bad, jnp.asarray(0))
