@@ -97,6 +97,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe_experts", type=int, default=0,
                    help="experts per MoE block (vit_moe); sharded over "
                         "the model axis (expert parallelism)")
+    p.add_argument("--resident_data", type="bool", default=True,
+                   help="with --steps_per_dispatch >1 on one process, keep "
+                        "the uint8 dataset in HBM and gather on device "
+                        "(needs --use_native_loader false: the C++ pool's "
+                        "bounded-shuffle stream has no index view)")
+    p.add_argument("--use_native_loader", type="bool", default=True,
+                   help="stream batches from the C++ bounded shuffle pool "
+                        "(reference RandomShuffleQueue parity); false uses "
+                        "the NumPy full-permutation pipeline")
     p.add_argument("--steps_per_dispatch", type=int, default=1,
                    help="train steps per device dispatch (lax.scan chunk; "
                         "output/eval/checkpoint cadences must be "
@@ -155,6 +164,8 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     if args.schedule == "cosine" and not args.cosine_decay_steps:
         cfg.optim.cosine_decay_steps = cfg.total_steps
     cfg.steps_per_dispatch = args.steps_per_dispatch
+    cfg.resident_data = args.resident_data
+    cfg.data.use_native_loader = args.use_native_loader
     # Seed the data stream (shuffle + device-side augmentation draws) from
     # the run seed too — otherwise --seed would not vary augmentation.
     cfg.data.seed = args.seed

@@ -112,6 +112,17 @@ class NativeShuffleBatchIterator(pipe.ShuffleBatchIterator):
              cfg.num_channels), np.uint8)
         self._lab_buf = np.empty((batch_size,), np.int32)
 
+    # The C++ pool streams records by VALUE (bounded-shuffle parity with
+    # the reference's RandomShuffleQueue); it has no index view into the
+    # decoded arrays, so the HBM-resident path can't reproduce its stream.
+    supports_index_stream = False
+
+    def next_index_chunk(self, k: int):
+        raise NotImplementedError(
+            "the native bounded-shuffle stream has no index view; use the "
+            "raw-chunk path, or use_native_loader=False for the "
+            "HBM-resident path")
+
     def _fill(self, img_buf: np.ndarray, lab_buf: np.ndarray) -> None:
         """One ``recordio_next_batch`` into caller buffers (shared by the
         per-batch and raw-chunk paths)."""

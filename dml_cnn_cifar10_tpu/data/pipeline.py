@@ -134,6 +134,21 @@ class ShuffleBatchIterator:
         idx = self._next_indices(self.batch_size)
         return Batch(self._finish(self.images[idx]), self.labels[idx])
 
+    # True when next_index_chunk draws from the same stream as
+    # __next__/next_raw_chunk. The native C++ iterator streams records by
+    # value from its bounded pool (no index view), so it sets this False
+    # and the resident data path is gated off (train/loop.py).
+    supports_index_stream = True
+
+    def next_index_chunk(self, k: int) -> np.ndarray:
+        """``[k, B]`` int32 shuffled indices into the local decoded arrays
+        (``self.images``/``self.labels``) — the same stream as
+        ``next_raw_chunk`` minus the gather, for the HBM-resident data path
+        (``parallel/step.py:make_train_chunk_resident``) where the gather
+        runs on device."""
+        idx = self._next_indices(self.batch_size * k)
+        return idx.reshape(k, self.batch_size).astype(np.int32)
+
     def next_raw_chunk(self, k: int) -> Batch:
         """``k`` stacked shuffled batches of RAW uint8 full-size images
         ([k, B, H, W, C] — no crop/cast/normalize) for device-side

@@ -19,6 +19,7 @@ state so parameter memory is updated in place in HBM.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -231,6 +232,41 @@ def make_train_step(
     )
 
 
+def _chunk_body(loss_fn, optim_cfg: OptimConfig,
+                data_cfg: Optional[DataConfig]):
+    """``(state, images [K,B,...], labels [K,B]) -> (state, last-step
+    metrics)`` — the shared scan-over-K-steps math of ``make_train_chunk``
+    and ``make_train_chunk_resident`` (one source of truth).
+
+    With ``data_cfg``, images are RAW uint8 and cast/crop/normalize run
+    on device first — one vectorized op over the whole [K,B,...] chunk
+    BEFORE the scan (uint8 stays a single layout-friendly op, the scan
+    then slices float32). Augmented configs fold the global step into the
+    data seed so every chunk draws fresh crops/flips, deterministically
+    per (seed, step).
+    """
+    one_step = _step_body(loss_fn, optim_cfg)
+    if data_cfg is not None:
+        from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
+
+    def run(state: TrainState, images, labels):
+        if data_cfg is not None:
+            if data_cfg.random_crop or data_cfg.random_flip:
+                key = jax.random.fold_in(jax.random.key(data_cfg.seed),
+                                         state.step)
+                images = device_preprocess(images, data_cfg, key)
+            else:
+                images = device_preprocess(images, data_cfg)
+
+        def body(st, batch):
+            return one_step(st, batch[0], batch[1])
+
+        state, ms = lax.scan(body, state, (images, labels))
+        return state, jax.tree.map(lambda x: x[-1], ms)
+
+    return run
+
+
 def make_train_chunk(
     model_def: ModelDef,
     model_cfg: ModelConfig,
@@ -254,31 +290,8 @@ def make_train_chunk(
     (:func:`~dml_cnn_cifar10_tpu.ops.preprocess.device_preprocess`) — the
     host only shuffles bytes, H2D moves uint8.
     """
-    loss_fn = _forward_loss(model_def, model_cfg, mesh=mesh)
-    if data_cfg is not None:
-        from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
-
-    one_step = _step_body(loss_fn, optim_cfg)
-
-    def chunk(state: TrainState, images, labels):
-        if data_cfg is not None:
-            # One vectorized cast/crop over the whole [K,B,...] chunk BEFORE
-            # the scan: uint8 stays a single layout-friendly op, the scan
-            # then slices float32. Augmented configs fold the global step
-            # into the data seed so every chunk draws fresh crops/flips,
-            # deterministically per (seed, step).
-            if data_cfg.random_crop or data_cfg.random_flip:
-                key = jax.random.fold_in(jax.random.key(data_cfg.seed),
-                                         state.step)
-                images = device_preprocess(images, data_cfg, key)
-            else:
-                images = device_preprocess(images, data_cfg)
-
-        def body(st, batch):
-            return one_step(st, batch[0], batch[1])
-
-        state, ms = lax.scan(body, state, (images, labels))
-        return state, jax.tree.map(lambda x: x[-1], ms)
+    chunk = _chunk_body(
+        _forward_loss(model_def, model_cfg, mesh=mesh), optim_cfg, data_cfg)
 
     if mesh is None:
         return jax.jit(chunk, donate_argnums=0)
@@ -292,6 +305,59 @@ def make_train_chunk(
         out_shardings=(state_sh, repl),
         donate_argnums=0,
     )
+
+
+def make_train_chunk_resident(
+    model_def: ModelDef,
+    model_cfg: ModelConfig,
+    optim_cfg: OptimConfig,
+    mesh: Mesh,
+    dataset_images: jax.Array,
+    dataset_labels: jax.Array,
+    state_sharding: Optional[TrainState] = None,
+    data_cfg: Optional[DataConfig] = None,
+) -> Callable[[TrainState, jax.Array], Tuple[TrainState, dict]]:
+    """Chunked training against an HBM-resident dataset:
+    ``(state, idx [K, B] int32) -> (new_state, metrics of the LAST step)``.
+
+    The decisive TPU-native data-path move for small-sample workloads: the
+    full uint8 dataset (CIFAR-10 train = 50k x 3073B = 154 MB) lives in
+    HBM once, replicated over the mesh; per chunk the host ships only the
+    shuffled **index** array (K*B int32 = ~10 KB), and the gather, decode,
+    augment, and K training steps all run on device. Eliminates the
+    host-side image gather + 8 MB H2D per chunk that otherwise bound
+    throughput (measured ~8 ms/chunk host vs ~0.1-2 ms/chunk device on the
+    reference CNN).
+
+    ``dataset_images`` [N, H, W, C] uint8 and ``dataset_labels`` [N] int32
+    should be placed replicated on ``mesh`` (``jax.device_put`` with
+    ``mesh_lib.replicated``) before building the step. Same math as
+    ``make_train_chunk`` on the same indices (tests assert it).
+    """
+    if data_cfg is None:
+        # The resident input is ALWAYS raw uint8 from HBM; without a
+        # decode config the model would silently train on 0-255
+        # un-cropped pixels.
+        raise ValueError(
+            "make_train_chunk_resident requires data_cfg (the gathered "
+            "dataset rows are raw uint8 and must be decoded on device)")
+    body = _chunk_body(
+        _forward_loss(model_def, model_cfg, mesh=mesh), optim_cfg, data_cfg)
+
+    def chunk(dataset_images, dataset_labels, state: TrainState, idx):
+        # Device-side gather: [K, B] indices into the HBM-resident arrays.
+        return body(state, dataset_images[idx], dataset_labels[idx])
+
+    repl = mesh_lib.replicated(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
+    idx_sh = mesh_lib.batch_sharding(mesh, 2, leading_dims=1)
+    jitted = jax.jit(
+        chunk,
+        in_shardings=(repl, repl, state_sh, idx_sh),
+        out_shardings=(state_sh, repl),
+        donate_argnums=2,
+    )
+    return functools.partial(jitted, dataset_images, dataset_labels)
 
 
 def _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh: Mesh):

@@ -146,10 +146,32 @@ class Trainer:
         # stream over the same decoded arrays (no second decode).
         acc_it = train_it.clone(seed=cfg.seed + 7 + shard)
         k = self.steps_per_dispatch
-        if k > 1:
-            # Chunked path: the host's only per-dispatch work is gathering
-            # raw uint8 bytes; decode/augment runs on device inside the
-            # compiled chunk (ops/preprocess.py).
+        resident = (k > 1 and cfg.resident_data and num_shards == 1
+                    and getattr(train_it, "supports_index_stream", False)
+                    and train_it.images.nbytes <= cfg.resident_data_max_bytes)
+        if resident:
+            # HBM-resident data path: dataset lives on device, the host
+            # ships only shuffled index arrays; gather+decode+K steps are
+            # one dispatch (parallel/step.py:make_train_chunk_resident).
+            repl = mesh_lib.replicated(self.mesh)
+            chunk_fn = step_lib.make_train_chunk_resident(
+                self.model_def, cfg.model, cfg.optim, self.mesh,
+                jax.device_put(train_it.images, repl),
+                jax.device_put(train_it.labels.astype(np.int32), repl),
+                state_sharding=self.state_sharding, data_cfg=cfg.data)
+            idx_sh = mesh_lib.batch_sharding(self.mesh, 2, leading_dims=1)
+
+            def produce():
+                return (jax.device_put(train_it.next_index_chunk(k),
+                                       idx_sh),)
+
+            prefetch = pipe.PrefetchIterator(
+                iter(produce, None), depth=cfg.data.prefetch, place=None)
+            step_fn = chunk_fn
+        elif k > 1:
+            # Host-fed chunked path (multi-host, or dataset too big for
+            # HBM): the host gathers raw uint8 bytes; decode/augment runs
+            # on device inside the compiled chunk (ops/preprocess.py).
             def produce():
                 b = train_it.next_raw_chunk(k)
                 return mesh_lib.shard_batch(self.mesh, b.images, b.labels,
@@ -179,8 +201,7 @@ class Trainer:
         n_dispatch = 0
         with PreemptionGuard() as preempt, profile_trace(cfg.profile_dir):
             while global_step < total_steps and not stop:
-                images, labels = next(prefetch)
-                state, metrics = step_fn(state, images, labels)
+                state, metrics = step_fn(state, *next(prefetch))
                 global_step += k
                 timer.tick()
 
