@@ -76,16 +76,19 @@ def main() -> None:
         iter(next_idx, None), depth=cfg.data.prefetch, place=None)
 
     # Warmup: first call compiles (~20-40s), more to fill the pipeline.
+    # Drain with device_get, NOT block_until_ready: on the tunneled TPU
+    # platform block_until_ready can return before the execution queue
+    # drains, which would inflate the measurement ~16x.
     for _ in range(3):
         state, metrics = chunk(state, next(prefetch))
-    jax.block_until_ready(metrics["loss"])
+    float(jax.device_get(metrics["loss"]))
 
     # Timed steady state.
     chunks = 200
     t0 = time.perf_counter()
     for _ in range(chunks):
         state, metrics = chunk(state, next(prefetch))
-    jax.block_until_ready(metrics["loss"])
+    float(jax.device_get(metrics["loss"]))  # full drain: loss of the last step
     dt = time.perf_counter() - t0
     steps = chunks * chunk_k
     prefetch.close()

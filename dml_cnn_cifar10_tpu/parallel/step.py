@@ -19,6 +19,7 @@ state so parameter memory is updated in place in HBM.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
@@ -360,6 +361,124 @@ def make_train_chunk_resident(
     return functools.partial(jitted, dataset_images, dataset_labels)
 
 
+def _eval_logits_fn(model_def: ModelDef, model_cfg: ModelConfig, mesh):
+    mesh_kwargs = {"mesh": mesh} if (model_def.wants_mesh and
+                                     mesh is not None) else {}
+
+    def logits_fn(state: TrainState, images):
+        if model_def.has_state:
+            logits, _ = model_def.apply(state.params, state.model_state,
+                                        images, model_cfg, train=False)
+        elif model_def.has_aux:
+            logits, _ = model_def.apply(state.params, images, model_cfg,
+                                        train=False, **mesh_kwargs)
+        else:
+            logits = model_def.apply(state.params, images, model_cfg,
+                                     train=False, **mesh_kwargs)
+        return logits
+
+    return logits_fn
+
+
+def make_eval_resident(
+    model_def: ModelDef,
+    model_cfg: ModelConfig,
+    mesh: Mesh,
+    images_u8,
+    labels,
+    data_cfg: DataConfig,
+    state_sharding: Optional[TrainState] = None,
+    batch_size: int = 128,
+):
+    """Full-split eval in ONE dispatch against an HBM-resident split:
+    returns ``(fn, total)`` with ``fn(state) -> correct count`` (device
+    scalar) over all ``total`` real records.
+
+    The split is padded to a whole number of batches (pad labels -1 ⇒ 0
+    correct, mirroring ``full_sweep_padded``), reshaped ``[M, B, ...]``,
+    and placed once; eval is a ``lax.scan`` of decode→forward→count over
+    the M batches. Replaces M host-fed eval dispatches + M device→host
+    fetches per eval with one dispatch + one fetch — decisive when
+    host↔device round trips are ~100 ms (remote-tunnel TPU).
+    """
+    import numpy as np
+
+    from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
+
+    n = images_u8.shape[0]
+    m = -(-n // batch_size)
+    pad = m * batch_size - n
+    if pad:
+        images_u8 = np.concatenate(
+            [images_u8, np.zeros((pad, *images_u8.shape[1:]),
+                                 images_u8.dtype)])
+        labels = np.concatenate([labels, np.full((pad,), -1, labels.dtype)])
+    ims = images_u8.reshape(m, batch_size, *images_u8.shape[1:])
+    lbs = labels.reshape(m, batch_size).astype(np.int32)
+
+    logits_fn = _eval_logits_fn(model_def, model_cfg, mesh)
+    eval_cfg = _eval_data_cfg(data_cfg)
+
+    def ev(ims, lbs, state: TrainState):
+        def body(total, batch):
+            images = device_preprocess(batch[0], eval_cfg)
+            logits = logits_fn(state, images)
+            return total + metrics_lib.correct_count(logits, batch[1]), None
+
+        total, _ = lax.scan(body, jnp.zeros((), jnp.int32), (ims, lbs))
+        return total
+
+    repl = mesh_lib.replicated(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
+    data_sh = mesh_lib.batch_sharding(mesh, ims.ndim, leading_dims=1)
+    lab_sh = mesh_lib.batch_sharding(mesh, 2, leading_dims=1)
+    jitted = jax.jit(ev, in_shardings=(data_sh, lab_sh, state_sh),
+                     out_shardings=repl)
+    ims_d = jax.device_put(ims, data_sh)
+    lbs_d = jax.device_put(lbs, lab_sh)
+    return functools.partial(jitted, ims_d, lbs_d), n
+
+
+def make_batch_eval_resident(
+    model_def: ModelDef,
+    model_cfg: ModelConfig,
+    mesh: Mesh,
+    dataset_images: jax.Array,
+    dataset_labels: jax.Array,
+    data_cfg: DataConfig,
+    state_sharding: Optional[TrainState] = None,
+):
+    """Single-batch accuracy against an HBM-resident dataset:
+    ``fn(state, idx [B] int32) -> accuracy`` (device scalar). The
+    index-fed mirror of ``make_eval_step`` for the boundary metrics —
+    ~0.5 KB host→device instead of a decoded image batch."""
+    from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
+
+    logits_fn = _eval_logits_fn(model_def, model_cfg, mesh)
+    eval_cfg = _eval_data_cfg(data_cfg)
+
+    def ev(dataset_images, dataset_labels, state: TrainState, idx):
+        images = device_preprocess(dataset_images[idx], eval_cfg)
+        labels = dataset_labels[idx]
+        return metrics_lib.batch_accuracy(logits_fn(state, images), labels)
+
+    repl = mesh_lib.replicated(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
+    jitted = jax.jit(
+        ev,
+        in_shardings=(repl, repl, state_sh,
+                      mesh_lib.batch_sharding(mesh, 1)),
+        out_shardings=repl,
+    )
+    return functools.partial(jitted, dataset_images, dataset_labels)
+
+
+def _eval_data_cfg(data_cfg: DataConfig) -> DataConfig:
+    """Eval-time decode config: deterministic (no random crop/flip)."""
+    return dataclasses.replace(data_cfg, random_crop=False,
+                               random_flip=False)
+
+
 def _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh: Mesh):
     """shard_map form: per-device forward/backward on the local batch shard,
     explicit ``lax.psum`` of gradients — the literal translation of
@@ -406,19 +525,10 @@ def make_eval_step(
     237-241``); ``correct`` is the global summable count for full-test-set
     eval (pad rows labeled -1 contribute 0)."""
 
-    mesh_kwargs = {"mesh": mesh} if (model_def.wants_mesh and
-                                     mesh is not None) else {}
+    logits_fn = _eval_logits_fn(model_def, model_cfg, mesh)
 
     def step(state: TrainState, images, labels):
-        if model_def.has_state:
-            logits, _ = model_def.apply(state.params, state.model_state,
-                                        images, model_cfg, train=False)
-        elif model_def.has_aux:
-            logits, _ = model_def.apply(state.params, images, model_cfg,
-                                        train=False, **mesh_kwargs)
-        else:
-            logits = model_def.apply(state.params, images, model_cfg,
-                                     train=False, **mesh_kwargs)
+        logits = logits_fn(state, images)
         return {
             "accuracy": metrics_lib.batch_accuracy(logits, labels),
             "correct": metrics_lib.correct_count(logits, labels),

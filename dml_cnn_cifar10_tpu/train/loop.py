@@ -23,6 +23,7 @@ import dataclasses
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dml_cnn_cifar10_tpu import ckpt as ckpt_lib
@@ -85,6 +86,11 @@ class Trainer:
             self.model_def, cfg.model, self.mesh,
             state_sharding=self.state_sharding)
         self.logger = MetricsLogger(cfg.metrics_jsonl, task_index=task_index)
+        # Resident-eval fns; built per-fit when the resident path is active.
+        self._resident_full_eval = None
+        self._resident_test_eval = None
+        self._resident_acc_eval = None
+        self._idx1_sharding = None
 
     def init_or_restore(self) -> step_lib.TrainState:
         key = jax.random.key(self.cfg.seed)
@@ -103,18 +109,27 @@ class Trainer:
         """Faithful: accuracy on ONE shuffled test batch
         (``cifar10cnn.py:202,238``); fixed: full-split sweep.
 
-        The sweep uses fixed-shape padded batches (pad label -1 ⇒ 0 correct)
-        so every process issues the same number of collective eval steps,
-        and the global correct count divides the pre-shard record total —
-        correct under any process/shard layout."""
-        if not self.cfg.eval_full_test_set:
-            m = self.eval_step(state, *self._placed(next(test_it)))
-            return float(m["accuracy"])
-        correct = 0
-        for batch in test_it.full_sweep_padded():
-            m = self.eval_step(state, *self._placed(batch))
-            correct += int(m["correct"])
-        return correct / max(test_it.total_records, 1)
+        On the resident path (set up by ``fit``) the whole test split
+        lives in HBM and either mode is one dispatch + one fetch. The
+        host-fed sweep uses fixed-shape padded batches (pad label -1 ⇒ 0
+        correct) so every process issues the same number of collective
+        eval steps — correct under any process/shard layout."""
+        if self.cfg.eval_full_test_set:
+            if self._resident_full_eval is not None:
+                fn, total = self._resident_full_eval
+                return int(jax.device_get(fn(state))) / max(total, 1)
+            correct = 0
+            for batch in test_it.full_sweep_padded():
+                m = self.eval_step(state, *self._placed(batch))
+                correct += int(m["correct"])
+            return correct / max(test_it.total_records, 1)
+        if self._resident_test_eval is not None:
+            idx = jax.device_put(test_it.next_index_chunk(1)[0],
+                                 self._idx1_sharding)
+            return float(jax.device_get(self._resident_test_eval(state,
+                                                                 idx)))
+        m = self.eval_step(state, *self._placed(next(test_it)))
+        return float(m["accuracy"])
 
     def fit(self, total_steps: Optional[int] = None,
             state: Optional[step_lib.TrainState] = None) -> TrainResult:
@@ -136,12 +151,36 @@ class Trainer:
         num_shards = jax.process_count()
         shard = jax.process_index()
         per_process_batch = cfg.batch_size // num_shards
+        # Resident-eval fns are fit-scoped: reset so a prior fit's
+        # closures (bound to THAT run's iterators and HBM-pinned splits)
+        # can't leak into this one or into standalone evaluate() calls.
+        self._resident_full_eval = None
+        self._resident_test_eval = None
+        self._resident_acc_eval = None
+        train_data_cfg = cfg.data
+        if (self.steps_per_dispatch > 1 and cfg.resident_data
+                and num_shards == 1 and cfg.data.use_native_loader):
+            # The HBM-resident path needs the index view only the
+            # in-memory permutation iterator provides; the native C++
+            # stream would silently force the ~90x-slower host-fed chunk
+            # path. Resident wins: build the train iterator non-native.
+            train_data_cfg = dataclasses.replace(cfg.data,
+                                                 use_native_loader=False)
         train_it = pipe.input_pipeline(
-            cfg.data, per_process_batch, train=True,
+            train_data_cfg, per_process_batch, train=True,
             seed=cfg.seed + shard, shard=shard, num_shards=num_shards)
+        if (train_data_cfg is not cfg.data
+                and train_it.images.nbytes > cfg.resident_data_max_bytes):
+            # Dataset turned out to exceed the HBM-resident cap: losing
+            # the native loader AND the resident path would be strictly
+            # worse than doing nothing, so rebuild the native stream.
+            train_data_cfg = cfg.data
+            train_it = pipe.input_pipeline(
+                train_data_cfg, per_process_batch, train=True,
+                seed=cfg.seed + shard, shard=shard, num_shards=num_shards)
         test_it = pipe.input_pipeline(
-            cfg.data, per_process_batch, train=False, seed=cfg.seed + shard,
-            shard=shard, num_shards=num_shards)
+            train_data_cfg, per_process_batch, train=False,
+            seed=cfg.seed + shard, shard=shard, num_shards=num_shards)
         # Fresh-batch train accuracy (cifar10cnn.py:235) — an independent
         # stream over the same decoded arrays (no second decode).
         acc_it = train_it.clone(seed=cfg.seed + 7 + shard)
@@ -154,12 +193,37 @@ class Trainer:
             # ships only shuffled index arrays; gather+decode+K steps are
             # one dispatch (parallel/step.py:make_train_chunk_resident).
             repl = mesh_lib.replicated(self.mesh)
+            ds_images = jax.device_put(train_it.images, repl)
+            ds_labels = jax.device_put(train_it.labels.astype(np.int32),
+                                       repl)
             chunk_fn = step_lib.make_train_chunk_resident(
                 self.model_def, cfg.model, cfg.optim, self.mesh,
-                jax.device_put(train_it.images, repl),
-                jax.device_put(train_it.labels.astype(np.int32), repl),
+                ds_images, ds_labels,
                 state_sharding=self.state_sharding, data_cfg=cfg.data)
             idx_sh = mesh_lib.batch_sharding(self.mesh, 2, leading_dims=1)
+            # Eval also goes resident: boundary train-accuracy is index-fed
+            # from the in-HBM train split, test eval is one dispatch over
+            # the in-HBM test split — each boundary costs ONE host↔device
+            # round trip instead of a decoded-batch H2D + per-batch
+            # fetches (decisive when the device link is a ~100 ms-RTT
+            # tunnel).
+            self._idx1_sharding = mesh_lib.batch_sharding(self.mesh, 1)
+            self._resident_acc_eval = step_lib.make_batch_eval_resident(
+                self.model_def, cfg.model, self.mesh, ds_images, ds_labels,
+                cfg.data, state_sharding=self.state_sharding)
+            if cfg.eval_full_test_set:
+                self._resident_full_eval = step_lib.make_eval_resident(
+                    self.model_def, cfg.model, self.mesh, test_it.images,
+                    test_it.labels, cfg.data,
+                    state_sharding=self.state_sharding,
+                    batch_size=per_process_batch)
+            else:
+                t_images = jax.device_put(test_it.images, repl)
+                t_labels = jax.device_put(test_it.labels.astype(np.int32),
+                                          repl)
+                self._resident_test_eval = step_lib.make_batch_eval_resident(
+                    self.model_def, cfg.model, self.mesh, t_images,
+                    t_labels, cfg.data, state_sharding=self.state_sharding)
 
             def produce():
                 return (jax.device_put(train_it.next_index_chunk(k),
@@ -206,10 +270,20 @@ class Trainer:
                 timer.tick()
 
                 if (i + k) % cfg.output_every == 0:
-                    loss = float(jax.device_get(metrics["loss"]))
+                    # Fresh-batch train accuracy (cifar10cnn.py:235), then
+                    # ONE fused device->host fetch for loss+accuracy.
+                    if self._resident_acc_eval is not None:
+                        aidx = jax.device_put(acc_it.next_index_chunk(1)[0],
+                                              self._idx1_sharding)
+                        acc_arr = self._resident_acc_eval(state, aidx)
+                    else:
+                        acc_arr = self.eval_step(
+                            state, *self._placed(next(acc_it)))["accuracy"]
+                    pair = jax.device_get(
+                        jnp.stack([metrics["loss"],
+                                   jnp.asarray(acc_arr, jnp.float32)]))
+                    loss, acc = float(pair[0]), float(pair[1])
                     train_loss.append(loss)
-                    acc = float(self.eval_step(
-                        state, *self._placed(next(acc_it)))["accuracy"])
                     self.logger.train_print(global_step, i + k - 1, acc)
                     self.logger.log("train", step=global_step, loss=loss,
                                     train_accuracy=acc,
@@ -252,13 +326,34 @@ class Trainer:
                                 signum=preempt.signum)
             self.logger.log("done", step=global_step,
                             images_per_sec=timer.images_per_sec)
+        # Release the fit-scoped resident closures — their partials pin
+        # the train/test splits in HBM.
+        self._resident_full_eval = None
+        self._resident_test_eval = None
+        self._resident_acc_eval = None
         return TrainResult(global_step, train_loss, test_accuracy,
                            timer.images_per_sec, state, preempted=stop)
 
 
 def _current_lr(cfg: TrainConfig, step: int) -> float:
-    from dml_cnn_cifar10_tpu.train import optim as optim_lib
-    import jax.numpy as jnp
-    return float(optim_lib.learning_rate(cfg.optim, jnp.asarray(step)))
+    """Host-math mirror of ``optim.learning_rate`` for the metrics log —
+    a device dispatch + fetch here would cost a full link round trip per
+    boundary. ``test_train_math.py`` pins it equal to the jnp version."""
+    import math
+    o = cfg.optim
+    if o.schedule == "exponential":
+        e = 0.0 if o.dead_lr_decay else step / o.decay_every
+        if o.staircase:
+            e = math.floor(e)
+        lr = o.learning_rate * o.lr_decay ** e
+    elif o.schedule == "cosine":
+        horizon = max(o.cosine_decay_steps - o.warmup_steps, 1)
+        prog = min(max((step - o.warmup_steps) / horizon, 0.0), 1.0)
+        lr = o.learning_rate * 0.5 * (1.0 + math.cos(math.pi * prog))
+    else:
+        lr = o.learning_rate
+    if o.warmup_steps > 0:
+        lr *= min((step + 1.0) / o.warmup_steps, 1.0)
+    return lr
 
 

@@ -72,6 +72,26 @@ def test_device_random_crop(rng):
         assert (np.diff(d, axis=1) % 256 == 1).all()  # contiguous cols
 
 
+def test_device_random_crop_with_fused_flip(rng):
+    """Crop+flip fused into the column-selection matmul: every output is
+    a contiguous window read forward or backward."""
+    import jax
+
+    cfg = DataConfig(random_crop=True, random_flip=True, normalize="none")
+    coord = (np.arange(32)[:, None] * 32 + np.arange(32)[None, :])
+    imgs = np.broadcast_to(
+        np.repeat(coord[None, :, :, None], 3, axis=3), (64, 32, 32, 3)
+    ).astype(np.uint8)
+    out = np.asarray(device_preprocess(imgs, cfg, jax.random.key(0)))
+    assert out.shape == (64, 24, 24, 3)
+    dirs = set()
+    for i in range(64):
+        d = np.diff(out[i, :, :, 0], axis=1) % 256
+        assert (d == 1).all() or (d == 255).all()  # forward or mirrored
+        dirs.add(int(d[0, 0]))
+    assert dirs == {1, 255}  # both orientations occur across 64 images
+
+
 def test_device_random_flip(rng):
     import jax
 

@@ -139,3 +139,26 @@ def test_constant_and_exponential_schedules_unchanged():
         bad = OptimConfig(schedule="cosine", warmup_steps=500,
                           cosine_decay_steps=400)
         optim_lib.learning_rate(bad, jnp.asarray(0))
+
+
+def test_host_lr_mirror_matches_device():
+    """train/loop._current_lr (host math, logging) == optim.learning_rate
+    (device math) across schedules and steps."""
+    from dml_cnn_cifar10_tpu.config import TrainConfig
+    from dml_cnn_cifar10_tpu.train.loop import _current_lr
+
+    cfgs = [
+        OptimConfig(),
+        OptimConfig(dead_lr_decay=False),
+        OptimConfig(schedule="constant", learning_rate=0.02),
+        OptimConfig(schedule="cosine", warmup_steps=10,
+                    cosine_decay_steps=110, learning_rate=0.5),
+        OptimConfig(dead_lr_decay=False, staircase=False, warmup_steps=5),
+    ]
+    for o in cfgs:
+        t = TrainConfig()
+        t.optim = o
+        for step in (0, 1, 9, 10, 60, 249, 250, 251, 1000):
+            host = _current_lr(t, step)
+            dev = float(optim_lib.learning_rate(o, jnp.asarray(step)))
+            assert host == pytest.approx(dev, rel=1e-6), (o.schedule, step)
