@@ -101,3 +101,14 @@ def test_trainer_chunked_dispatch_native_loader(data_cfg, tmp_path):
     result = Trainer(cfg).fit()
     assert result.final_step == 20
     assert np.isfinite(result.train_loss).all()
+
+
+def test_trainer_bfloat16_compute(data_cfg, tmp_path):
+    """compute_dtype=bfloat16 (the TPU-native activations dtype, exposed
+    as --compute_dtype) trains end-to-end and learns."""
+    cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=30)
+    cfg.model.compute_dtype = "bfloat16"
+    result = Trainer(cfg).fit()
+    assert result.final_step == 30
+    assert np.isfinite(result.train_loss).all()
+    assert result.test_accuracy[-1] > 0.15

@@ -1,0 +1,85 @@
+"""Resident (one-dispatch) eval == the host-fed padded sweep, exactly."""
+
+import jax
+import numpy as np
+
+from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                        ParallelConfig)
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+
+def test_resident_full_eval_matches_host_sweep(rng):
+    model_def = get_model("cnn")
+    model_cfg = ModelConfig(logit_relu=False)
+    data_cfg = DataConfig(normalize="scale")
+    mesh = mesh_lib.build_mesh(ParallelConfig())
+
+    n = 200  # NOT a multiple of the batch: exercises the -1 padding
+    images = rng.integers(0, 256, (n, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, n).astype(np.int32)
+
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, model_cfg, data_cfg,
+        OptimConfig(), mesh)
+
+    fn, total = step_lib.make_eval_resident(
+        model_def, model_cfg, mesh, images, labels, data_cfg, batch_size=64)
+    assert total == n
+    resident_correct = int(jax.device_get(fn(state)))
+
+    # Host decode + batched eval_step over the same split.
+    from dml_cnn_cifar10_tpu.data import records as rec
+    ev = step_lib.make_eval_step(model_def, model_cfg, mesh)
+    host_correct = 0
+    for start in range(0, n, 64):
+        ims = rec.normalize(
+            rec.center_crop(images[start:start + 64].astype(np.float32),
+                            data_cfg.crop_height, data_cfg.crop_width),
+            data_cfg.normalize)
+        lbs = labels[start:start + 64]
+        pad = 64 - ims.shape[0]
+        if pad:
+            ims = np.concatenate([ims, np.zeros((pad, *ims.shape[1:]),
+                                                np.float32)])
+            lbs = np.concatenate([lbs, np.full((pad,), -1, np.int32)])
+        im, lb = mesh_lib.shard_batch(mesh, ims, lbs)
+        host_correct += int(jax.device_get(ev(state, im, lb)["correct"]))
+
+    assert resident_correct == host_correct
+    assert 0 <= resident_correct <= n
+
+
+def test_batch_eval_resident_matches_eval_step(rng):
+    model_def = get_model("cnn")
+    model_cfg = ModelConfig(logit_relu=False)
+    data_cfg = DataConfig(normalize="scale")
+    mesh = mesh_lib.build_mesh(ParallelConfig())
+
+    n, b = 256, 32
+    images = rng.integers(0, 256, (n, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, n).astype(np.int32)
+    idx = rng.integers(0, n, b).astype(np.int32)
+
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, model_cfg, data_cfg,
+        OptimConfig(), mesh)
+
+    repl = mesh_lib.replicated(mesh)
+    fn = step_lib.make_batch_eval_resident(
+        model_def, model_cfg, mesh, jax.device_put(images, repl),
+        jax.device_put(labels, repl), data_cfg)
+    acc_resident = float(jax.device_get(
+        fn(state, jax.device_put(idx, mesh_lib.batch_sharding(mesh, 1)))))
+
+    from dml_cnn_cifar10_tpu.data import records as rec
+    ims = rec.normalize(
+        rec.center_crop(images[idx].astype(np.float32),
+                        data_cfg.crop_height, data_cfg.crop_width),
+        data_cfg.normalize)
+    ev = step_lib.make_eval_step(model_def, model_cfg, mesh)
+    im, lb = mesh_lib.shard_batch(mesh, ims, labels[idx])
+    acc_host = float(jax.device_get(ev(state, im, lb)["accuracy"]))
+
+    np.testing.assert_allclose(acc_resident, acc_host, atol=1e-6)
