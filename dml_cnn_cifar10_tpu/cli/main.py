@@ -97,6 +97,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe_experts", type=int, default=0,
                    help="experts per MoE block (vit_moe); sharded over "
                         "the model axis (expert parallelism)")
+    p.add_argument("--moe_top_k", type=int, default=1,
+                   help="experts per token: 1 = Switch, 2 = GShard")
     p.add_argument("--resident_data", type="bool", default=True,
                    help="with --steps_per_dispatch >1 on one process, keep "
                         "the uint8 dataset in HBM and gather on device "
@@ -118,6 +120,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "auto-partitioning")
     p.add_argument("--compute_dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--momentum", type=float, default=0.0,
+                   help="SGD momentum (reference uses plain SGD)")
+    p.add_argument("--weight_decay", type=float, default=0.0)
+    p.add_argument("--grad_clip_norm", type=float, default=None,
+                   help="global-norm gradient clipping")
     p.add_argument("--schedule", type=str, default="exponential",
                    choices=["exponential", "cosine", "constant"],
                    help="LR schedule family (exponential = reference "
@@ -158,6 +165,9 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.model.compute_dtype = args.compute_dtype
     cfg.optim.learning_rate = args.learning_rate
     cfg.optim.grad_accum = args.grad_accum
+    cfg.optim.momentum = args.momentum
+    cfg.optim.weight_decay = args.weight_decay
+    cfg.optim.grad_clip_norm = args.grad_clip_norm
     cfg.optim.schedule = args.schedule
     cfg.optim.warmup_steps = args.warmup_steps
     cfg.optim.cosine_decay_steps = args.cosine_decay_steps
@@ -187,6 +197,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.model.moe_experts = args.moe_experts
     if args.model == "vit_moe" and args.moe_experts == 0:
         cfg.model.moe_experts = 8
+    cfg.model.moe_top_k = args.moe_top_k
     cfg.parallel.explicit_collectives = args.explicit_collectives
     return cfg
 

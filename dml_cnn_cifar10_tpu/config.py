@@ -102,9 +102,11 @@ class ModelConfig:
     # == 0, best MXU utilization at moderate seq degree).
     sp_mode: str = "ring"                 # ring | ulysses
     # Mixture-of-Experts (model name "vit_moe"): every block's MLP becomes
-    # a top-1-routed expert bank (ops/moe.py), experts sharded over the
-    # ``model`` mesh axis (expert parallelism).
+    # a routed expert bank (ops/moe.py) — moe_top_k=1 Switch routing,
+    # 2 GShard — with experts sharded over the ``model`` mesh axis
+    # (expert parallelism).
     moe_experts: int = 0                  # 0 = dense MLP
+    moe_top_k: int = 1                    # 1 = Switch, 2 = GShard routing
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01            # load-balance loss weight
 

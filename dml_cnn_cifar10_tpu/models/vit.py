@@ -116,7 +116,8 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
 
 
 def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
-           capacity_factor: float, mesh=None, sp_mode: str = "ring"):
+           capacity_factor: float, mesh=None, sp_mode: str = "ring",
+           moe_top_k: int = 1):
     """One transformer block → ``(x, aux_loss)`` (aux 0.0 for dense MLP)."""
     b, s, dim = x.shape
     h = layer_norm(x, p["ln1"])
@@ -146,7 +147,8 @@ def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
                     p["proj"]["bias"])
     h = layer_norm(x, p["ln2"])
     if "moe" in p:
-        y, aux = moe_ops.moe_mlp(h, p["moe"], capacity_factor)
+        y, aux = moe_ops.moe_mlp(h, p["moe"], capacity_factor,
+                                 top_k=moe_top_k)
         return x + y, aux
     h = jax.nn.gelu(L.dense(h, p["mlp1"]["kernel"], p["mlp1"]["bias"]))
     return x + L.dense(h, p["mlp2"]["kernel"], p["mlp2"]["bias"]), \
@@ -229,7 +231,8 @@ def apply_with_aux(params: Params, images: jax.Array, cfg: ModelConfig,
             h, block_aux = _block(h, bp, cfg.vit_heads,
                                   cfg.use_pallas_attention,
                                   cfg.moe_capacity_factor, mesh=attn_mesh,
-                                  sp_mode=cfg.sp_mode)
+                                  sp_mode=cfg.sp_mode,
+                                  moe_top_k=cfg.moe_top_k)
             return (h, aux_sum + block_aux), None
 
         (x, aux), _ = lax.scan(body, (x, aux), p["blocks"])

@@ -3,11 +3,11 @@
 No reference counterpart (SURVEY §2.3: expert parallelism absent), built
 TPU-first as the framework's ``ep`` capability:
 
-- **Switch-style top-1 routing** with a **static capacity**: every shape is
-  known at trace time (tokens = B*S, capacity = ceil(T/E · factor)), so the
-  whole layer is dense einsums XLA can tile onto the MXU — no dynamic
-  gather/scatter, no data-dependent shapes (the TPU-idiomatic formulation
-  from the Switch/GShard line of work).
+- **Switch-style top-1 / GShard-style top-2 routing** with a **static
+  capacity**: every shape is known at trace time (tokens = B*S, capacity =
+  ceil(T/E · factor · k)), so the whole layer is dense einsums XLA can
+  tile onto the MXU — no dynamic gather/scatter, no data-dependent shapes
+  (the TPU-idiomatic formulation from the Switch/GShard line of work).
 - **Dispatch/combine as one-hot einsum contractions**: routing becomes
   ``[T,E,C]`` tensors contracted against tokens. With the expert-major
   weights (``w1 [E,D,H]``, ``w2 [E,H,D]``) sharded over ``model`` on the
@@ -51,34 +51,55 @@ def init_moe_params(key: jax.Array, dim: int, hidden: int, num_experts: int,
     }
 
 
-def moe_mlp(x: jax.Array, params: Params, capacity_factor: float
-            ) -> Tuple[jax.Array, jax.Array]:
-    """Top-1 MoE MLP: ``[B,S,D] -> ([B,S,D], aux_loss scalar)``.
+def moe_mlp(x: jax.Array, params: Params, capacity_factor: float,
+            top_k: int = 1) -> Tuple[jax.Array, jax.Array]:
+    """Top-k MoE MLP: ``[B,S,D] -> ([B,S,D], aux_loss scalar)``.
 
-    All shapes static; the expert dim of every einsum below is the sharded
-    (``model``) axis under expert parallelism.
+    ``top_k=1`` is Switch routing (output scaled by the router prob p1);
+    ``top_k=2`` is GShard routing (two experts per token, combine weights
+    p_i renormalized over the chosen pair). All shapes static; the expert
+    dim of every einsum below is the sharded (``model``) axis under
+    expert parallelism. First-choice assignments take queue priority over
+    second choices, so under capacity pressure a token loses its backup
+    expert before anyone loses their primary.
     """
     b, s, d = x.shape
     e = params["w1"].shape[0]
+    if not 1 <= top_k <= e:
+        raise ValueError(f"top_k={top_k} must be in [1, num_experts={e}]")
     t = b * s
-    capacity = max(1, math.ceil(t / e * capacity_factor))
+    capacity = max(1, math.ceil(t / e * capacity_factor * top_k))
 
     tokens = x.reshape(t, d)
     gate_logits = tokens.astype(jnp.float32) @ \
         params["gate"]["kernel"].astype(jnp.float32)          # [T,E]
     probs = jax.nn.softmax(gate_logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)                   # [T]
-    expert_prob = jnp.max(probs, axis=-1)                     # [T]
-    expert_1h = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T,E]
 
-    # Position of each token within its expert's queue (first-come order);
-    # tokens beyond capacity are dropped.
-    position = jnp.cumsum(expert_1h, axis=0) * expert_1h - 1.0    # [T,E]
-    keep = (position >= 0) & (position < capacity)
-    pos_1h = jax.nn.one_hot(position.astype(jnp.int32), capacity,
-                            dtype=jnp.float32) * keep[..., None]
-    dispatch = pos_1h                                          # [T,E,C]
-    combine = dispatch * expert_prob[:, None, None]            # [T,E,C]
+    # Rank the k chosen experts per token (sequential masked argmax —
+    # k is tiny and static, so this unrolls into k dense passes).
+    masked = probs
+    ranks = []                                                # [(1h, prob)]
+    for _ in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)                     # [T]
+        oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)        # [T,E]
+        ranks.append((oh, jnp.sum(masked * oh, axis=-1)))     # prob at idx
+        masked = masked * (1.0 - oh)
+    # Switch keeps the raw p1 scale; GShard renormalizes over the pair.
+    renorm = sum(p for _, p in ranks) if top_k > 1 else \
+        jnp.ones((t,), jnp.float32)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    offset = jnp.zeros((e,), jnp.float32)  # queue slots used by prior ranks
+    for oh, prob in ranks:
+        position = (jnp.cumsum(oh, axis=0) - 1.0 + offset[None, :]) * oh
+        keep = (oh > 0) & (position < capacity)
+        pos_1h = jax.nn.one_hot(position.astype(jnp.int32), capacity,
+                                dtype=jnp.float32) * keep[..., None]
+        dispatch = dispatch + pos_1h
+        combine = combine + pos_1h * (prob / jnp.maximum(renorm, 1e-9)
+                                      )[:, None, None]
+        offset = offset + jnp.sum(oh, axis=0)
 
     cdt = x.dtype
     xe = jnp.einsum("tec,td->ecd", dispatch.astype(cdt), tokens)  # [E,C,D]
@@ -88,8 +109,9 @@ def moe_mlp(x: jax.Array, params: Params, capacity_factor: float
         + params["b2"][:, None, :]                             # [E,C,D]
     y = jnp.einsum("tec,ecd->td", combine.astype(cdt), ye)     # [T,D]
 
-    # Switch load-balance loss: E * sum_e f_e * p_e (scalar, f32).
-    f = jnp.mean(expert_1h, axis=0)                            # [E]
+    # Load-balance loss on FIRST choices (Switch eq. 4 / GShard l_aux):
+    # E * sum_e f_e * p_e.
+    f = jnp.mean(ranks[0][0], axis=0)                          # [E]
     p = jnp.mean(probs, axis=0)                                # [E]
     aux = e * jnp.sum(f * p)
     return y.reshape(b, s, d), aux

@@ -1,4 +1,4 @@
-"""Expert parallelism: Switch-style top-1 MoE (ops/moe.py) + vit_moe.
+"""Expert parallelism: Switch top-1 / GShard top-2 MoE (ops/moe.py) + vit_moe.
 
 Op-level: routing/capacity/aux-loss semantics against a hand-computed
 dense-per-expert reference. Step-level: ep (experts over ``model``) matches
@@ -153,3 +153,108 @@ def test_moe_aux_loss_reaches_training_loss(rng):
     _, loss_on = _run(cfg_on, mesh, images, labels, nsteps=1)
     _, loss_off = _run(cfg_off, mesh, images, labels, nsteps=1)
     assert loss_on[0] > loss_off[0]
+
+
+# ---- top-2 (GShard) routing ----
+
+def test_top2_combines_two_experts():
+    """Ample capacity: each token's output == renormalized-weighted sum of
+    its two highest-prob experts' MLPs."""
+    params = _moe_params()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (2, 8, 8)).astype(np.float32))
+    y, aux = moe.moe_mlp(x, params, capacity_factor=4.0, top_k=2)
+
+    tokens = np.asarray(x).reshape(-1, 8)
+    logits = tokens @ np.asarray(params["gate"]["kernel"])
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    order = np.argsort(-probs, axis=-1)
+    got = np.asarray(y).reshape(-1, 8)
+    for ti in range(tokens.shape[0]):
+        e1, e2 = order[ti, 0], order[ti, 1]
+        p1, p2 = probs[ti, e1], probs[ti, e2]
+        w1, w2 = p1 / (p1 + p2), p2 / (p1 + p2)
+        want = (w1 * np.asarray(_dense_expert(params, e1, tokens[ti]))
+                + w2 * np.asarray(_dense_expert(params, e2, tokens[ti])))
+        np.testing.assert_allclose(got[ti], want, rtol=2e-4, atol=2e-5)
+    assert np.isfinite(float(aux))
+
+
+def test_top2_first_choice_priority_under_pressure():
+    """Capacity exactly fits the first choices: EVERY rank-0 assignment
+    survives and EVERY rank-1 assignment drops — the 'a token loses its
+    backup expert before anyone loses their primary' invariant.
+
+    Construction: 32 tokens, 16 route (e0 first, e1 second), 16 route
+    (e1 first, e0 second) via a crafted gate; capacity_factor=1.0 with
+    top_k=2 gives capacity 16 per expert — exactly the rank-0 load."""
+    params = _moe_params()
+    s = 8.0
+    gate = np.zeros((8, 4), np.float32)
+    gate[0, 0] = s   # expert 0 keyed on feature 0
+    gate[1, 1] = s   # expert 1 keyed on feature 1
+    gate[0, 2] = gate[1, 2] = gate[0, 3] = gate[1, 3] = -s  # never chosen
+    params = dict(params, gate={"kernel": jnp.asarray(gate)})
+
+    x = np.zeros((1, 32, 8), np.float32)
+    x[0, 0::2, 0], x[0, 0::2, 1] = 2.0, 1.0   # group A: e0 then e1
+    x[0, 1::2, 0], x[0, 1::2, 1] = 1.0, 2.0   # group B: e1 then e0
+    y, _ = moe.moe_mlp(jnp.asarray(x), params, capacity_factor=1.0,
+                       top_k=2)
+
+    tokens = x.reshape(-1, 8)
+    logits = tokens @ gate
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    got = np.asarray(y).reshape(-1, 8)
+    for ti in range(32):
+        e1 = int(np.argsort(-probs[ti])[0])
+        e2 = int(np.argsort(-probs[ti])[1])
+        w1 = probs[ti, e1] / (probs[ti, e1] + probs[ti, e2])
+        # Rank-0 contribution present, rank-1 contribution dropped.
+        want = w1 * np.asarray(_dense_expert(params, e1, tokens[ti]))
+        np.testing.assert_allclose(got[ti], want, rtol=2e-4, atol=2e-5)
+
+
+def test_top1_unchanged_by_topk_refactor():
+    """top_k=1 keeps the Switch semantics: output scaled by raw p1."""
+    params = _moe_params()
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(0, 1, (2, 4, 8)).astype(np.float32))
+    y, _ = moe.moe_mlp(x, params, capacity_factor=4.0, top_k=1)
+    tokens = np.asarray(x).reshape(-1, 8)
+    logits = tokens @ np.asarray(params["gate"]["kernel"])
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    got = np.asarray(y).reshape(-1, 8)
+    for ti in range(tokens.shape[0]):
+        e1 = int(np.argmax(probs[ti]))
+        want = probs[ti, e1] * np.asarray(
+            _dense_expert(params, e1, tokens[ti]))
+        np.testing.assert_allclose(got[ti], want, rtol=2e-4, atol=2e-5)
+
+
+def test_top2_vit_moe_trains(rng):
+    import dataclasses
+
+    cfg = dataclasses.replace(VIT_MOE, moe_top_k=2)
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=4, model_axis=2))
+    model_def = get_model("vit_moe")
+    optim = OptimConfig(learning_rate=0.01)
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, DATA, optim)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, cfg, DATA, optim, mesh,
+        state_sharding=sh)
+    train = step_lib.make_train_step(model_def, cfg, optim, mesh,
+                                     state_sharding=sh)
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    st, m = train(state, *mesh_lib.shard_batch(mesh, images, labels))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_topk_rejects_bad_k():
+    params = _moe_params(e=4)
+    x = jnp.zeros((1, 2, 8))
+    with pytest.raises(ValueError):
+        moe.moe_mlp(x, params, 1.0, top_k=5)
+    with pytest.raises(ValueError):
+        moe.moe_mlp(x, params, 1.0, top_k=0)
