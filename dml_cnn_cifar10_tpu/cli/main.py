@@ -92,6 +92,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ViT embed dim (default 192)")
     p.add_argument("--vit_depth", type=int, default=None,
                    help="ViT blocks (default 12)")
+    p.add_argument("--remat", type="bool", default=False,
+                   help="recompute transformer-block activations in the "
+                        "backward pass (activation memory O(1) in depth)")
     p.add_argument("--pipe_axis", type=int, default=1,
                    help="pipeline-parallel mesh degree (GPipe stages)")
     p.add_argument("--moe_experts", type=int, default=0,
@@ -198,6 +201,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     if args.model == "vit_moe" and args.moe_experts == 0:
         cfg.model.moe_experts = 8
     cfg.model.moe_top_k = args.moe_top_k
+    cfg.model.remat = args.remat
     cfg.parallel.explicit_collectives = args.explicit_collectives
     return cfg
 

@@ -219,20 +219,33 @@ def apply_with_aux(params: Params, images: jax.Array, cfg: ModelConfig,
     aux = jnp.zeros((), jnp.float32)
     if pipe_parallel:
         from dml_cnn_cifar10_tpu.parallel import pipeline
-        x = pipeline.pipeline_blocks(
-            x, p["blocks"],
-            lambda h, bp: _block(h, bp, cfg.vit_heads,
-                                 cfg.use_pallas_attention,
-                                 cfg.moe_capacity_factor)[0],
-            mesh)
+
+        def stage_fn(h, bp):
+            return _block(h, bp, cfg.vit_heads, cfg.use_pallas_attention,
+                          cfg.moe_capacity_factor)[0]
+
+        if cfg.remat:
+            # Same memory lever inside each pipeline stage body.
+            stage_fn = jax.checkpoint(stage_fn)
+        x = pipeline.pipeline_blocks(x, p["blocks"], stage_fn, mesh)
     else:
+        def block_fn(h, bp):
+            return _block(h, bp, cfg.vit_heads,
+                          cfg.use_pallas_attention,
+                          cfg.moe_capacity_factor, mesh=attn_mesh,
+                          sp_mode=cfg.sp_mode,
+                          moe_top_k=cfg.moe_top_k)
+
+        if cfg.remat:
+            # Recompute block activations in backward: scan(checkpoint)
+            # keeps live activation memory O(1) in depth — deep stacks and
+            # long sequences stop being HBM-bound (traded for ~1 extra
+            # forward of FLOPs, cheap on the MXU).
+            block_fn = jax.checkpoint(block_fn)
+
         def body(carry, bp):
             h, aux_sum = carry
-            h, block_aux = _block(h, bp, cfg.vit_heads,
-                                  cfg.use_pallas_attention,
-                                  cfg.moe_capacity_factor, mesh=attn_mesh,
-                                  sp_mode=cfg.sp_mode,
-                                  moe_top_k=cfg.moe_top_k)
+            h, block_aux = block_fn(h, bp)
             return (h, aux_sum + block_aux), None
 
         (x, aux), _ = lax.scan(body, (x, aux), p["blocks"])
