@@ -138,7 +138,7 @@ def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
                                           use_pallas=use_pallas)
         elif sp_mode == "ring":
             from dml_cnn_cifar10_tpu.parallel import ring_attention as ring
-            o = ring.ring_attention(q, k, v, mesh)
+            o = ring.ring_attention(q, k, v, mesh, use_pallas=use_pallas)
         else:
             raise ValueError(f"unknown sp_mode {sp_mode!r}")
     else:

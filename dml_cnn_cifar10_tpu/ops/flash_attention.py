@@ -29,8 +29,10 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30  # not -inf: exp(-inf - -inf) would NaN the first block
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
                   scale: float, kv_len: int, block_k: int):
+    """One K/V-block update of the running (m, l, acc) — shared by the
+    plain and stats-emitting kernels."""
     kb = pl.program_id(2)
 
     @pl.when(kb == 0)
@@ -58,29 +60,43 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         preferred_element_type=jnp.float32)
     m_scr[:, :1] = m_cur
 
-    @pl.when(kb == pl.num_programs(2) - 1)
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, kv_len: int, block_k: int):
+    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, scale=scale,
+                  kv_len=kv_len, block_k=block_k)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _finalize():
         o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("scale", "block_q", "block_k",
-                                    "interpret"))
-def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    scale: float | None = None, block_q: int = 128,
-                    block_k: int = 128,
-                    interpret: bool | None = None) -> jax.Array:
-    """FlashAttention over [B, S, H, D] tensors → [B, S, H, D].
+def _flash_stats_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+                        m_scr, l_scr, acc_scr, *,
+                        scale: float, kv_len: int, block_k: int):
+    """Like ``_flash_kernel`` but emits the raw running state — f32
+    UNNORMALIZED accumulator plus row max ``m`` and normalizer ``l`` —
+    the partial-softmax interface the ring-attention merge rule needs
+    (parallel/ring_attention.py). Emitting ``acc_scr`` directly keeps the
+    partial in f32 regardless of input dtype (normalizing to the input
+    dtype and re-multiplying by ``l`` would quantize every ring step's
+    partial)."""
+    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, scale=scale,
+                  kv_len=kv_len, block_k=block_k)
 
-    Contract-identical to :func:`ops.attention.xla_attention`; tests assert
-    numerical agreement. Sequence lengths that aren't multiples of the
-    block sizes are zero-padded and masked inside the kernel.
-    """
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _finalize():
+        acc_ref[0] = acc_scr[:]
+        m_ref[0] = m_scr[:]
+        l_ref[0] = l_scr[:]
+
+
+def _flash_call(q, k, v, scale, block_q, block_k, interpret,
+                with_stats: bool):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-
     b, s, h, d = q.shape
     bq, bk = min(block_q, s), min(block_k, s)
 
@@ -99,16 +115,22 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     nq, nk = sp // bq, sp // bk
 
     from jax.experimental.pallas import tpu as pltpu
-    out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, kv_len=s, block_k=bk),
-        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+    o_spec = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
+    stat_spec = pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0))
+    stat_shape = jax.ShapeDtypeStruct((b * h, sp, 128), jnp.float32)
+    kernel = _flash_stats_kernel if with_stats else _flash_kernel
+    res = pl.pallas_call(
+        functools.partial(kernel, scale=scale, kv_len=s, block_k=bk),
+        out_shape=([jax.ShapeDtypeStruct(qb.shape, jnp.float32), stat_shape,
+                    stat_shape] if with_stats
+                   else jax.ShapeDtypeStruct(qb.shape, q.dtype)),
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
             pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
             pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+        out_specs=([o_spec, stat_spec, stat_spec] if with_stats else o_spec),
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),   # m (col 0 used)
             pltpu.VMEM((bq, 128), jnp.float32),   # l (col 0 used)
@@ -117,5 +139,50 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         interpret=interpret,
     )(qb, kb_, vb)
 
-    out = out[:, :s].reshape(b, h, s, d)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    def from_bh(x):  # [B*H, Sp, ...] → [B, S, H, ...]
+        x = x[:, :s]
+        x = x.reshape(b, h, s, *x.shape[2:])
+        return jnp.swapaxes(x, 1, 2)
+
+    if not with_stats:
+        return from_bh(res)
+    acc, m, l = res
+    # Stats live in lane column 0 of their [bq, 128] tiles.
+    return from_bh(acc), from_bh(m[:, :, 0]), from_bh(l[:, :, 0])
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "block_q", "block_k",
+                                    "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    scale: float | None = None, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: bool | None = None) -> jax.Array:
+    """FlashAttention over [B, S, H, D] tensors → [B, S, H, D].
+
+    Contract-identical to :func:`ops.attention.xla_attention`; tests assert
+    numerical agreement. Sequence lengths that aren't multiples of the
+    block sizes are zero-padded and masked inside the kernel.
+    """
+    return _flash_call(q, k, v, scale, block_q, block_k, interpret,
+                       with_stats=False)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "block_q", "block_k",
+                                    "interpret"))
+def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
+                          scale: float | None = None, block_q: int = 128,
+                          block_k: int = 128,
+                          interpret: bool | None = None):
+    """FlashAttention's raw partial-softmax state:
+    ``(acc [B,S,H,D] f32 UNNORMALIZED accumulator, m [B,S,H] f32 row max,
+    l [B,S,H] f32 normalizer)``; the normalized output is ``acc / l``.
+
+    This is the partial-attention interface: partials over different K/V
+    shards merge with the standard flash rule in full f32 — exactly what
+    the ring-attention body needs to run its local block on the MXU via
+    Pallas (:func:`parallel.ring_attention.ring_attention`).
+    """
+    return _flash_call(q, k, v, scale, block_q, block_k, interpret,
+                       with_stats=True)

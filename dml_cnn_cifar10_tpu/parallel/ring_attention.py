@@ -16,11 +16,14 @@ chip stays O(S·D/seq + block²), and the K/V transfers ride ICI neighbor
 links, overlappable with the block compute by XLA's latency-hiding
 scheduler.
 
-The per-block math mirrors the flash merge rule (running m/l/acc, same as
-:mod:`~dml_cnn_cifar10_tpu.ops.flash_attention`) in plain jnp: each ring
-step materializes only the local S/seq × S/seq score block, which XLA fuses
-on-chip. Routing the local block through the Pallas kernel itself is a
-follow-up optimization, not wired up yet.
+The per-block math is the flash merge rule (running m/l/acc, same as
+:mod:`~dml_cnn_cifar10_tpu.ops.flash_attention`) with two local-block
+engines: plain jnp (each ring step materializes only the local
+S/seq × S/seq score block, which XLA fuses on-chip — right for short
+shards) or, with ``use_pallas=True`` and shards ≥128, the Pallas flash
+kernel's stats interface (``flash_attention_stats``) so even the local
+block never materializes its score matrix — the long-context
+configuration.
 """
 
 from __future__ import annotations
@@ -64,9 +67,24 @@ def _merge(m1, l1, a1, m2, l2, a2):
     return m, l, a1 * wa1 + a2 * wa2
 
 
-def _ring_body(carry, _, axis_name: str, scale: float, nsteps: int):
+def _block_stats_pallas(q, k, v, scale):
+    """The same ``(m, l, acc)`` partials as :func:`_block_stats`, computed
+    by the Pallas flash kernel (``flash_attention_stats``): the local
+    S/seq × S/seq block runs blocked on the MXU with the score matrix
+    never leaving VMEM — the long-context ring configuration."""
+    from dml_cnn_cifar10_tpu.ops import flash_attention as fa
+
+    acc, m, l = fa.flash_attention_stats(q, k, v, scale=scale)
+    m_ = jnp.transpose(m, (0, 2, 1))[..., None]       # [B,H,Sq,1]
+    l_ = jnp.transpose(l, (0, 2, 1))[..., None]
+    return m_, l_, acc                                # acc already f32
+
+
+def _ring_body(carry, _, axis_name: str, scale: float, nsteps: int,
+               use_pallas: bool = False):
     q, k, v, m, l, acc = carry
-    bm, bl, bacc = _block_stats(q, k, v, scale)
+    stats = _block_stats_pallas if use_pallas else _block_stats
+    bm, bl, bacc = stats(q, k, v, scale)
     m, l, acc = _merge(m, l, acc, bm, bl, bacc)
     # Rotate K/V one ring hop (neighbor ppermute over ICI). The final
     # rotation returns the shards to their home device, so the carry stays
@@ -78,10 +96,14 @@ def _ring_body(carry, _, axis_name: str, scale: float, nsteps: int):
 
 
 def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
-                         axis_name: str, scale: Optional[float] = None
-                         ) -> jax.Array:
+                         axis_name: str, scale: Optional[float] = None,
+                         use_pallas: bool = False) -> jax.Array:
     """Per-device body: runs under ``shard_map`` with Q/K/V sequence-sharded
-    on ``axis_name``. Shapes [B, S_local, H, D] → [B, S_local, H, D]."""
+    on ``axis_name``. Shapes [B, S_local, H, D] → [B, S_local, H, D].
+
+    ``use_pallas`` routes each local block through the flash kernel's
+    stats interface when the local shard is long enough to benefit
+    (same ≥128 threshold as ``dispatch_attention``)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     nsteps = lax.axis_size(axis_name)
@@ -91,7 +113,8 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     a0 = jnp.zeros((b, sq, h, d), jnp.float32)
 
     body = functools.partial(_ring_body, axis_name=axis_name, scale=scale,
-                             nsteps=nsteps)
+                             nsteps=nsteps,
+                             use_pallas=use_pallas and sq >= 128)
     (q, k, v, m, l, acc), _ = lax.scan(
         body, (q, k, v, m0, l0, a0), None, length=nsteps)
     out = acc / jnp.transpose(l, (0, 2, 1, 3))
@@ -138,16 +161,18 @@ def sp_shard_map(local_fn, mesh: Mesh, axis_name: str, seq_len: int,
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    scale: Optional[float] = None,
-                   axis_name: str = "seq") -> jax.Array:
+                   axis_name: str = "seq",
+                   use_pallas: bool = False) -> jax.Array:
     """Sequence-parallel attention over the mesh's ``seq`` axis.
 
     Global-view entrypoint: [B, S, H, D] arrays (sharded or not); S must be
     divisible by the ``seq`` axis size. Batch stays sharded on ``data`` so
-    dp × sp compose.
+    dp × sp compose. ``use_pallas`` runs each local block on the Pallas
+    flash kernel (long-shard configs).
     """
     fn = sp_shard_map(
         functools.partial(ring_attention_local, axis_name=axis_name,
-                          scale=scale),
+                          scale=scale, use_pallas=use_pallas),
         mesh, axis_name, q.shape[1], q.shape[2])
     return fn(q, k, v)
 

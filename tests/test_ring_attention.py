@@ -78,3 +78,57 @@ def test_ring_under_jit_compiles_once():
     out1 = f(q, k, v)
     out2 = f(q * 0.5, k, v)
     assert out1.shape == q.shape and out2.shape == q.shape
+
+
+def test_flash_stats_interface():
+    """flash_attention_stats returns (acc, m, l) with acc f32
+    unnormalized (the ring merge currency) and acc/l == dense attention."""
+    from dml_cnn_cifar10_tpu.ops import flash_attention as fa
+
+    rng = np.random.default_rng(7)
+    q, k, v = _qkv(rng, b=2, s=200, h=2, d=16)
+    acc, m, l = fa.flash_attention_stats(q, k, v)
+    assert acc.dtype == jnp.float32
+    out = acc / l[..., None]     # l [B,S,H] broadcasts over D
+    ref = attn.xla_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+    # Recompute the softmax stats densely and compare.
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (16 ** -0.5)
+    m_ref = jnp.transpose(jnp.max(s, -1), (0, 2, 1))
+    l_ref = jnp.transpose(
+        jnp.sum(jnp.exp(s - jnp.max(s, -1, keepdims=True)), -1), (0, 2, 1))
+    np.testing.assert_allclose(np.asarray(m), np.asarray(m_ref), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_ref), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_ring_pallas_local_block_matches_dense():
+    """Ring attention with the local block on the Pallas flash kernel
+    (long shards: S_local = 256 >= 128) == dense attention."""
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=2, seq_axis=4))
+    rng = np.random.default_rng(8)
+    q, k, v = _qkv(rng, b=2, s=1024, h=2, d=16)
+    out = ra.ring_attention(q, k, v, mesh, use_pallas=True)
+    ref = attn.xla_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_ring_pallas_bf16_partials_stay_f32():
+    """bf16 inputs: the stats interface keeps partials f32, so the ring
+    merge matches dense attention at bf16-input tolerance."""
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=2, seq_axis=4))
+    rng = np.random.default_rng(9)
+    shape = (2, 512, 2, 16)
+    qf = rng.normal(0, 1, shape).astype(np.float32)
+    kf = rng.normal(0, 1, shape).astype(np.float32)
+    vf = rng.normal(0, 1, shape).astype(np.float32)
+    q, k, v = (jnp.asarray(a, jnp.bfloat16) for a in (qf, kf, vf))
+    out = ra.ring_attention(q, k, v, mesh, use_pallas=True)
+    assert out.dtype == jnp.bfloat16
+    ref = attn.xla_attention(jnp.asarray(qf), jnp.asarray(kf),
+                             jnp.asarray(vf))
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.05)
