@@ -123,6 +123,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "auto-partitioning")
     p.add_argument("--compute_dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--optimizer", type=str, default="sgd",
+                   choices=["sgd", "adamw"],
+                   help="sgd = reference; adamw for the transformer ladder")
     p.add_argument("--momentum", type=float, default=0.0,
                    help="SGD momentum (reference uses plain SGD)")
     p.add_argument("--weight_decay", type=float, default=0.0)
@@ -168,6 +171,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.model.compute_dtype = args.compute_dtype
     cfg.optim.learning_rate = args.learning_rate
     cfg.optim.grad_accum = args.grad_accum
+    cfg.optim.optimizer = args.optimizer
     cfg.optim.momentum = args.momentum
     cfg.optim.weight_decay = args.weight_decay
     cfg.optim.grad_clip_norm = args.grad_clip_norm

@@ -140,6 +140,13 @@ class OptimConfig:
     schedule: str = "exponential"         # exponential | cosine | constant
     warmup_steps: int = 0
     cosine_decay_steps: int = 0
+    # Optimizer family. "sgd" (+ optional momentum) is the reference's;
+    # "adamw" (decoupled weight decay, bias-corrected moments) is the
+    # transformer-ladder standard.
+    optimizer: str = "sgd"                # sgd | adamw
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    adam_eps: float = 1e-8
     grad_clip_norm: Optional[float] = None
     # Gradient accumulation: split each global batch into this many
     # microbatches inside the compiled step (lax.scan), average the grads,

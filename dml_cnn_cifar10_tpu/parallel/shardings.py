@@ -151,10 +151,11 @@ def param_pspecs(model_name: str, params: Any, pipe: bool = False) -> Any:
 
 
 def state_pspecs(model_name: str, state: Any, pipe: bool = False) -> Any:
-    """Specs for a full ``TrainState``: params by model rule, optimizer
-    momentum mirrors the params (same tree paths), scalar step + BN state
-    replicated."""
-    opt = {k: (param_pspecs(model_name, v, pipe=pipe) if k == "momentum"
+    """Specs for a full ``TrainState``: params by model rule, per-param
+    optimizer moments (SGD momentum, AdamW mu/nu) mirror the params (same
+    tree paths), scalar step + BN state replicated."""
+    opt = {k: (param_pspecs(model_name, v, pipe=pipe)
+               if k in ("momentum", "mu", "nu")
                else jax.tree.map(lambda _: P(), v))
            for k, v in state.opt.items()}
     return type(state)(

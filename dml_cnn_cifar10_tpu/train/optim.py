@@ -63,27 +63,64 @@ def learning_rate(cfg: OptimConfig, step: jax.Array) -> jax.Array:
 
 
 def sgd_init(params: Any, cfg: OptimConfig) -> OptState:
+    """Optimizer-state init for the configured family (name kept for the
+    historical sgd-only API; dispatches on ``cfg.optimizer``)."""
     state: OptState = {"step": jnp.zeros((), jnp.int32)}
-    if cfg.momentum:
-        state["momentum"] = jax.tree.map(jnp.zeros_like, params)
+    if cfg.optimizer == "adamw":
+        if cfg.momentum:
+            raise ValueError(
+                "momentum is an SGD knob; AdamW's first moment is adam_b1 "
+                "— drop --momentum or use --optimizer sgd")
+        state["mu"] = jax.tree.map(jnp.zeros_like, params)
+        state["nu"] = jax.tree.map(jnp.zeros_like, params)
+    elif cfg.optimizer == "sgd":
+        if cfg.momentum:
+            state["momentum"] = jax.tree.map(jnp.zeros_like, params)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     return state
+
+
+def _clipped(grads: Any, cfg: OptimConfig) -> Any:
+    if cfg.grad_clip_norm is None:
+        return grads
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                         for g in jax.tree.leaves(grads)))
+    scale = jnp.minimum(1.0, cfg.grad_clip_norm / (gnorm + 1e-12))
+    return jax.tree.map(lambda g: g * scale, grads)
 
 
 def sgd_update(
     grads: Any, state: OptState, params: Any, cfg: OptimConfig
 ) -> Tuple[Any, OptState]:
-    """One SGD step; returns (new_params, new_state).
+    """One optimizer step; returns (new_params, new_state).
 
     The step counter increments on apply, mirroring ``minimize(...,
-    global_step=global_step)`` (``cifar10cnn.py:163``).
+    global_step=global_step)`` (``cifar10cnn.py:163``). SGD couples weight
+    decay into the gradient (classic L2); AdamW decays decoupled, applied
+    directly to the weights (Loshchilov & Hutter).
     """
     step = state["step"]
     lr = learning_rate(cfg, step)
-    if cfg.grad_clip_norm is not None:
-        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
-                             for g in jax.tree.leaves(grads)))
-        scale = jnp.minimum(1.0, cfg.grad_clip_norm / (gnorm + 1e-12))
-        grads = jax.tree.map(lambda g: g * scale, grads)
+    grads = _clipped(grads, cfg)
+
+    if cfg.optimizer == "adamw":
+        t = (step + 1).astype(jnp.float32)
+        b1, b2 = cfg.adam_b1, cfg.adam_b2
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                          state["mu"], grads)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g),
+                          state["nu"], grads)
+        bc1 = 1.0 - b1 ** t
+        bc2 = 1.0 - b2 ** t
+
+        def upd(p, m, v):
+            ghat = (m / bc1) / (jnp.sqrt(v / bc2) + cfg.adam_eps)
+            return p - lr * (ghat + cfg.weight_decay * p).astype(p.dtype)
+
+        new_params = jax.tree.map(upd, params, mu, nu)
+        return new_params, {"step": step + 1, "mu": mu, "nu": nu}
+
     if cfg.weight_decay:
         grads = jax.tree.map(lambda g, p: g + cfg.weight_decay * p,
                              grads, params)
@@ -105,9 +142,13 @@ def as_optax(cfg: OptimConfig):
     def schedule(count):
         return learning_rate(cfg, count)
 
-    tx = [optax.trace(decay=cfg.momentum)] if cfg.momentum else []
-    if cfg.grad_clip_norm is not None:
-        tx.insert(0, optax.clip_by_global_norm(cfg.grad_clip_norm))
+    clip = ([optax.clip_by_global_norm(cfg.grad_clip_norm)]
+            if cfg.grad_clip_norm is not None else [])
+    if cfg.optimizer == "adamw":
+        return optax.chain(*clip, optax.adamw(
+            schedule, b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps,
+            weight_decay=cfg.weight_decay))
+    tx = clip + ([optax.trace(decay=cfg.momentum)] if cfg.momentum else [])
     if cfg.weight_decay:
         tx.append(optax.add_decayed_weights(cfg.weight_decay))
     tx.append(optax.scale_by_learning_rate(schedule))
