@@ -132,3 +132,29 @@ def test_trainer_async_checkpoint(data_cfg, tmp_path):
     result = Trainer(cfg).fit()
     assert result.final_step == 20
     assert ck.all_checkpoint_steps(cfg.log_dir)  # final save landed
+
+
+def test_adamw_state_roundtrips(tmp_path, data_cfg):
+    """AdamW moments (mu/nu) survive save -> restore -> resume."""
+    import dataclasses
+
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+    from tests.conftest import tiny_train_cfg
+
+    cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=10)
+    cfg.optim = dataclasses.replace(cfg.optim, optimizer="adamw",
+                                    learning_rate=1e-3)
+    r1 = Trainer(cfg).fit()
+    assert r1.final_step == 10
+
+    cfg2 = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=20)
+    cfg2.optim = dataclasses.replace(cfg2.optim, optimizer="adamw",
+                                     learning_rate=1e-3)
+    t2 = Trainer(cfg2)
+    state = t2.init_or_restore()
+    assert int(np.asarray(state.step)) == 10
+    # Restored moments are the trained ones, not zeros.
+    assert any(np.abs(np.asarray(x)).max() > 0
+               for x in jax.tree.leaves(state.opt["mu"]))
+    r2 = t2.fit(state=state)
+    assert r2.final_step == 20

@@ -33,9 +33,10 @@ def _batch(rng, n=16, hw=24):
     return images, labels
 
 
-def _run_steps(model_cfg, mesh, images, labels, nsteps=3, momentum=0.0):
+def _run_steps(model_cfg, mesh, images, labels, nsteps=3, momentum=0.0,
+               optim=None):
     model_def = get_model(model_cfg.name)
-    optim = OptimConfig(learning_rate=0.01, momentum=momentum)
+    optim = optim or OptimConfig(learning_rate=0.01, momentum=momentum)
     sh = step_lib.train_state_shardings(mesh, model_def, model_cfg, DATA,
                                         optim)
     state = step_lib.init_train_state(
@@ -140,3 +141,16 @@ def test_explicit_collectives_rejects_tp():
         step_lib.make_train_step(get_model("cnn"), ModelConfig(),
                                  OptimConfig(), _mesh(4, 2),
                                  explicit_collectives=True)
+
+
+def test_adamw_under_tp(rng):
+    """AdamW's sharded mu/nu moments flow through a real tensor-parallel
+    train step (spec-level coverage lives in test_train_math)."""
+    cfg = ModelConfig(name="vit_tiny", vit_depth=2, vit_dim=64, vit_heads=2,
+                      patch_size=4, pool="mean", logit_relu=False)
+    images, labels = _batch(rng)
+    st, losses = _run_steps(
+        cfg, _mesh(), images, labels, nsteps=2,
+        optim=OptimConfig(optimizer="adamw", learning_rate=1e-3))
+    assert np.isfinite(losses).all()
+    assert int(jax.device_get(st.step)) == 2
