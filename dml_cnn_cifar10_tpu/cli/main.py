@@ -129,6 +129,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=0.0,
                    help="SGD momentum (reference uses plain SGD)")
     p.add_argument("--weight_decay", type=float, default=0.0)
+    p.add_argument("--label_smoothing", type=float, default=0.0)
     p.add_argument("--grad_clip_norm", type=float, default=None,
                    help="global-norm gradient clipping")
     p.add_argument("--schedule", type=str, default="exponential",
@@ -174,6 +175,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.optim.optimizer = args.optimizer
     cfg.optim.momentum = args.momentum
     cfg.optim.weight_decay = args.weight_decay
+    cfg.optim.label_smoothing = args.label_smoothing
     cfg.optim.grad_clip_norm = args.grad_clip_norm
     cfg.optim.schedule = args.schedule
     cfg.optim.warmup_steps = args.warmup_steps

@@ -144,6 +144,8 @@ class OptimConfig:
     # "adamw" (decoupled weight decay, bias-corrected moments) is the
     # transformer-ladder standard.
     optimizer: str = "sgd"                # sgd | adamw
+    # Label smoothing ε for the CE loss (0 = reference parity).
+    label_smoothing: float = 0.0
     adam_b1: float = 0.9
     adam_b2: float = 0.999
     adam_eps: float = 1e-8

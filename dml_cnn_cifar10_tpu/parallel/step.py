@@ -105,29 +105,32 @@ def train_state_shardings(
 
 def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,
                   axis_name: Optional[str] = None,
-                  mesh: Optional[Mesh] = None):
+                  mesh: Optional[Mesh] = None,
+                  label_smoothing: float = 0.0):
     """loss_fn(params, model_state, images, labels) →
     (loss, (logits, new_model_state))."""
     mesh_kwargs = {"mesh": mesh} if (model_def.wants_mesh and
                                      mesh is not None) else {}
+    ce = functools.partial(loss_lib.softmax_cross_entropy,
+                           label_smoothing=label_smoothing)
 
     def loss_fn(params, model_state, images, labels):
         if model_def.has_state:
             kwargs = {"axis_name": axis_name} if axis_name else {}
             logits, new_state = model_def.apply(
                 params, model_state, images, model_cfg, train=True, **kwargs)
-            loss = loss_lib.softmax_cross_entropy(logits, labels)
+            loss = ce(logits, labels)
         elif model_def.has_aux:
             logits, aux = model_def.apply(params, images, model_cfg,
                                           train=True, **mesh_kwargs)
             new_state = model_state
-            loss = loss_lib.softmax_cross_entropy(logits, labels) \
+            loss = ce(logits, labels) \
                 + model_cfg.moe_aux_coef * aux
         else:
             logits = model_def.apply(params, images, model_cfg, train=True,
                                      **mesh_kwargs)
             new_state = model_state
-            loss = loss_lib.softmax_cross_entropy(logits, labels)
+            loss = ce(logits, labels)
         return loss, (logits, new_state)
 
     return loss_fn
@@ -216,7 +219,8 @@ def make_train_step(
                 "explicit_collectives path; use the GSPMD (default) step")
         return _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh)
 
-    loss_fn = _forward_loss(model_def, model_cfg, mesh=mesh)
+    loss_fn = _forward_loss(model_def, model_cfg, mesh=mesh,
+                             label_smoothing=optim_cfg.label_smoothing)
     step = _step_body(loss_fn, optim_cfg)
 
     if mesh is None:
@@ -292,7 +296,9 @@ def make_train_chunk(
     host only shuffles bytes, H2D moves uint8.
     """
     chunk = _chunk_body(
-        _forward_loss(model_def, model_cfg, mesh=mesh), optim_cfg, data_cfg)
+        _forward_loss(model_def, model_cfg, mesh=mesh,
+                      label_smoothing=optim_cfg.label_smoothing),
+        optim_cfg, data_cfg)
 
     if mesh is None:
         return jax.jit(chunk, donate_argnums=0)
@@ -343,7 +349,9 @@ def make_train_chunk_resident(
             "make_train_chunk_resident requires data_cfg (the gathered "
             "dataset rows are raw uint8 and must be decoded on device)")
     body = _chunk_body(
-        _forward_loss(model_def, model_cfg, mesh=mesh), optim_cfg, data_cfg)
+        _forward_loss(model_def, model_cfg, mesh=mesh,
+                      label_smoothing=optim_cfg.label_smoothing),
+        optim_cfg, data_cfg)
 
     def chunk(dataset_images, dataset_labels, state: TrainState, idx):
         # Device-side gather: [K, B] indices into the HBM-resident arrays.
@@ -484,7 +492,8 @@ def _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh: Mesh):
     explicit ``lax.psum`` of gradients — the literal translation of
     "workers compute grads, aggregation applies them" minus the
     asynchrony (SURVEY §2.3, §3.3)."""
-    loss_fn = _forward_loss(model_def, model_cfg, axis_name="data")
+    loss_fn = _forward_loss(model_def, model_cfg, axis_name="data",
+                             label_smoothing=optim_cfg.label_smoothing)
     ndev = mesh.shape["data"]
 
     def local_step(state: TrainState, images, labels):

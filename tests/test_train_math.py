@@ -245,3 +245,52 @@ def test_adamw_moments_shard_with_params():
     with pytest.raises(ValueError, match="momentum"):
         optim_lib.sgd_init({"w": jnp.zeros(2)},
                            OptimConfig(optimizer="adamw", momentum=0.9))
+
+
+def test_label_smoothing_loss():
+    """ε-smoothed CE == (1-ε)*CE + ε*uniform-CE, computed densely."""
+    from dml_cnn_cifar10_tpu.train import loss as loss_lib
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(0, 2, (8, 10)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 10, 8).astype(np.int32))
+    eps = 0.1
+    got = float(loss_lib.softmax_cross_entropy(logits, labels,
+                                               label_smoothing=eps))
+    logp = np.asarray(jax.nn.log_softmax(logits, -1))
+    onehot = np.eye(10)[np.asarray(labels)]
+    target = (1 - eps) * onehot + eps / 10
+    want = float(np.mean(-np.sum(target * logp, -1)))
+    assert got == pytest.approx(want, rel=1e-6)
+    # eps=0 is exactly the parity loss.
+    assert float(loss_lib.softmax_cross_entropy(logits, labels)) == \
+        pytest.approx(float(loss_lib.softmax_cross_entropy(
+            logits, labels, label_smoothing=0.0)))
+
+
+def test_label_smoothing_through_train_step(rng):
+    from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig,
+                                            ParallelConfig)
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+    data = DataConfig(normalize="scale")
+    model_cfg = ModelConfig(logit_relu=False)
+    mesh = mesh_lib.build_mesh(ParallelConfig())
+    model_def = get_model("cnn")
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+
+    def loss_at(eps):
+        cfg = OptimConfig(learning_rate=0.01, label_smoothing=eps)
+        state = step_lib.init_train_state(
+            jax.random.key(0), model_def, model_cfg, data, cfg, mesh)
+        train = step_lib.make_train_step(model_def, model_cfg, cfg, mesh)
+        _, m = train(state, im, lb)
+        return float(jax.device_get(m["loss"]))
+
+    # Smoothing changes the loss value (and at init, raises it toward
+    # the uniform target's entropy floor).
+    assert loss_at(0.1) != loss_at(0.0)
