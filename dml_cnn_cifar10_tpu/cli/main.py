@@ -130,6 +130,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="SGD momentum (reference uses plain SGD)")
     p.add_argument("--weight_decay", type=float, default=0.0)
     p.add_argument("--label_smoothing", type=float, default=0.0)
+    p.add_argument("--random_brightness", type=float, default=0.0,
+                   help="augment: per-image brightness delta (pixel "
+                        "units; the TF tutorial used 63)")
+    p.add_argument("--random_contrast", type=float, default=0.0,
+                   help="augment: per-image contrast deviation (the TF "
+                        "tutorial's [0.2,1.8] is 0.8)")
     p.add_argument("--grad_clip_norm", type=float, default=None,
                    help="global-norm gradient clipping")
     p.add_argument("--schedule", type=str, default="exponential",
@@ -166,6 +172,8 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     )
     cfg.data.dataset = args.dataset
     cfg.data.data_dir = args.data_dir
+    cfg.data.random_brightness = args.random_brightness
+    cfg.data.random_contrast = args.random_contrast
     if args.dataset == "cifar100":
         cfg.data.num_classes = cfg.model.num_classes = 100
     cfg.model.name = args.model

@@ -40,6 +40,12 @@ class DataConfig:
     # augmentation the comment intended (fixed mode).
     random_crop: bool = False
     random_flip: bool = False
+    # Color jitter (the TF CIFAR-tutorial lineage the reference derives
+    # from used random_brightness(63) + random_contrast(0.2, 1.8)):
+    # brightness adds U[-b, b] in pixel units per image; contrast scales
+    # per-channel deviation-from-mean by U[1-c, 1+c]. 0 = off.
+    random_brightness: float = 0.0
+    random_contrast: float = 0.0
     # Pixel normalization. The reference feeds raw 0..255 floats
     # (cifar10cnn.py:66 — cast, no scaling), which with LR 0.1 makes training
     # numerically violent; faithful default keeps that. "scale" maps to
@@ -55,6 +61,22 @@ class DataConfig:
     # record layout) for air-gapped testing/benchmarking.
     synthetic_train_records: int = 2048
     synthetic_test_records: int = 512
+
+    @property
+    def augmented(self) -> bool:
+        """True when ANY randomized augmentation is on — the single
+        source of truth for "needs a PRNG key on the device decode path"
+        (ops/preprocess.py) and for the chunk builders' key threading."""
+        return bool(self.random_crop or self.random_flip
+                    or self.random_brightness or self.random_contrast)
+
+    def without_augmentation(self) -> "DataConfig":
+        """Eval-time decode config: every randomized augmentation off.
+        New augmentation fields must be added here and in ``augmented``."""
+        return dataclasses.replace(self, random_crop=False,
+                                   random_flip=False,
+                                   random_brightness=0.0,
+                                   random_contrast=0.0)
 
     @property
     def record_bytes(self) -> int:

@@ -125,6 +125,12 @@ class ShuffleBatchIterator:
             images = rec.center_crop(images, cfg.crop_height, cfg.crop_width)
         if self.train and cfg.random_flip:
             images = rec.random_flip(images, self.rng)
+        if self.train and cfg.random_brightness:
+            images = rec.random_brightness(images, cfg.random_brightness,
+                                           self.rng)
+        if self.train and cfg.random_contrast:
+            images = rec.random_contrast(images, cfg.random_contrast,
+                                         self.rng)
         return np.ascontiguousarray(rec.normalize(images, cfg.normalize))
 
     def __iter__(self) -> Iterator[Batch]:

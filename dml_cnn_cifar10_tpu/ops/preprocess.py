@@ -13,9 +13,10 @@ into the training step for free.
 Used by the chunked training path (``parallel/step.py:make_train_chunk``
 with ``data_cfg=``). Deterministic center-crop pipelines (faithful parity
 + bench) need no key; augmented configs (``random_crop``/``random_flip``,
-fixed mode) pass a PRNG ``key`` and the augmentation runs on device too —
-per-image random windows via ``dynamic_slice`` under ``vmap``, flips via a
-mask select, all fused into the step.
+fixed mode — any ``cfg.augmented`` field) pass a PRNG ``key`` and the
+augmentation runs on device too: per-image random crop windows as one-hot
+selection matmuls (MXU work, flips folded in), brightness/contrast as
+per-image affine maps, all fused into the step.
 """
 
 from __future__ import annotations
@@ -24,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dml_cnn_cifar10_tpu.config import DataConfig
 
@@ -33,21 +35,26 @@ def device_preprocess(images_u8: jax.Array, cfg: DataConfig,
     """uint8 ``[..., H, W, C]`` full-size images → float32
     ``[..., crop_h, crop_w, C]``, cropped/augmented and normalized per
     ``cfg`` — the device-side mirror of the host pipeline's ``_finish``.
-    Random crop/flip require ``key``."""
-    if (cfg.random_crop or cfg.random_flip) and key is None:
+    Any randomized augmentation (``cfg.augmented``) requires ``key``."""
+    if cfg.augmented and key is None:
         raise ValueError(
-            "random crop/flip on device need a PRNG key; pass key= or use "
-            "the host pipeline")
+            "random crop/flip/brightness/contrast on device need a PRNG "
+            "key; pass key= or use the host pipeline")
     x = images_u8.astype(jnp.float32)
+    if cfg.augmented:
+        kc, kf, kb, kn = jax.random.split(key, 4)
     if cfg.random_crop:
-        kc, key = jax.random.split(key)
         # Flip folds into the crop's column-selection matmul for free.
         x = _random_crop(x, cfg, kc,
-                         flip_key=key if cfg.random_flip else None)
+                         flip_key=kf if cfg.random_flip else None)
     else:
         x = _center_crop(x, cfg)
         if cfg.random_flip:
-            x = _random_flip(x, key)
+            x = _random_flip(x, kf)
+    if cfg.random_brightness:
+        x = _random_brightness(x, cfg.random_brightness, kb)
+    if cfg.random_contrast:
+        x = _random_contrast(x, cfg.random_contrast, kn)
     return _normalize(x, cfg)
 
 
@@ -108,6 +115,28 @@ def _random_flip(x: jax.Array, key: jax.Array) -> jax.Array:
     flip = jax.random.bernoulli(key, 0.5, (flat.shape[0],))
     out = jnp.where(flip[:, None, None, None], flat[:, :, ::-1, :], flat)
     return out.reshape(lead + (h, w, c))
+
+
+def _random_brightness(x: jax.Array, max_delta: float,
+                       key: jax.Array) -> jax.Array:
+    """Per-image additive brightness (mirrors records.random_brightness)."""
+    lead = x.shape[:-3]
+    n = int(np.prod(lead)) if lead else 1
+    deltas = jax.random.uniform(key, (n,), minval=-max_delta,
+                                maxval=max_delta)
+    return x + deltas.reshape(lead + (1, 1, 1))
+
+
+def _random_contrast(x: jax.Array, max_dev: float,
+                     key: jax.Array) -> jax.Array:
+    """Per-image contrast about the per-channel mean (mirrors
+    records.random_contrast)."""
+    lead = x.shape[:-3]
+    n = int(np.prod(lead)) if lead else 1
+    f = jax.random.uniform(key, (n,), minval=1.0 - max_dev,
+                           maxval=1.0 + max_dev).reshape(lead + (1, 1, 1))
+    mean = jnp.mean(x, axis=(-3, -2), keepdims=True)
+    return (x - mean) * f + mean
 
 
 def _normalize(x: jax.Array, cfg: DataConfig) -> jax.Array:

@@ -254,9 +254,11 @@ def _chunk_body(loss_fn, optim_cfg: OptimConfig,
     if data_cfg is not None:
         from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
 
+    augmented = data_cfg is not None and data_cfg.augmented
+
     def run(state: TrainState, images, labels):
         if data_cfg is not None:
-            if data_cfg.random_crop or data_cfg.random_flip:
+            if augmented:
                 key = jax.random.fold_in(jax.random.key(data_cfg.seed),
                                          state.step)
                 images = device_preprocess(images, data_cfg, key)
@@ -482,9 +484,8 @@ def make_batch_eval_resident(
 
 
 def _eval_data_cfg(data_cfg: DataConfig) -> DataConfig:
-    """Eval-time decode config: deterministic (no random crop/flip)."""
-    return dataclasses.replace(data_cfg, random_crop=False,
-                               random_flip=False)
+    """Eval-time decode config: deterministic (all augmentation off)."""
+    return data_cfg.without_augmentation()
 
 
 def _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh: Mesh):

@@ -112,3 +112,15 @@ def test_trainer_bfloat16_compute(data_cfg, tmp_path):
     assert result.final_step == 30
     assert np.isfinite(result.train_loss).all()
     assert result.test_accuracy[-1] > 0.15
+
+
+def test_profile_trace_writes_files(data_cfg, tmp_path):
+    """--profile_dir captures a jax.profiler trace during fit."""
+    cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=10)
+    cfg.profile_dir = os.path.join(str(tmp_path), "trace")
+    result = Trainer(cfg).fit()
+    assert result.final_step == 10
+    files = []
+    for root, _, names in os.walk(cfg.profile_dir):
+        files += [os.path.join(root, n) for n in names]
+    assert files, "profiler produced no trace files"

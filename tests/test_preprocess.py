@@ -132,3 +132,36 @@ def test_augmented_chunk_trains(rng):
     state, m = chunk(state, im, lb)
     assert np.isfinite(float(m["loss"]))
     assert int(jax.device_get(state.step)) == 2
+
+
+def test_device_brightness_contrast(rng):
+    import jax
+
+    images = rng.integers(0, 256, (32, 32, 32, 3)).astype(np.uint8)
+    # Brightness only: out - center_crop(in) is a per-image constant.
+    cfg_b = DataConfig(random_brightness=40.0, normalize="none")
+    out = np.asarray(device_preprocess(images, cfg_b, jax.random.key(0)))
+    base = _host(images, cfg_b)
+    diff = out - base
+    per_image = diff.reshape(32, -1)
+    assert np.allclose(per_image, per_image[:, :1], atol=1e-4)
+    assert (np.abs(per_image[:, 0]) <= 40.0 + 1e-4).all()
+    assert np.unique(np.round(per_image[:, 0], 3)).size > 8  # varies
+
+    # Contrast only: per-image per-channel means preserved.
+    cfg_c = DataConfig(random_contrast=0.5, normalize="none")
+    out = np.asarray(device_preprocess(images, cfg_c, jax.random.key(1)))
+    np.testing.assert_allclose(out.mean(axis=(1, 2)), base.mean(axis=(1, 2)),
+                               rtol=1e-4, atol=1e-3)
+    assert (out != base).any()
+
+
+def test_host_brightness_contrast_semantics(rng):
+    images = rng.normal(128, 40, (16, 24, 24, 3)).astype(np.float32)
+    g = np.random.default_rng(0)
+    out = rec.random_brightness(images, 30.0, g)
+    d = (out - images).reshape(16, -1)
+    assert np.allclose(d, d[:, :1])
+    out = rec.random_contrast(images, 0.5, np.random.default_rng(1))
+    np.testing.assert_allclose(out.mean(axis=(1, 2)),
+                               images.mean(axis=(1, 2)), rtol=1e-5)
