@@ -121,6 +121,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--explicit_collectives", type="bool", default=False,
                    help="use the shard_map+psum step instead of jit "
                         "auto-partitioning")
+    p.add_argument("--fsdp", type="bool", default=False,
+                   help="ZeRO/FSDP: shard params + optimizer moments over "
+                        "the data axis (state memory 1/N; grads become "
+                        "reduce-scatter)")
     p.add_argument("--compute_dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--optimizer", type=str, default="sgd",
@@ -217,6 +221,10 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.model.moe_top_k = args.moe_top_k
     cfg.model.remat = args.remat
     cfg.parallel.explicit_collectives = args.explicit_collectives
+    cfg.parallel.fsdp = args.fsdp
+    if args.fsdp and args.explicit_collectives:
+        raise SystemExit("--fsdp needs the GSPMD (default) step, not "
+                         "--explicit_collectives")
     return cfg
 
 

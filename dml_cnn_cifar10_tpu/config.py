@@ -62,21 +62,22 @@ class DataConfig:
     synthetic_train_records: int = 2048
     synthetic_test_records: int = 512
 
+    # Every randomized-augmentation field and its "off" value — the one
+    # list ``augmented`` and ``without_augmentation`` both derive from, so
+    # a new augmentation knob cannot drift between them.
+    _AUG_OFF = (("random_crop", False), ("random_flip", False),
+                ("random_brightness", 0.0), ("random_contrast", 0.0))
+
     @property
     def augmented(self) -> bool:
         """True when ANY randomized augmentation is on — the single
         source of truth for "needs a PRNG key on the device decode path"
         (ops/preprocess.py) and for the chunk builders' key threading."""
-        return bool(self.random_crop or self.random_flip
-                    or self.random_brightness or self.random_contrast)
+        return any(getattr(self, name) != off for name, off in self._AUG_OFF)
 
     def without_augmentation(self) -> "DataConfig":
-        """Eval-time decode config: every randomized augmentation off.
-        New augmentation fields must be added here and in ``augmented``."""
-        return dataclasses.replace(self, random_crop=False,
-                                   random_flip=False,
-                                   random_brightness=0.0,
-                                   random_contrast=0.0)
+        """Eval-time decode config: every randomized augmentation off."""
+        return dataclasses.replace(self, **dict(self._AUG_OFF))
 
     @property
     def record_bytes(self) -> int:
@@ -200,6 +201,13 @@ class ParallelConfig:
     process_id: int = 0
     # Explicit shard_map + lax.psum step instead of jit auto-partitioning.
     explicit_collectives: bool = False
+    # ZeRO/FSDP: shard params + optimizer moments over the ``data`` axis
+    # (parallel/shardings.py:_add_fsdp). State memory scales 1/|data|;
+    # GSPMD all-gathers weights before compute and reduce-scatters grads.
+    # Composes with the model/seq/pipe axes. No reference counterpart —
+    # the PS already "sharded" state round-robin over PS tasks
+    # (cifar10cnn.py:195-196); this is the SPMD-native form of that idea.
+    fsdp: bool = False
 
 
 @dataclasses.dataclass

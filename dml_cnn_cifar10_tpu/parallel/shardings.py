@@ -130,6 +130,30 @@ def rule_for(model_name: str, pipe: bool = False) -> Rule:
     return _RULES.get(model_name, _replicated)
 
 
+def _add_fsdp(spec: P, shape, data_size: int) -> P:
+    """ZeRO/FSDP layout: additionally shard the largest still-unsharded dim
+    divisible by the ``data``-axis size over ``data``.
+
+    Per-leaf greedy choice keeps every rule composable: tensor-parallel
+    (``model``) and pipeline (``pipe``) dims are left alone, and a leaf with
+    no evenly divisible free dim stays as the base rule says (correctness
+    never depends on the fsdp spec firing — GSPMD all-gathers whatever is
+    sharded before compute and reduce-scatters the matching grads).
+    """
+    if data_size <= 1 or not shape:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    best = -1
+    for i, (dim, e) in enumerate(zip(shape, entries)):
+        if e is None and dim % data_size == 0:
+            if best < 0 or dim > shape[best]:
+                best = i
+    if best < 0:
+        return spec
+    entries[best] = "data"
+    return P(*entries)
+
+
 def _path_str(key_path) -> str:
     parts = []
     for k in key_path:
@@ -142,37 +166,54 @@ def _path_str(key_path) -> str:
     return "/".join(parts)
 
 
-def param_pspecs(model_name: str, params: Any, pipe: bool = False) -> Any:
+def param_pspecs(model_name: str, params: Any, pipe: bool = False,
+                 fsdp_data: int = 0) -> Any:
     """Pytree of ``PartitionSpec`` matching ``params`` (arrays or
-    ShapeDtypeStructs)."""
+    ShapeDtypeStructs). ``fsdp_data > 1`` layers the ZeRO/FSDP ``data``-axis
+    sharding on top of the model's tensor/pipeline rule."""
     rule = rule_for(model_name, pipe=pipe)
-    return jax.tree_util.tree_map_with_path(
-        lambda kp, leaf: rule(_path_str(kp), leaf.ndim), params)
+
+    def spec_for(kp, leaf):
+        spec = rule(_path_str(kp), leaf.ndim)
+        return _add_fsdp(spec, leaf.shape, fsdp_data)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
-def state_pspecs(model_name: str, state: Any, pipe: bool = False) -> Any:
+def state_pspecs(model_name: str, state: Any, pipe: bool = False,
+                 fsdp_data: int = 0) -> Any:
     """Specs for a full ``TrainState``: params by model rule, per-param
     optimizer moments (SGD momentum, AdamW mu/nu) mirror the params (same
-    tree paths), scalar step + BN state replicated."""
-    opt = {k: (param_pspecs(model_name, v, pipe=pipe)
+    tree paths), scalar step + BN state replicated. With ``fsdp_data > 1``
+    params AND moments are sharded over ``data`` (ZeRO-3: the dominant
+    state memory scales 1/|data|; BN state stays replicated — it is
+    pmean'd cross-replica, not per-shard)."""
+    opt = {k: (param_pspecs(model_name, v, pipe=pipe, fsdp_data=fsdp_data)
                if k in ("momentum", "mu", "nu")
                else jax.tree.map(lambda _: P(), v))
            for k, v in state.opt.items()}
     return type(state)(
-        params=param_pspecs(model_name, state.params, pipe=pipe),
+        params=param_pspecs(model_name, state.params, pipe=pipe,
+                            fsdp_data=fsdp_data),
         opt=opt,
         model_state=jax.tree.map(lambda _: P(), state.model_state),
     )
 
 
-def state_shardings(mesh: Mesh, model_name: str, state: Any) -> Any:
+def state_shardings(mesh: Mesh, model_name: str, state: Any,
+                    fsdp: bool = False) -> Any:
     """``state_pspecs`` bound to a mesh → pytree of ``NamedSharding``.
 
     A mesh with a nontrivial ``pipe`` axis selects the pipeline layout
-    (stage-sharded layer stacks) instead of the tensor-parallel one."""
+    (stage-sharded layer stacks) instead of the tensor-parallel one.
+    ``fsdp=True`` additionally shards params + optimizer moments over the
+    ``data`` axis (ZeRO-3); GSPMD compiles the all-gather before compute
+    and the reduce-scatter of gradients in place of the plain all-reduce."""
     pipe = mesh.shape.get("pipe", 1) > 1
+    fsdp_data = mesh.shape["data"] if fsdp else 0
     return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
-                        state_pspecs(model_name, state, pipe=pipe),
+                        state_pspecs(model_name, state, pipe=pipe,
+                                     fsdp_data=fsdp_data),
                         is_leaf=lambda x: isinstance(x, P))
 
 

@@ -91,16 +91,20 @@ def train_state_shardings(
     model_cfg: ModelConfig,
     data_cfg: DataConfig,
     optim_cfg: OptimConfig,
+    fsdp: bool = False,
 ) -> TrainState:
     """The ``TrainState`` sharding tree (tensor-parallel rules applied) for
     a model config, computed shape-only via ``eval_shape``. Compute it ONCE
     and hand the same tree to ``make_train_step`` / ``make_eval_step`` /
-    ``restore_checkpoint`` — it is the single currency for state layout."""
+    ``restore_checkpoint`` — it is the single currency for state layout.
+    ``fsdp=True`` adds the ZeRO-3 ``data``-axis sharding of params +
+    moments (:func:`~..parallel.shardings.state_shardings`)."""
     abstract = jax.eval_shape(
         lambda k: init_train_state(k, model_def, model_cfg, data_cfg,
                                    optim_cfg),
         jax.random.key(0))
-    return shardings_lib.state_shardings(mesh, model_cfg.name, abstract)
+    return shardings_lib.state_shardings(mesh, model_cfg.name, abstract,
+                                         fsdp=fsdp)
 
 
 def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,

@@ -57,9 +57,15 @@ class Trainer:
         # One sharding tree, computed once, used everywhere state is placed
         # (init, restore, train/eval in_shardings). The explicit-collectives
         # path is dp-only and expects replicated state.
+        if cfg.parallel.explicit_collectives and cfg.parallel.fsdp:
+            raise ValueError(
+                "fsdp needs the GSPMD (default) step: the "
+                "explicit_collectives shard_map path expects replicated "
+                "state")
         self.state_sharding = None if cfg.parallel.explicit_collectives \
             else step_lib.train_state_shardings(
-                self.mesh, self.model_def, cfg.model, cfg.data, cfg.optim)
+                self.mesh, self.model_def, cfg.model, cfg.data, cfg.optim,
+                fsdp=cfg.parallel.fsdp)
         self.train_step = step_lib.make_train_step(
             self.model_def, cfg.model, cfg.optim, self.mesh,
             explicit_collectives=cfg.parallel.explicit_collectives,

@@ -1,0 +1,186 @@
+"""ZeRO/FSDP: param + optimizer-moment sharding over the ``data`` axis.
+
+The reference's PS placed variables round-robin over PS tasks
+(``cifar10cnn.py:195-196``) — the only "state sharding" it had. The SPMD
+form is ZeRO-3: every param/moment leaf partitioned over ``data``, GSPMD
+all-gathering weights before compute and reduce-scattering gradients.
+These tests prove it is *real* (leaves actually partitioned 1/N on device)
+and *pure layout* (same math as replicated dp to fp32 tolerance), on the
+8-virtual-device CPU mesh (SURVEY §4's no-pod distributed recipe).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                        ParallelConfig)
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import shardings
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+DATA = DataConfig(normalize="scale")
+
+
+def _mesh(data=8, model=1):
+    return mesh_lib.build_mesh(
+        ParallelConfig(data_axis=data, model_axis=model))
+
+
+def _batch(rng, n=16, hw=24):
+    images = rng.normal(0.5, 0.25, (n, hw, hw, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, n).astype(np.int32)
+    return images, labels
+
+
+def _run_steps(model_cfg, mesh, images, labels, fsdp, nsteps=3, optim=None):
+    model_def = get_model(model_cfg.name)
+    optim = optim or OptimConfig(learning_rate=0.01)
+    sh = step_lib.train_state_shardings(mesh, model_def, model_cfg, DATA,
+                                        optim, fsdp=fsdp)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, model_cfg, DATA, optim, mesh,
+        state_sharding=sh)
+    train = step_lib.make_train_step(model_def, model_cfg, optim, mesh,
+                                     state_sharding=sh)
+    losses = []
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    for _ in range(nsteps):
+        state, metrics = train(state, im, lb)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    return state, losses
+
+
+def test_fsdp_spec_picks_largest_free_dim():
+    # conv kernel [5,5,3,64]: only 64 divides 8 -> trailing dim sharded.
+    assert shardings._add_fsdp(P(), (5, 5, 3, 64), 8) == P(
+        None, None, None, "data")
+    # fc kernel [2304,384]: both divide, 2304 is larger -> dim 0.
+    assert shardings._add_fsdp(P(), (2304, 384), 8) == P("data", None)
+    # model-sharded col kernel: the tp dim is taken, fsdp takes the other.
+    assert shardings._add_fsdp(P(None, "model"), (2304, 384), 8) == P(
+        "data", "model")
+    # no divisible free dim -> unchanged (bias of the 10-way head).
+    assert shardings._add_fsdp(P(), (10,), 8) == P()
+    # scalars / data_size 1 -> unchanged.
+    assert shardings._add_fsdp(P(), (), 8) == P()
+    assert shardings._add_fsdp(P(), (64,), 1) == P()
+
+
+def test_fsdp_state_actually_sharded():
+    mesh = _mesh()
+    model_def = get_model("cnn")
+    cfg = ModelConfig(logit_relu=False)
+    optim = OptimConfig(momentum=0.9)  # momentum buffers shard like params
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, DATA, optim,
+                                        fsdp=True)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, cfg, DATA, optim, mesh,
+        state_sharding=sh)
+    k = state.params["full1"]["kernel"]          # [2304, 384] col-parallel:
+    # the tp rule claims the trailing dim (size-1 model axis here), fsdp
+    # takes the free leading dim.
+    assert k.sharding.spec == P("data", "model")
+    assert k.addressable_shards[0].data.shape == (2304 // 8, 384)
+    m = state.opt["momentum"]["full1"]["kernel"]
+    assert m.sharding.spec == P("data", "model")
+    assert shardings.assert_some_leaf_sharded(state.params, axis="data")
+    # scalar step and the tiny head bias stay replicated
+    assert state.opt["step"].sharding.spec == P()
+    assert state.params["full3"]["bias"].sharding.spec == P()
+
+
+def test_fsdp_matches_dp(rng):
+    """fsdp must be a pure layout change: same losses, same final params
+    as replicated dp, to fp32 tolerance (reduce-scatter vs all-reduce can
+    reorder the sum)."""
+    cfg = ModelConfig(logit_relu=False)
+    images, labels = _batch(rng)
+    st_dp, loss_dp = _run_steps(cfg, _mesh(), images, labels, fsdp=False)
+    st_fs, loss_fs = _run_steps(cfg, _mesh(), images, labels, fsdp=True)
+    np.testing.assert_allclose(loss_dp, loss_fs, rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(st_dp.params),
+                    jax.tree.leaves(st_fs.params)):
+        np.testing.assert_allclose(np.asarray(jax.device_get(a)),
+                                   np.asarray(jax.device_get(b)),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_fsdp_composes_with_tp(rng):
+    """data=4 (fsdp) x model=2 (tp): the col-parallel kernel carries BOTH
+    axes and the step still matches pure dp."""
+    cfg = ModelConfig(logit_relu=False)
+    images, labels = _batch(rng)
+    mesh = _mesh(data=4, model=2)
+    model_def = get_model("cnn")
+    optim = OptimConfig(learning_rate=0.01)
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, DATA, optim,
+                                        fsdp=True)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, cfg, DATA, optim, mesh,
+        state_sharding=sh)
+    k = state.params["full1"]["kernel"]          # [2304, 384] col-parallel
+    assert k.sharding.spec == P("data", "model")
+    assert k.addressable_shards[0].data.shape == (2304 // 4, 384 // 2)
+
+    _, loss_dp = _run_steps(cfg, _mesh(), images, labels, fsdp=False)
+    train = step_lib.make_train_step(model_def, cfg, optim, mesh,
+                                     state_sharding=sh)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    losses = []
+    for _ in range(3):
+        state, metrics = train(state, im, lb)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    np.testing.assert_allclose(loss_dp, losses, rtol=1e-5, atol=1e-6)
+
+
+def test_fsdp_adamw_vit(rng):
+    """AdamW mu/nu shard over ``data`` and train finitely on a ViT."""
+    cfg = ModelConfig(name="vit_tiny", vit_depth=2, vit_dim=64, vit_heads=2,
+                      patch_size=8, logit_relu=False)
+    images, labels = _batch(rng)
+    optim = OptimConfig(optimizer="adamw", learning_rate=1e-3)
+    st, losses = _run_steps(cfg, _mesh(), images, labels, fsdp=True,
+                            nsteps=2, optim=optim)
+    assert np.isfinite(losses).all()
+    assert shardings.assert_some_leaf_sharded(st.opt["mu"], axis="data")
+    assert int(jax.device_get(st.step)) == 2
+
+
+def test_fsdp_checkpoint_roundtrip(tmp_path, rng):
+    """Save from fsdp-sharded state, restore into the same layout: the
+    host fetch assembles the global arrays, restore re-sharding matches."""
+    from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+
+    cfg = ModelConfig(logit_relu=False)
+    images, labels = _batch(rng)
+    mesh = _mesh()
+    model_def = get_model("cnn")
+    optim = OptimConfig(learning_rate=0.01)
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, DATA, optim,
+                                        fsdp=True)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, cfg, DATA, optim, mesh,
+        state_sharding=sh)
+    train = step_lib.make_train_step(model_def, cfg, optim, mesh,
+                                     state_sharding=sh)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    state, _ = train(state, im, lb)
+
+    ckpt_lib.save_checkpoint(str(tmp_path), state, step=1)
+    fresh = step_lib.init_train_state(
+        jax.random.key(7), model_def, cfg, DATA, optim, mesh,
+        state_sharding=sh)
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), fresh, sharding=sh)
+    assert restored.params["full1"]["kernel"].sharding.spec == P(
+        "data", "model")
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(a)),
+                                      np.asarray(jax.device_get(b)))
+    # restored state trains on (the donated-buffer layouts line up)
+    restored, metrics = train(restored, im, lb)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
