@@ -18,11 +18,16 @@ class ModelDef(NamedTuple):
     # apply returns ``(logits, aux_loss)``; the step adds
     # ``model_cfg.moe_aux_coef * aux_loss`` to the training loss.
     has_aux: bool = False
+    # Conv-family models support spatial partitioning: the image H dim
+    # shards over the ``seq`` mesh axis (GSPMD inserts conv/pool halo
+    # exchanges). ViTs use ``seq`` for token/sequence parallelism instead.
+    spatial: bool = False
 
 
 def _cnn() -> ModelDef:
     from dml_cnn_cifar10_tpu.models import cnn
-    return ModelDef(cnn.init_params, cnn.apply, lambda p: {}, False)
+    return ModelDef(cnn.init_params, cnn.apply, lambda p: {}, False,
+                    spatial=True)
 
 
 def _resnet(depth: int) -> Callable[[], ModelDef]:
@@ -33,6 +38,7 @@ def _resnet(depth: int) -> Callable[[], ModelDef]:
             resnet.apply,
             resnet.init_state,
             True,
+            spatial=True,
         )
     return make
 

@@ -53,16 +53,30 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def spatial_enabled(model_def, mesh: Mesh) -> bool:
+    """True when this model/mesh pair does spatial partitioning (conv
+    family + nontrivial ``seq`` axis) — the ONE predicate every step
+    builder and batch placement consults, so the layouts can't drift."""
+    return bool(getattr(model_def, "spatial", False)
+                and mesh.shape["seq"] > 1)
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 4,
-                   leading_dims: int = 0) -> NamedSharding:
+                   leading_dims: int = 0,
+                   spatial: bool = False) -> NamedSharding:
     """Batch dim over ``data``, preceded by ``leading_dims`` replicated axes
-    (the K axis of a ``[K, B, ...]`` step chunk); rest replicated."""
+    (the K axis of a ``[K, B, ...]`` step chunk); rest replicated.
+    ``spatial=True`` additionally shards the dim after batch (image H) over
+    ``seq`` — spatial partitioning for conv models (GSPMD halo exchange)."""
     spec = [None] * leading_dims + ["data"]
+    if spatial and ndim > len(spec):
+        spec.append("seq")
     spec += [None] * (ndim - len(spec))
     return NamedSharding(mesh, P(*spec))
 
 
-def shard_batch(mesh: Mesh, images, labels, leading_dims: int = 0):
+def shard_batch(mesh: Mesh, images, labels, leading_dims: int = 0,
+                spatial: bool = False):
     """Place a host batch on the mesh, batch dim sharded over ``data``.
 
     Single-process: a plain ``device_put`` with a NamedSharding. Multi-host:
@@ -71,7 +85,7 @@ def shard_batch(mesh: Mesh, images, labels, leading_dims: int = 0):
     every worker feeding its own queue in the reference
     (``cifar10cnn.py:201``).
     """
-    img_s = batch_sharding(mesh, images.ndim, leading_dims)
+    img_s = batch_sharding(mesh, images.ndim, leading_dims, spatial=spatial)
     lab_s = batch_sharding(mesh, labels.ndim, leading_dims)
     if jax.process_count() == 1:
         return (jax.device_put(images, img_s), jax.device_put(labels, lab_s))

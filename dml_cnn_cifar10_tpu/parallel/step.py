@@ -231,7 +231,11 @@ def make_train_step(
         return jax.jit(step, donate_argnums=0)
     repl = mesh_lib.replicated(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    data = mesh_lib.batch_sharding(mesh, 4)
+    # Conv models use a nontrivial ``seq`` axis for spatial partitioning:
+    # the image H dim shards over ``seq`` and GSPMD inserts the conv/pool
+    # halo exchanges (the vision analog of sequence parallelism).
+    spatial = mesh_lib.spatial_enabled(model_def, mesh)
+    data = mesh_lib.batch_sharding(mesh, 4, spatial=spatial)
     lab = mesh_lib.batch_sharding(mesh, 1)
     return jax.jit(
         step,
@@ -310,7 +314,8 @@ def make_train_chunk(
         return jax.jit(chunk, donate_argnums=0)
     repl = mesh_lib.replicated(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    data = mesh_lib.batch_sharding(mesh, 5, leading_dims=1)
+    spatial = mesh_lib.spatial_enabled(model_def, mesh)
+    data = mesh_lib.batch_sharding(mesh, 5, leading_dims=1, spatial=spatial)
     lab = mesh_lib.batch_sharding(mesh, 2, leading_dims=1)
     return jax.jit(
         chunk,
@@ -359,9 +364,19 @@ def make_train_chunk_resident(
                       label_smoothing=optim_cfg.label_smoothing),
         optim_cfg, data_cfg)
 
+    spatial = mesh_lib.spatial_enabled(model_def, mesh)
+    gathered_sh = mesh_lib.batch_sharding(mesh, 5, leading_dims=1,
+                                          spatial=spatial)
+
     def chunk(dataset_images, dataset_labels, state: TrainState, idx):
         # Device-side gather: [K, B] indices into the HBM-resident arrays.
-        return body(state, dataset_images[idx], dataset_labels[idx])
+        # Conv models on a seq>1 mesh pin the gathered chunk to the
+        # spatial (H-over-seq) layout so the resident path partitions
+        # activations the same way the host-fed paths do.
+        images = dataset_images[idx]
+        if spatial:
+            images = lax.with_sharding_constraint(images, gathered_sh)
+        return body(state, images, dataset_labels[idx])
 
     repl = mesh_lib.replicated(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
@@ -444,7 +459,9 @@ def make_eval_resident(
 
     repl = mesh_lib.replicated(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    data_sh = mesh_lib.batch_sharding(mesh, ims.ndim, leading_dims=1)
+    data_sh = mesh_lib.batch_sharding(
+        mesh, ims.ndim, leading_dims=1,
+        spatial=mesh_lib.spatial_enabled(model_def, mesh))
     lab_sh = mesh_lib.batch_sharding(mesh, 2, leading_dims=1)
     jitted = jax.jit(ev, in_shardings=(data_sh, lab_sh, state_sh),
                      out_shardings=repl)
@@ -471,8 +488,14 @@ def make_batch_eval_resident(
     logits_fn = _eval_logits_fn(model_def, model_cfg, mesh)
     eval_cfg = _eval_data_cfg(data_cfg)
 
+    spatial = mesh_lib.spatial_enabled(model_def, mesh)
+    gathered_sh = mesh_lib.batch_sharding(mesh, 4, spatial=spatial)
+
     def ev(dataset_images, dataset_labels, state: TrainState, idx):
-        images = device_preprocess(dataset_images[idx], eval_cfg)
+        images = dataset_images[idx]
+        if spatial:
+            images = lax.with_sharding_constraint(images, gathered_sh)
+        images = device_preprocess(images, eval_cfg)
         labels = dataset_labels[idx]
         return metrics_lib.batch_accuracy(logits_fn(state, images), labels)
 
@@ -552,9 +575,11 @@ def make_eval_step(
         return jax.jit(step)
     repl = mesh_lib.replicated(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
+    spatial = mesh_lib.spatial_enabled(model_def, mesh)
     return jax.jit(
         step,
-        in_shardings=(state_sh, mesh_lib.batch_sharding(mesh, 4),
+        in_shardings=(state_sh,
+                      mesh_lib.batch_sharding(mesh, 4, spatial=spatial),
                       mesh_lib.batch_sharding(mesh, 1)),
         out_shardings=repl,
     )

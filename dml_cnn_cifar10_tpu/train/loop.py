@@ -109,7 +109,9 @@ class Trainer:
             self.cfg.log_dir, state, sharding=sharding)
 
     def _placed(self, batch: pipe.Batch):
-        return mesh_lib.shard_batch(self.mesh, batch.images, batch.labels)
+        return mesh_lib.shard_batch(
+            self.mesh, batch.images, batch.labels,
+            spatial=mesh_lib.spatial_enabled(self.model_def, self.mesh))
 
     def evaluate(self, state, test_it: pipe.ShuffleBatchIterator) -> float:
         """Faithful: accuracy on ONE shuffled test batch
@@ -242,10 +244,12 @@ class Trainer:
             # Host-fed chunked path (multi-host, or dataset too big for
             # HBM): the host gathers raw uint8 bytes; decode/augment runs
             # on device inside the compiled chunk (ops/preprocess.py).
+            spatial = mesh_lib.spatial_enabled(self.model_def, self.mesh)
+
             def produce():
                 b = train_it.next_raw_chunk(k)
                 return mesh_lib.shard_batch(self.mesh, b.images, b.labels,
-                                            leading_dims=1)
+                                            leading_dims=1, spatial=spatial)
 
             prefetch = pipe.PrefetchIterator(
                 iter(produce, None), depth=cfg.data.prefetch, place=None)

@@ -1,0 +1,154 @@
+"""Spatial partitioning: conv models shard the image H dim over ``seq``.
+
+The vision analog of sequence parallelism — activations for large images
+split spatially across devices, GSPMD inserting the conv/pool halo
+exchanges. The reference has nothing like it (fixed 24x24 inputs,
+``cifar10cnn.py:17-18``); it is a pure TPU-scale capability. Tests prove
+the input really lands H-sharded and the math is identical to plain dp,
+on the 8-virtual-device CPU mesh.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                        ParallelConfig)
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+DATA = DataConfig(normalize="scale")
+
+
+def _mesh(data, seq):
+    return mesh_lib.build_mesh(ParallelConfig(data_axis=data, seq_axis=seq))
+
+
+def _run(model_cfg, mesh, images, labels, nsteps=3):
+    model_def = get_model(model_cfg.name)
+    optim = OptimConfig(learning_rate=0.01)
+    sh = step_lib.train_state_shardings(mesh, model_def, model_cfg, DATA,
+                                        optim)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, model_cfg, DATA, optim, mesh,
+        state_sharding=sh)
+    train = step_lib.make_train_step(model_def, model_cfg, optim, mesh,
+                                     state_sharding=sh)
+    spatial = model_def.spatial and mesh.shape["seq"] > 1
+    im, lb = mesh_lib.shard_batch(mesh, images, labels, spatial=spatial)
+    losses = []
+    for _ in range(nsteps):
+        state, metrics = train(state, im, lb)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    return state, losses, im
+
+
+def test_images_land_h_sharded(rng):
+    mesh = _mesh(4, 2)
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    im, _ = mesh_lib.shard_batch(mesh, images, labels, spatial=True)
+    assert im.sharding.spec == P("data", "seq", None, None)
+    assert im.addressable_shards[0].data.shape == (16 // 4, 24 // 2, 24, 3)
+
+
+def test_cnn_spatial_matches_dp(rng):
+    """data=4 x seq=2 (H halved per shard) must equal pure dp: the halo
+    exchange reconstructs exactly the rows SAME conv/pool padding needs."""
+    cfg = ModelConfig(logit_relu=False)
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    _, loss_dp, _ = _run(cfg, _mesh(8, 1), images, labels)
+    st, loss_sp, im = _run(cfg, _mesh(4, 2), images, labels)
+    np.testing.assert_allclose(loss_dp, loss_sp, rtol=1e-5, atol=1e-6)
+
+
+def test_resnet_spatial_matches_dp(rng):
+    """BatchNorm under spatial sharding: the batch statistics reduce over
+    (B, H, W) — GSPMD turns the partial spatial sums into a cross-device
+    reduction, so stats (and therefore training) match plain dp."""
+    cfg = ModelConfig(name="resnet18", logit_relu=False)
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    _, loss_dp, _ = _run(cfg, _mesh(8, 1), images, labels, nsteps=2)
+    _, loss_sp, _ = _run(cfg, _mesh(4, 2), images, labels, nsteps=2)
+    np.testing.assert_allclose(loss_dp, loss_sp, rtol=2e-5, atol=2e-6)
+
+
+def test_vit_does_not_claim_spatial():
+    """ViTs use ``seq`` for token parallelism — ModelDef.spatial stays off
+    so the input sharding never puts image H on the seq axis."""
+    assert not get_model("vit_tiny").spatial
+    assert not get_model("vit_moe").spatial
+    assert get_model("cnn").spatial
+    assert get_model("resnet18").spatial
+    assert get_model("resnet50").spatial
+
+
+def test_spatial_resident_matches_hostfed(rng):
+    """The HBM-resident gather path pins the same spatial layout the
+    host-fed chunk uses: identical math on identical indices."""
+    mesh = _mesh(4, 2)
+    cfg = ModelConfig(logit_relu=False)
+    model_def = get_model("cnn")
+    optim = OptimConfig(learning_rate=0.01)
+    data_cfg = DataConfig(normalize="scale")
+    ds_images = rng.integers(0, 256, (64, 32, 32, 3)).astype(np.uint8)
+    ds_labels = rng.integers(0, 10, 64).astype(np.int32)
+    idx = rng.integers(0, 64, (2, 16)).astype(np.int32)
+
+    def fresh_state(sh):
+        return step_lib.init_train_state(
+            jax.random.key(0), model_def, cfg, data_cfg, optim, mesh,
+            state_sharding=sh)
+
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, data_cfg,
+                                        optim)
+    resident = step_lib.make_train_chunk_resident(
+        model_def, cfg, optim, mesh,
+        jax.device_put(ds_images, mesh_lib.replicated(mesh)),
+        jax.device_put(ds_labels, mesh_lib.replicated(mesh)),
+        state_sharding=sh, data_cfg=data_cfg)
+    st_r, m_r = resident(fresh_state(sh),
+                         jax.device_put(idx, mesh_lib.batch_sharding(
+                             mesh, 2, leading_dims=1)))
+
+    hostfed = step_lib.make_train_chunk(model_def, cfg, optim, mesh,
+                                        state_sharding=sh,
+                                        data_cfg=data_cfg)
+    im, lb = mesh_lib.shard_batch(mesh, ds_images[idx], ds_labels[idx],
+                                  leading_dims=1, spatial=True)
+    st_h, m_h = hostfed(fresh_state(sh), im, lb)
+    np.testing.assert_allclose(float(jax.device_get(m_r["loss"])),
+                               float(jax.device_get(m_h["loss"])),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(st_r.params),
+                    jax.tree.leaves(st_h.params)):
+        np.testing.assert_allclose(np.asarray(jax.device_get(a)),
+                                   np.asarray(jax.device_get(b)),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_spatial_chunked_step(rng):
+    """The K-step raw-uint8 chunk path under spatial sharding: device-side
+    decode (crop from 32 to 24) composes with the H-sharded layout."""
+    mesh = _mesh(4, 2)
+    cfg = ModelConfig(logit_relu=False)
+    model_def = get_model("cnn")
+    optim = OptimConfig(learning_rate=0.01)
+    data_cfg = DataConfig(normalize="scale")
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, data_cfg,
+                                        optim)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, cfg, data_cfg, optim, mesh,
+        state_sharding=sh)
+    chunk = step_lib.make_train_chunk(model_def, cfg, optim, mesh,
+                                      state_sharding=sh, data_cfg=data_cfg)
+    raw = rng.integers(0, 256, (2, 16, 32, 32, 3)).astype(np.uint8)
+    rlb = rng.integers(0, 10, (2, 16)).astype(np.int32)
+    im, lb = mesh_lib.shard_batch(mesh, raw, rlb, leading_dims=1,
+                                  spatial=True)
+    state, metrics = chunk(state, im, lb)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    assert int(jax.device_get(state.step)) == 2
