@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import re
+import time
 from typing import Any, Optional
 
 import jax
@@ -120,19 +121,35 @@ class CheckpointManager:
     """
 
     def __init__(self, ckpt_dir: str, every_steps: int, keep: int = 3,
-                 is_chief: Optional[bool] = None, async_save: bool = False):
+                 is_chief: Optional[bool] = None, async_save: bool = False,
+                 every_secs: Optional[float] = None):
         self.ckpt_dir = ckpt_dir
         self.every_steps = max(1, every_steps)
         self.keep = keep
         self.is_chief = (jax.process_index() == 0) if is_chief is None \
             else is_chief
         self.async_save = async_save
+        # Wall-clock cadence (the MonitoredTrainingSession default was
+        # time-based: save_checkpoint_secs=600, cifar10cnn.py:222). The
+        # clock only TRIGGERS via time_due(); the caller decides when to
+        # act on it — multi-host loops must agree first (fetch_to_host is
+        # a collective; one process saving alone would deadlock the rest),
+        # which train/loop.py does at its preemption-sync boundary.
+        self.every_secs = every_secs
+        self._last_time = time.monotonic()
         self._pool = None
         self._pending = None
         if async_save:
             import concurrent.futures
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="ckpt-writer")
+
+    def time_due(self) -> bool:
+        """True when the wall-clock cadence has elapsed since the last
+        save (this process's clock)."""
+        return bool(self.every_secs
+                    and time.monotonic() - self._last_time
+                    >= self.every_secs)
 
     def flush(self) -> None:
         """Wait for an in-flight async write; re-raise its error if any."""
@@ -152,6 +169,7 @@ class CheckpointManager:
     def maybe_save(self, state: Any, step: int, force: bool = False) -> bool:
         if not force and step % self.every_steps != 0:
             return False
+        self._last_time = time.monotonic()
         # Collective fetch BEFORE the chief check: with tensor-parallel
         # state on a multi-host mesh the gather is a collective, so every
         # process participates; only the chief touches the filesystem.

@@ -65,6 +65,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval_every", type=int, default=500,
                    help="eval cadence (reference EVAL_EVERY)")
     p.add_argument("--checkpoint_every", type=int, default=1000)
+    p.add_argument("--checkpoint_every_secs", type=float, default=None,
+                   help="wall-clock checkpoint cadence in addition to the "
+                        "step cadence (the reference's MTS saved every "
+                        "600 s by default)")
+    p.add_argument("--mode", type=str, default="train",
+                   choices=["train", "eval", "export"],
+                   help="train; eval = restore latest checkpoint and sweep "
+                        "the full test split; export = restore and write a "
+                        "self-contained jax.export serving artifact")
+    p.add_argument("--export_path", type=str, default=None,
+                   help="output file for --mode export "
+                        "(default <log_dir>/model.jaxexport)")
     p.add_argument("--learning_rate", type=float, default=0.1)
     p.add_argument("--fidelity", type=str, default="faithful",
                    choices=["faithful", "fixed"],
@@ -169,6 +181,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         output_every=args.output_every,
         eval_every=args.eval_every,
         checkpoint_every=args.checkpoint_every,
+        checkpoint_every_secs=args.checkpoint_every_secs,
         log_dir=args.log_dir,
         metrics_jsonl=args.metrics_jsonl,
         profile_dir=args.profile_dir,
@@ -251,6 +264,59 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     cfg = config_from_args(args)
     from dml_cnn_cifar10_tpu.train.loop import Trainer
+
+    if args.mode == "eval":
+        import jax
+
+        from dml_cnn_cifar10_tpu.data import pipeline as pipe
+        cfg.eval_full_test_set = True
+        trainer = Trainer(cfg, task_index=args.task_index)
+        state = trainer.init_or_restore()
+        step = int(jax.device_get(state.step))
+        if step == 0:
+            print(f"[cli] warning: no checkpoint under {cfg.log_dir}; "
+                  "evaluating fresh-initialized weights", file=sys.stderr)
+        # Per-process shard of the split, like fit(): each process feeds
+        # only its slice into the collective sweep — an unsharded pipeline
+        # would count every record process_count times.
+        num_shards = jax.process_count()
+        shard = jax.process_index()
+        test_it = pipe.input_pipeline(
+            cfg.data, cfg.batch_size // num_shards, train=False,
+            seed=cfg.seed + shard, shard=shard, num_shards=num_shards)
+        acc = trainer.evaluate(state, test_it)
+        print(f" --- Test Accuracy = {acc * 100:.2f}%.")
+        print(f"[cli] eval at step {step}: {acc * 100:.2f}% on "
+              f"{test_it.total_records} records")
+        return 0
+
+    if args.mode == "export":
+        import os
+
+        import jax
+
+        from dml_cnn_cifar10_tpu import export as export_lib
+        trainer = Trainer(cfg, task_index=args.task_index)
+        state = trainer.init_or_restore()
+        step = int(jax.device_get(state.step))
+        if step == 0:
+            print(f"[cli] warning: no checkpoint under {cfg.log_dir}; "
+                  "exporting fresh-initialized weights", file=sys.stderr)
+        path = args.export_path or f"{cfg.log_dir}/model.jaxexport"
+        # The host fetch inside export_forward is a collective when state
+        # is sharded multi-host: every process participates, the chief
+        # writes.
+        blob = export_lib.export_forward(
+            trainer.model_def, cfg.model, cfg.data, state.params,
+            state.model_state if trainer.model_def.has_state else None)
+        if jax.process_index() == 0:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            export_lib.save_exported(path, blob)
+            print(f"[cli] exported step-{step} forward ({len(blob)} bytes, "
+                  f"tpu+cpu, symbolic batch) to {path}")
+        return 0
+
     result = Trainer(cfg, task_index=args.task_index).fit()
     print(f"[cli] done at step {result.final_step}; "
           f"{result.images_per_sec:.1f} images/sec")

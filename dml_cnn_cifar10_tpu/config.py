@@ -223,6 +223,11 @@ class TrainConfig:
     eval_full_test_set: bool = False
     log_dir: str = "/tmp/train_logs"      # checkpoint dir (cifar10cnn.py:269-272)
     checkpoint_every: int = 1000          # steps; MTS default was 600s wall-clock
+    # Wall-clock checkpoint cadence IN ADDITION to the step cadence — the
+    # faithful MTS behavior (save_checkpoint_secs=600 default at
+    # cifar10cnn.py:222). None disables the clock trigger. Multi-host runs
+    # agree on it at the preemption-sync boundary (train/loop.py).
+    checkpoint_every_secs: Optional[float] = None
     keep_checkpoints: int = 3
     # Overlap checkpoint serialize+write with training on a background
     # writer thread (the device->host fetch stays synchronous — donated

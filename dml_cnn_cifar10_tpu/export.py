@@ -1,0 +1,105 @@
+"""Serving export: the trained forward pass as a portable XLA artifact.
+
+The reference has no deployment story at all — its only output is the
+checkpoint directory (``cifar10cnn.py:222``); serving would mean rebuilding
+the whole TF graph. The TPU-native answer is :mod:`jax.export`: serialize
+the jitted eval forward (params captured as constants) to StableHLO bytes
+that any later process — including one without this framework installed —
+can deserialize and call on TPU or CPU.
+
+The artifact is self-contained (weights embedded), has a symbolic batch
+dimension (any batch size at call time), and takes RAW uint8 full-size
+images — the device decode (cast/crop/normalize,
+:func:`~dml_cnn_cifar10_tpu.ops.preprocess.device_preprocess`) is compiled
+into it, so the serving input contract matches the on-disk CIFAR records,
+not the training-time float layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig
+from dml_cnn_cifar10_tpu.models.registry import ModelDef
+
+
+def make_serving_fn(model_def: ModelDef, model_cfg: ModelConfig,
+                    data_cfg: DataConfig, params: Any,
+                    model_state: Any = None):
+    """``fn(images_u8 [B, H, W, C]) -> logits [B, K]`` — eval-mode forward
+    with weights closed over and the eval decode fused in front."""
+    from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
+
+    eval_cfg = data_cfg.without_augmentation()
+
+    def fn(images_u8):
+        images = device_preprocess(images_u8, eval_cfg)
+        if model_def.has_state:
+            logits, _ = model_def.apply(params, model_state, images,
+                                        model_cfg, train=False)
+        elif model_def.has_aux:
+            logits, _ = model_def.apply(params, images, model_cfg,
+                                        train=False)
+        else:
+            logits = model_def.apply(params, images, model_cfg,
+                                     train=False)
+        return logits
+
+    return fn
+
+
+def export_forward(model_def: ModelDef, model_cfg: ModelConfig,
+                   data_cfg: DataConfig, params: Any,
+                   model_state: Any = None,
+                   platforms: Optional[list] = None) -> bytes:
+    """Serialize the serving forward to StableHLO bytes.
+
+    ``platforms`` defaults to ``["tpu", "cpu"]`` so one artifact serves
+    both the pod and a CPU canary. The batch dim is symbolic ("b"): the
+    deserialized callable accepts any batch size.
+    """
+    # Device arrays would serialize a sharding; fetch to host first so the
+    # artifact is placement-free. fetch_to_host handles sharded /
+    # non-fully-addressable state (collective on multi-host meshes — every
+    # process must call export_forward together).
+    from dml_cnn_cifar10_tpu.ckpt.checkpoint import fetch_to_host
+
+    params = jax.tree.map(np.asarray, fetch_to_host(params))
+    if model_state is not None:
+        model_state = jax.tree.map(np.asarray, fetch_to_host(model_state))
+    fn = make_serving_fn(model_def, model_cfg, data_cfg, params, model_state)
+    spec = jax.ShapeDtypeStruct(
+        (jax_export.symbolic_shape("b")[0], data_cfg.image_height,
+         data_cfg.image_width, data_cfg.num_channels), jnp.uint8)
+    exp = jax_export.export(
+        jax.jit(fn), platforms=platforms or ["tpu", "cpu"])(spec)
+    return exp.serialize()
+
+
+def save_exported(path: str, blob: bytes) -> None:
+    """Atomic write (tmp + rename, the checkpoint module's convention) so
+    a crash mid-write can't leave a truncated artifact for a server to
+    trip over."""
+    import os
+
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+def load_exported_bytes(blob: bytes):
+    """Deserialize an exported artifact; returns the jit-callable
+    ``fn(images_u8) -> logits``."""
+    return jax.jit(jax_export.deserialize(blob).call)
+
+
+def load_exported(path: str):
+    """:func:`load_exported_bytes` from a file."""
+    with open(path, "rb") as f:
+        return load_exported_bytes(f.read())

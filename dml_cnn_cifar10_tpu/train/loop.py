@@ -261,7 +261,8 @@ class Trainer:
 
         ckpt_mgr = ckpt_lib.CheckpointManager(
             cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints,
-            async_save=cfg.async_checkpoint)
+            async_save=cfg.async_checkpoint,
+            every_secs=cfg.checkpoint_every_secs)
         timer = StepTimer(cfg.batch_size * k)
         train_loss, test_accuracy = [], []
 
@@ -316,10 +317,22 @@ class Trainer:
                 # the same iteration.
                 if num_shards == 1:
                     stop = preempt.requested
+                    # Wall-clock checkpoint cadence (MTS parity: the
+                    # reference's MonitoredTrainingSession saved every
+                    # 600 s by default, cifar10cnn.py:222).
+                    if ckpt_mgr.time_due():
+                        ckpt_mgr.maybe_save(state, global_step, force=True)
                 elif n_dispatch % sync_stride == 0:
                     from jax.experimental import multihost_utils
-                    stop = bool(multihost_utils.process_allgather(
-                        np.asarray(preempt.requested)).any())
+                    # One DCN allgather carries both flags: no process may
+                    # leave the loop OR enter the collective checkpoint
+                    # fetch alone.
+                    flags = multihost_utils.process_allgather(
+                        np.asarray([preempt.requested,
+                                    ckpt_mgr.time_due()]))
+                    stop = bool(np.asarray(flags)[..., 0].any())
+                    if bool(np.asarray(flags)[..., 1].any()):
+                        ckpt_mgr.maybe_save(state, global_step, force=True)
 
             # Final save covers both normal completion and preemption: the
             # in-flight step finished, so the checkpoint loses zero work.
