@@ -169,12 +169,16 @@ class CheckpointManager:
     def maybe_save(self, state: Any, step: int, force: bool = False) -> bool:
         if not force and step % self.every_steps != 0:
             return False
-        self._last_time = time.monotonic()
         # Collective fetch BEFORE the chief check: with tensor-parallel
         # state on a multi-host mesh the gather is a collective, so every
         # process participates; only the chief touches the filesystem.
         host_state = fetch_to_host(state)
         if not self.is_chief:
+            # Clock reset AFTER the slow part (the collective fetch /
+            # write): resetting on entry would count the save's own
+            # duration against the next interval, turning any
+            # every_secs shorter than one save into a checkpoint storm.
+            self._last_time = time.monotonic()
             return False
         if self.async_save:
             self.flush()  # ordered writes + surface prior errors
@@ -184,4 +188,5 @@ class CheckpointManager:
         else:
             _write_checkpoint(self.ckpt_dir, host_state, step,
                               keep=self.keep)
+        self._last_time = time.monotonic()
         return True

@@ -167,6 +167,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serialize+write checkpoints on a background "
                         "thread (training overlaps the disk IO)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--tensorboard_dir", type=str, default=None,
+                   help="write TensorBoard event files (chief only; the "
+                        "reference's MTS wrote summaries to --log_dir)")
     p.add_argument("--profile_dir", type=str, default=None)
     p.add_argument("--seed", type=int, default=0)
     return p
@@ -184,6 +187,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         checkpoint_every_secs=args.checkpoint_every_secs,
         log_dir=args.log_dir,
         metrics_jsonl=args.metrics_jsonl,
+        tensorboard_dir=args.tensorboard_dir,
         profile_dir=args.profile_dir,
         seed=args.seed,
     )

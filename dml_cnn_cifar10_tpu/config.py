@@ -256,6 +256,9 @@ class TrainConfig:
     # collective. Single-process runs react to the signal immediately.
     preempt_sync_every: int = 10
     metrics_jsonl: Optional[str] = None   # structured metrics sink
+    # TensorBoard event-file dir (chief only) — the MTS wrote summaries to
+    # --log_dir by default (cifar10cnn.py:222); opt-in here.
+    tensorboard_dir: Optional[str] = None
     seed: int = 0
     profile_dir: Optional[str] = None     # jax.profiler trace output
 

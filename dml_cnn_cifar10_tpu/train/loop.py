@@ -91,7 +91,10 @@ class Trainer:
         self.eval_step = step_lib.make_eval_step(
             self.model_def, cfg.model, self.mesh,
             state_sharding=self.state_sharding)
-        self.logger = MetricsLogger(cfg.metrics_jsonl, task_index=task_index)
+        self.logger = MetricsLogger(
+            cfg.metrics_jsonl, task_index=task_index,
+            tensorboard_dir=(cfg.tensorboard_dir
+                             if jax.process_index() == 0 else None))
         # Resident-eval fns; built per-fit when the resident path is active.
         self._resident_full_eval = None
         self._resident_test_eval = None

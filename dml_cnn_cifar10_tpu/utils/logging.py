@@ -25,13 +25,25 @@ def _finite(v):
 
 
 class MetricsLogger:
-    def __init__(self, jsonl_path: Optional[str] = None, task_index: int = 0):
+    def __init__(self, jsonl_path: Optional[str] = None, task_index: int = 0,
+                 tensorboard_dir: Optional[str] = None):
         self.task_index = task_index
         self._file = None
         if jsonl_path:
             os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
             self._file = open(jsonl_path, "a", buffering=1)
         self._t0 = time.time()
+        # TensorBoard event files — the MonitoredTrainingSession wrote
+        # summaries to --log_dir by default (cifar10cnn.py:222); opt-in
+        # here because the writer import is heavyweight. Only scalar
+        # fields accompanied by a ``step`` are recorded.
+        self._tb = None
+        if tensorboard_dir:
+            # tensorboardX over torch.utils.tensorboard: identical
+            # add_scalar/close API without dragging the full torch
+            # runtime into a JAX process.
+            from tensorboardX import SummaryWriter
+            self._tb = SummaryWriter(log_dir=tensorboard_dir)
 
     def log(self, kind: str, **fields) -> None:
         if self._file is not None:
@@ -39,6 +51,12 @@ class MetricsLogger:
                    "task": self.task_index,
                    **{k: _finite(v) for k, v in fields.items()}}
             self._file.write(json.dumps(rec, allow_nan=False) + "\n")
+        if self._tb is not None and "step" in fields:
+            step = fields["step"]
+            for k, v in fields.items():
+                if k != "step" and isinstance(v, (int, float)) \
+                        and _finite(v) is not None:
+                    self._tb.add_scalar(f"{kind}/{k}", v, step)
 
     def train_print(self, global_step: int, local_step: int,
                     train_accuracy: float) -> None:
@@ -54,3 +72,6 @@ class MetricsLogger:
         if self._file is not None:
             self._file.close()
             self._file = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None

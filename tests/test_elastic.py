@@ -1,0 +1,101 @@
+"""Elastic resume: checkpoints restore across mesh shapes and layouts.
+
+The reference's only fault-tolerance story is restart-with-same---log_dir
+(MTS restore, ``cifar10cnn.py:222``) on the SAME cluster shape. Here the
+checkpoint stores placement-free host arrays, so a job can come back on a
+different device count or a different parallelism layout — shrink 8→4
+devices, switch dp→fsdp, switch replicated→tensor-parallel — and training
+continues from the saved step with identical math.
+"""
+
+import jax
+import numpy as np
+
+from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                        ParallelConfig)
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+DATA = DataConfig(normalize="scale")
+CFG = ModelConfig(logit_relu=False)
+OPT = OptimConfig(learning_rate=0.01, momentum=0.9)
+
+
+def _setup(mesh, fsdp=False):
+    model_def = get_model("cnn")
+    sh = step_lib.train_state_shardings(mesh, model_def, CFG, DATA, OPT,
+                                        fsdp=fsdp)
+    train = step_lib.make_train_step(model_def, CFG, OPT, mesh,
+                                     state_sharding=sh)
+    return model_def, sh, train
+
+
+def _batch(rng, n=16):
+    return (rng.normal(0.5, 0.25, (n, 24, 24, 3)).astype(np.float32),
+            rng.integers(0, 10, n).astype(np.int32))
+
+
+def test_resume_across_mesh_shapes(tmp_path, rng):
+    """Train on an 8-device dp mesh, save; resume on a 4-device dp x tp
+    mesh with fsdp — step count, params, and forward math all carry over."""
+    images, labels = _batch(rng)
+
+    mesh_a = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    model_def, sh_a, train_a = _setup(mesh_a)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, CFG, DATA, OPT, mesh_a,
+        state_sharding=sh_a)
+    im, lb = mesh_lib.shard_batch(mesh_a, images, labels)
+    for _ in range(3):
+        state, _ = train_a(state, im, lb)
+    ckpt_lib.save_checkpoint(str(tmp_path), state, step=3)
+    want_params = jax.device_get(state.params)
+
+    # "Cluster shrank": 4 devices, different layout (tp=2 + fsdp).
+    mesh_b = mesh_lib.build_mesh(
+        ParallelConfig(data_axis=2, model_axis=2),
+        devices=jax.devices()[:4])
+    model_def, sh_b, train_b = _setup(mesh_b, fsdp=True)
+    fresh = step_lib.init_train_state(
+        jax.random.key(9), model_def, CFG, DATA, OPT, mesh_b,
+        state_sharding=sh_b)
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), fresh,
+                                           sharding=sh_b)
+    assert int(jax.device_get(restored.step)) == 3
+    for a, b in zip(jax.tree.leaves(want_params),
+                    jax.tree.leaves(jax.device_get(restored.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # The momentum buffers restored too (same layout rules as params) and
+    # training continues: one more step on the new mesh equals the same
+    # step taken on the old mesh, to fp32 tolerance.
+    im_b, lb_b = mesh_lib.shard_batch(mesh_b, images, labels)
+    cont_b, mb = train_b(restored, im_b, lb_b)
+    cont_a, ma = train_a(state, im, lb)
+    np.testing.assert_allclose(float(jax.device_get(ma["loss"])),
+                               float(jax.device_get(mb["loss"])),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(jax.device_get(cont_a.params)),
+                    jax.tree.leaves(jax.device_get(cont_b.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+    assert int(jax.device_get(cont_b.step)) == 4
+
+
+def test_trainer_resume_on_different_parallelism(tmp_path, data_cfg):
+    """Driver-level: fit() on dp, resume fit() with fsdp+tp from the same
+    log_dir (the restart-with-same---log_dir contract, now elastic)."""
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+    from tests.conftest import tiny_train_cfg
+
+    cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=4)
+    r1 = Trainer(cfg).fit()
+    assert r1.final_step == 4
+
+    cfg2 = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=8)
+    cfg2.parallel = ParallelConfig(data_axis=4, model_axis=2, fsdp=True)
+    r2 = Trainer(cfg2).fit()
+    assert r2.final_step == 8
+    assert np.isfinite(r2.train_loss).all()

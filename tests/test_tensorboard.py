@@ -1,0 +1,19 @@
+"""TensorBoard event-file sink (the MTS-wrote-summaries parity knob)."""
+
+import os
+
+
+def test_tb_event_files_written(tmp_path):
+    from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+
+    tb_dir = str(tmp_path / "tb")
+    logger = MetricsLogger(tensorboard_dir=tb_dir)
+    logger.log("train", step=10, loss=1.5, train_accuracy=0.25,
+               images_per_sec=1000.0, lr=0.1)
+    logger.log("eval", step=10, test_accuracy=0.3)
+    logger.log("train", step=20, loss=float("nan"))  # NaN must not crash
+    logger.log("done", images_per_sec=1000.0)        # no step: skipped
+    logger.close()
+    events = [f for f in os.listdir(tb_dir) if "tfevents" in f]
+    assert events, os.listdir(tb_dir)
+    assert os.path.getsize(os.path.join(tb_dir, events[0])) > 0
