@@ -140,8 +140,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute_dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--optimizer", type=str, default="sgd",
-                   choices=["sgd", "adamw"],
-                   help="sgd = reference; adamw for the transformer ladder")
+                   choices=["sgd", "adamw", "lars", "lamb"],
+                   help="sgd = reference; adamw for the transformer "
+                        "ladder; lars/lamb add the per-layer trust ratio "
+                        "for large-global-batch scaling")
     p.add_argument("--momentum", type=float, default=0.0,
                    help="SGD momentum (reference uses plain SGD)")
     p.add_argument("--weight_decay", type=float, default=0.0)

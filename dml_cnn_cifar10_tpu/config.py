@@ -165,8 +165,13 @@ class OptimConfig:
     cosine_decay_steps: int = 0
     # Optimizer family. "sgd" (+ optional momentum) is the reference's;
     # "adamw" (decoupled weight decay, bias-corrected moments) is the
-    # transformer-ladder standard.
-    optimizer: str = "sgd"                # sgd | adamw
+    # transformer-ladder standard; "lars"/"lamb" add the per-layer trust
+    # ratio that makes LARGE global batches trainable — the natural
+    # companion of wide ``data``-axis scaling (You et al. 2017/2019).
+    optimizer: str = "sgd"                # sgd | adamw | lars | lamb
+    # LARS trust coefficient (eta in the paper) and norm-guard epsilon.
+    lars_trust_coef: float = 0.001
+    lars_eps: float = 1e-9
     # Label smoothing ε for the CE loss (0 = reference parity).
     label_smoothing: float = 0.0
     adam_b1: float = 0.9

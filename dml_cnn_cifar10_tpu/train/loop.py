@@ -352,6 +352,7 @@ class Trainer:
                                 signum=preempt.signum)
             self.logger.log("done", step=global_step,
                             images_per_sec=timer.images_per_sec)
+            self.logger.flush()
         # Release the fit-scoped resident closures — their partials pin
         # the train/test splits in HBM.
         self._resident_full_eval = None

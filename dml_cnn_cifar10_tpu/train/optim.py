@@ -66,13 +66,17 @@ def sgd_init(params: Any, cfg: OptimConfig) -> OptState:
     """Optimizer-state init for the configured family (name kept for the
     historical sgd-only API; dispatches on ``cfg.optimizer``)."""
     state: OptState = {"step": jnp.zeros((), jnp.int32)}
-    if cfg.optimizer == "adamw":
+    if cfg.optimizer in ("adamw", "lamb"):
         if cfg.momentum:
             raise ValueError(
-                "momentum is an SGD knob; AdamW's first moment is adam_b1 "
-                "— drop --momentum or use --optimizer sgd")
+                f"momentum is an SGD/LARS knob; {cfg.optimizer}'s first "
+                "moment is adam_b1 — drop --momentum")
         state["mu"] = jax.tree.map(jnp.zeros_like, params)
         state["nu"] = jax.tree.map(jnp.zeros_like, params)
+    elif cfg.optimizer == "lars":
+        # LARS always carries momentum (paper default 0.9; our
+        # cfg.momentum=0 means "use the conventional 0.9").
+        state["momentum"] = jax.tree.map(jnp.zeros_like, params)
     elif cfg.optimizer == "sgd":
         if cfg.momentum:
             state["momentum"] = jax.tree.map(jnp.zeros_like, params)
@@ -121,6 +125,58 @@ def sgd_update(
         new_params = jax.tree.map(upd, params, mu, nu)
         return new_params, {"step": step + 1, "mu": mu, "nu": nu}
 
+    if cfg.optimizer == "lamb":
+        t = (step + 1).astype(jnp.float32)
+        b1, b2 = cfg.adam_b1, cfg.adam_b2
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                          state["mu"], grads)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g),
+                          state["nu"], grads)
+        bc1 = 1.0 - b1 ** t
+        bc2 = 1.0 - b2 ** t
+
+        def lamb_upd(p, m, v):
+            # AdamW direction, then the per-layer trust ratio rescales
+            # the step to the weight's own norm (You et al. 2019 /
+            # optax.scale_by_trust_ratio semantics: ratio 1 when either
+            # norm is zero).
+            r = (m / bc1) / (jnp.sqrt(v / bc2) + cfg.adam_eps) \
+                + cfg.weight_decay * p
+            return p - (lr * _trust_ratio(p, r) * r).astype(p.dtype)
+
+        new_params = jax.tree.map(lamb_upd, params, mu, nu)
+        return new_params, {"step": step + 1, "mu": mu, "nu": nu}
+
+    if cfg.optimizer == "lars":
+        beta = cfg.momentum or 0.9
+
+        def local_gradient(p, g):
+            # Trust-adapted gradient, optax-style convention: local LR
+            # eta*||w||/(||g + wd*w|| + eps) — the decayed gradient's
+            # norm, NOT the paper's ||g|| + wd*||w|| split (they differ
+            # when g and w aren't parallel; test_lars_local_lr_formula
+            # pins this form). 1-D leaves (biases, BN) skip the
+            # adaptation, the standard practice.
+            g = g + cfg.weight_decay * p
+            if p.ndim <= 1:
+                return g
+            pn = jnp.linalg.norm(p)
+            gn = jnp.linalg.norm(g)
+            local = jnp.where(
+                pn > 0,
+                jnp.where(gn > 0,
+                          cfg.lars_trust_coef * pn / (gn + cfg.lars_eps),
+                          1.0),
+                1.0)
+            return local * g
+
+        adapted = jax.tree.map(local_gradient, params, grads)
+        mom = jax.tree.map(lambda m, g: beta * m + g,
+                           state["momentum"], adapted)
+        new_params = jax.tree.map(lambda p, m: p - (lr * m).astype(p.dtype),
+                                  params, mom)
+        return new_params, {"step": step + 1, "momentum": mom}
+
     if cfg.weight_decay:
         grads = jax.tree.map(lambda g, p: g + cfg.weight_decay * p,
                              grads, params)
@@ -135,8 +191,19 @@ def sgd_update(
     return new_params, new_state
 
 
+def _trust_ratio(p: jax.Array, u: jax.Array) -> jax.Array:
+    """||p|| / ||u|| with optax's safe guards: 1 when either norm is 0."""
+    pn = jnp.linalg.norm(p)
+    un = jnp.linalg.norm(u)
+    return jnp.where(pn > 0, jnp.where(un > 0, pn / un, 1.0), 1.0)
+
+
 def as_optax(cfg: OptimConfig):
-    """The same optimizer as an optax ``GradientTransformation``."""
+    """The configured optimizer as an optax ``GradientTransformation``.
+
+    sgd/adamw/lamb compose to the same math as :func:`sgd_update` (LAMB is
+    test-pinned to ``optax.lamb``). LARS is the closest optax composition
+    — see the inline note on the lr-vs-trace ordering difference."""
     import optax
 
     def schedule(count):
@@ -148,6 +215,22 @@ def as_optax(cfg: OptimConfig):
         return optax.chain(*clip, optax.adamw(
             schedule, b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps,
             weight_decay=cfg.weight_decay))
+    if cfg.optimizer == "lamb":
+        return optax.chain(*clip, optax.lamb(
+            schedule, b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps,
+            weight_decay=cfg.weight_decay))
+    if cfg.optimizer == "lars":
+        # Closest optax composition, NOT bit-identical to sgd_update's
+        # LARS: optax scales by lr before the momentum trace (ours
+        # after), so momentum trajectories diverge under a non-constant
+        # schedule. The adaptation mask (skip 1-D leaves) and eps ARE
+        # forwarded to match.
+        return optax.chain(*clip, optax.lars(
+            schedule, weight_decay=cfg.weight_decay,
+            trust_coefficient=cfg.lars_trust_coef, eps=cfg.lars_eps,
+            trust_ratio_mask=lambda params: jax.tree.map(
+                lambda p: p.ndim > 1, params),
+            momentum=cfg.momentum or 0.9))
     tx = clip + ([optax.trace(decay=cfg.momentum)] if cfg.momentum else [])
     if cfg.weight_decay:
         tx.append(optax.add_decayed_weights(cfg.weight_decay))

@@ -68,6 +68,15 @@ class MetricsLogger:
         # Reference's eval line (cifar10cnn.py:240-241).
         print(" --- Test Accuracy = {:.2f}%.".format(100.0 * test_accuracy))
 
+    def flush(self) -> None:
+        """Force both sinks to disk — tensorboardX's event writer is a
+        daemon thread (flush_secs=120) that dies unflushed at interpreter
+        exit, so the driver flushes at every fit() end."""
+        if self._file is not None:
+            self._file.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
     def close(self) -> None:
         if self._file is not None:
             self._file.close()

@@ -1,0 +1,132 @@
+"""LARS / LAMB: per-layer trust-ratio optimizers for large-batch scaling.
+
+No reference counterpart (plain SGD, ``cifar10cnn.py:162``) — these are
+the standard companions of wide ``data``-axis scaling. LAMB is pinned
+numerically against optax.lamb; LARS against a NumPy hand-computation of
+You et al.'s local-LR formula.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                        ParallelConfig)
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+from dml_cnn_cifar10_tpu.train import optim
+
+
+def _tree(rng):
+    return {
+        "layer": {"kernel": rng.normal(0, 0.5, (6, 4)).astype(np.float32),
+                  "bias": rng.normal(0, 0.1, (4,)).astype(np.float32)},
+    }
+
+
+def test_lamb_matches_optax(rng):
+    import optax
+
+    cfg = OptimConfig(optimizer="lamb", learning_rate=0.01,
+                      weight_decay=0.01, schedule="constant")
+    params = _tree(rng)
+    grads = jax.tree.map(lambda p: np.asarray(
+        rng.normal(0, 0.2, p.shape), np.float32), params)
+
+    state = optim.sgd_init(params, cfg)
+    ours = params
+    ref = optax.lamb(0.01, b1=cfg.adam_b1, b2=cfg.adam_b2,
+                     eps=cfg.adam_eps, weight_decay=0.01)
+    ref_state = ref.init(params)
+    theirs = params
+    for _ in range(3):
+        ours, state = optim.sgd_update(grads, state, ours, cfg)
+        updates, ref_state = ref.update(grads, ref_state, theirs)
+        theirs = optax.apply_updates(theirs, updates)
+    for a, b in zip(jax.tree.leaves(ours), jax.tree.leaves(theirs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_lars_local_lr_formula(rng):
+    """One LARS step against NumPy: adapted kernel gets
+    eta*||w||/(||g+wd*w||-ish) scaling, 1-D bias skips adaptation,
+    momentum buffer accumulates the adapted gradient."""
+    cfg = OptimConfig(optimizer="lars", learning_rate=0.1,
+                      weight_decay=0.01, momentum=0.9,
+                      schedule="constant", lars_trust_coef=0.001)
+    params = _tree(rng)
+    grads = jax.tree.map(lambda p: np.asarray(
+        rng.normal(0, 0.2, p.shape), np.float32), params)
+
+    state = optim.sgd_init(params, cfg)
+    new_params, new_state = optim.sgd_update(grads, state, params, cfg)
+
+    w = params["layer"]["kernel"]
+    g = grads["layer"]["kernel"] + 0.01 * w
+    local = 0.001 * np.linalg.norm(w) / (np.linalg.norm(g) + cfg.lars_eps)
+    want_kernel = w - 0.1 * (local * g)          # m0 = 0 -> m1 = adapted g
+    np.testing.assert_allclose(
+        np.asarray(new_params["layer"]["kernel"]), want_kernel,
+        rtol=1e-5, atol=1e-7)
+
+    b = params["layer"]["bias"]
+    gb = grads["layer"]["bias"] + 0.01 * b       # no trust adaptation
+    np.testing.assert_allclose(
+        np.asarray(new_params["layer"]["bias"]), b - 0.1 * gb,
+        rtol=1e-5, atol=1e-7)
+    assert int(new_state["step"]) == 1
+
+
+def test_lars_zero_norm_guard():
+    """Zero weights / zero grads take the ratio-1 path, no NaN."""
+    cfg = OptimConfig(optimizer="lars", learning_rate=0.1,
+                      schedule="constant")
+    params = {"k": jnp.zeros((3, 3)), "b": jnp.zeros((3,))}
+    grads = {"k": jnp.zeros((3, 3)), "b": jnp.zeros((3,))}
+    state = optim.sgd_init(params, cfg)
+    new_params, _ = optim.sgd_update(grads, state, params, cfg)
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree.leaves(new_params))
+
+
+def test_lars_trains_under_fsdp(rng):
+    """LARS momentum buffers shard like params (same 'momentum' key the
+    sharding rules already map) and a large-batch step runs on the
+    dp x fsdp mesh."""
+    data = DataConfig(normalize="scale")
+    cfg = ModelConfig(logit_relu=False)
+    optim_cfg = OptimConfig(optimizer="lars", learning_rate=0.1,
+                            weight_decay=1e-4, schedule="constant",
+                            warmup_steps=2)
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    model_def = get_model("cnn")
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, data,
+                                        optim_cfg, fsdp=True)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, cfg, data, optim_cfg, mesh,
+        state_sharding=sh)
+    train = step_lib.make_train_step(model_def, cfg, optim_cfg, mesh,
+                                     state_sharding=sh)
+    images = rng.normal(0.5, 0.25, (64, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 64).astype(np.int32)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    losses = []
+    for _ in range(3):
+        state, metrics = train(state, im, lb)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    assert np.isfinite(losses).all()
+    from dml_cnn_cifar10_tpu.parallel import shardings
+    assert shardings.assert_some_leaf_sharded(state.opt["momentum"],
+                                              axis="data")
+
+
+def test_lamb_rejects_momentum():
+    import pytest
+
+    with pytest.raises(ValueError, match="momentum"):
+        optim.sgd_init({"w": jnp.ones(2)},
+                       OptimConfig(optimizer="lamb", momentum=0.9))
