@@ -277,81 +277,87 @@ class Trainer:
         # STEPS regardless of chunk size (at least every dispatch).
         sync_stride = max(1, cfg.preempt_sync_every // k)
         n_dispatch = 0
-        with PreemptionGuard() as preempt, profile_trace(cfg.profile_dir):
-            while global_step < total_steps and not stop:
-                state, metrics = step_fn(state, *next(prefetch))
-                global_step += k
-                timer.tick()
+        try:
+            with PreemptionGuard() as preempt, profile_trace(cfg.profile_dir):
+                while global_step < total_steps and not stop:
+                    state, metrics = step_fn(state, *next(prefetch))
+                    global_step += k
+                    timer.tick()
 
-                if (i + k) % cfg.output_every == 0:
-                    # Fresh-batch train accuracy (cifar10cnn.py:235), then
-                    # ONE fused device->host fetch for loss+accuracy.
-                    if self._resident_acc_eval is not None:
-                        aidx = jax.device_put(acc_it.next_index_chunk(1)[0],
-                                              self._idx1_sharding)
-                        acc_arr = self._resident_acc_eval(state, aidx)
-                    else:
-                        acc_arr = self.eval_step(
-                            state, *self._placed(next(acc_it)))["accuracy"]
-                    pair = jax.device_get(
-                        jnp.stack([metrics["loss"],
-                                   jnp.asarray(acc_arr, jnp.float32)]))
-                    loss, acc = float(pair[0]), float(pair[1])
-                    train_loss.append(loss)
-                    self.logger.train_print(global_step, i + k - 1, acc)
-                    self.logger.log("train", step=global_step, loss=loss,
-                                    train_accuracy=acc,
-                                    images_per_sec=timer.images_per_sec,
-                                    lr=_current_lr(cfg, global_step))
-                if (i + k) % cfg.eval_every == 0:
-                    ta = self.evaluate(state, test_it)
-                    test_accuracy.append(ta)
-                    self.logger.eval_print(ta)
-                    self.logger.log("eval", step=global_step,
-                                    test_accuracy=ta)
-                ckpt_mgr.maybe_save(state, global_step)
-                i += k
-                n_dispatch += 1
-                # Preemption: a single process reacts immediately; a
-                # multi-host job must AGREE first — under synchronous SPMD
-                # no process may leave the step loop alone (its peers would
-                # hang in the next collective), so the flag is allgathered
-                # at a shared dispatch boundary and every process exits on
-                # the same iteration.
-                if num_shards == 1:
-                    stop = preempt.requested
-                    # Wall-clock checkpoint cadence (MTS parity: the
-                    # reference's MonitoredTrainingSession saved every
-                    # 600 s by default, cifar10cnn.py:222).
-                    if ckpt_mgr.time_due():
-                        ckpt_mgr.maybe_save(state, global_step, force=True)
-                elif n_dispatch % sync_stride == 0:
-                    from jax.experimental import multihost_utils
-                    # One DCN allgather carries both flags: no process may
-                    # leave the loop OR enter the collective checkpoint
-                    # fetch alone.
-                    flags = multihost_utils.process_allgather(
-                        np.asarray([preempt.requested,
-                                    ckpt_mgr.time_due()]))
-                    stop = bool(np.asarray(flags)[..., 0].any())
-                    if bool(np.asarray(flags)[..., 1].any()):
-                        ckpt_mgr.maybe_save(state, global_step, force=True)
+                    if (i + k) % cfg.output_every == 0:
+                        # Fresh-batch train accuracy (cifar10cnn.py:235), then
+                        # ONE fused device->host fetch for loss+accuracy.
+                        if self._resident_acc_eval is not None:
+                            aidx = jax.device_put(acc_it.next_index_chunk(1)[0],
+                                                  self._idx1_sharding)
+                            acc_arr = self._resident_acc_eval(state, aidx)
+                        else:
+                            acc_arr = self.eval_step(
+                                state, *self._placed(next(acc_it)))["accuracy"]
+                        pair = jax.device_get(
+                            jnp.stack([metrics["loss"],
+                                       jnp.asarray(acc_arr, jnp.float32)]))
+                        loss, acc = float(pair[0]), float(pair[1])
+                        train_loss.append(loss)
+                        self.logger.train_print(global_step, i + k - 1, acc)
+                        self.logger.log("train", step=global_step, loss=loss,
+                                        train_accuracy=acc,
+                                        images_per_sec=timer.images_per_sec,
+                                        lr=_current_lr(cfg, global_step))
+                    if (i + k) % cfg.eval_every == 0:
+                        ta = self.evaluate(state, test_it)
+                        test_accuracy.append(ta)
+                        self.logger.eval_print(ta)
+                        self.logger.log("eval", step=global_step,
+                                        test_accuracy=ta)
+                    ckpt_mgr.maybe_save(state, global_step)
+                    i += k
+                    n_dispatch += 1
+                    # Preemption: a single process reacts immediately; a
+                    # multi-host job must AGREE first — under synchronous SPMD
+                    # no process may leave the step loop alone (its peers would
+                    # hang in the next collective), so the flag is allgathered
+                    # at a shared dispatch boundary and every process exits on
+                    # the same iteration.
+                    if num_shards == 1:
+                        stop = preempt.requested
+                        # Wall-clock checkpoint cadence (MTS parity: the
+                        # reference's MonitoredTrainingSession saved every
+                        # 600 s by default, cifar10cnn.py:222).
+                        if ckpt_mgr.time_due():
+                            ckpt_mgr.maybe_save(state, global_step, force=True)
+                    elif n_dispatch % sync_stride == 0:
+                        from jax.experimental import multihost_utils
+                        # One DCN allgather carries both flags: no process may
+                        # leave the loop OR enter the collective checkpoint
+                        # fetch alone.
+                        flags = multihost_utils.process_allgather(
+                            np.asarray([preempt.requested,
+                                        ckpt_mgr.time_due()]))
+                        stop = bool(np.asarray(flags)[..., 0].any())
+                        if bool(np.asarray(flags)[..., 1].any()):
+                            ckpt_mgr.maybe_save(state, global_step, force=True)
 
-            # Final save covers both normal completion and preemption: the
-            # in-flight step finished, so the checkpoint loses zero work.
-            # It runs INSIDE the guard so a second signal during the
-            # write (Ctrl-C twice, pool re-sending SIGTERM) can't kill the
-            # process before the atomic rename lands.
-            ckpt_mgr.maybe_save(state, global_step, force=True)
-            ckpt_mgr.close()  # drain + stop the async writer thread
-            prefetch.close()
-            if stop:
-                print(f"[preempt] signal {preempt.signum}: checkpointed at "
-                      f"step {global_step}, exiting cleanly")
-                self.logger.log("preempt", step=global_step,
-                                signum=preempt.signum)
-            self.logger.log("done", step=global_step,
-                            images_per_sec=timer.images_per_sec)
+                # Final save covers both normal completion and preemption: the
+                # in-flight step finished, so the checkpoint loses zero work.
+                # It runs INSIDE the guard so a second signal during the
+                # write (Ctrl-C twice, pool re-sending SIGTERM) can't kill the
+                # process before the atomic rename lands.
+                ckpt_mgr.maybe_save(state, global_step, force=True)
+                ckpt_mgr.close()  # drain + stop the async writer thread
+                prefetch.close()
+                if stop:
+                    print(f"[preempt] signal {preempt.signum}: checkpointed at "
+                          f"step {global_step}, exiting cleanly")
+                    self.logger.log("preempt", step=global_step,
+                                    signum=preempt.signum)
+                self.logger.log("done", step=global_step,
+                                images_per_sec=timer.images_per_sec)
+        finally:
+            # Crash paths flush too: tensorboardX's daemon
+            # writer dies unflushed at interpreter exit, and
+            # an OOM/NaN abort is exactly when the last
+            # scalars matter.
             self.logger.flush()
         # Release the fit-scoped resident closures — their partials pin
         # the train/test splits in HBM.

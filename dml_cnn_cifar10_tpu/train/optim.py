@@ -108,7 +108,7 @@ def sgd_update(
     lr = learning_rate(cfg, step)
     grads = _clipped(grads, cfg)
 
-    if cfg.optimizer == "adamw":
+    if cfg.optimizer in ("adamw", "lamb"):
         t = (step + 1).astype(jnp.float32)
         b1, b2 = cfg.adam_b1, cfg.adam_b2
         mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
@@ -117,34 +117,19 @@ def sgd_update(
                           state["nu"], grads)
         bc1 = 1.0 - b1 ** t
         bc2 = 1.0 - b2 ** t
+        lamb = cfg.optimizer == "lamb"
 
         def upd(p, m, v):
-            ghat = (m / bc1) / (jnp.sqrt(v / bc2) + cfg.adam_eps)
-            return p - lr * (ghat + cfg.weight_decay * p).astype(p.dtype)
-
-        new_params = jax.tree.map(upd, params, mu, nu)
-        return new_params, {"step": step + 1, "mu": mu, "nu": nu}
-
-    if cfg.optimizer == "lamb":
-        t = (step + 1).astype(jnp.float32)
-        b1, b2 = cfg.adam_b1, cfg.adam_b2
-        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
-                          state["mu"], grads)
-        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g),
-                          state["nu"], grads)
-        bc1 = 1.0 - b1 ** t
-        bc2 = 1.0 - b2 ** t
-
-        def lamb_upd(p, m, v):
-            # AdamW direction, then the per-layer trust ratio rescales
-            # the step to the weight's own norm (You et al. 2019 /
+            # AdamW direction; LAMB then rescales the step to the
+            # weight's own norm per layer (You et al. 2019 /
             # optax.scale_by_trust_ratio semantics: ratio 1 when either
             # norm is zero).
             r = (m / bc1) / (jnp.sqrt(v / bc2) + cfg.adam_eps) \
                 + cfg.weight_decay * p
-            return p - (lr * _trust_ratio(p, r) * r).astype(p.dtype)
+            scale = lr * _trust_ratio(p, r) if lamb else lr
+            return p - (scale * r).astype(p.dtype)
 
-        new_params = jax.tree.map(lamb_upd, params, mu, nu)
+        new_params = jax.tree.map(upd, params, mu, nu)
         return new_params, {"step": step + 1, "mu": mu, "nu": nu}
 
     if cfg.optimizer == "lars":

@@ -6,8 +6,6 @@ numerically against optax.lamb; LARS against a NumPy hand-computation of
 You et al.'s local-LR formula.
 """
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
