@@ -11,10 +11,14 @@ restart restore the latest checkpoint and resume at the saved global step
   target untouched when no checkpoint exists, so startup is always
   "restore-if-present" exactly like MTS.
 
-Format: flax msgpack bytes of the state pytree (arrays are fetched to host
-first — checkpoints of sharded/replicated device arrays just work). A
-``checkpoint`` index file names the latest step, mirroring TF's
-``checkpoint`` protofile convention.
+Formats: ``msgpack`` (default — flax msgpack bytes of the state pytree,
+one file) or ``orbax`` (an ``orbax.checkpoint`` PyTree directory, the
+JAX-ecosystem standard — interoperable with external orbax tooling).
+Arrays are fetched to host first, so checkpoints of sharded/replicated
+device arrays just work in either format, and ``restore_checkpoint``
+auto-detects the format per checkpoint so a run can switch formats
+mid-flight. A ``checkpoint`` index file names the latest step, mirroring
+TF's ``checkpoint`` protofile convention.
 """
 
 from __future__ import annotations
@@ -28,11 +32,13 @@ import jax
 
 from flax import serialization
 
-_CKPT_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
+_CKPT_RE = re.compile(r"ckpt_(\d+)\.(msgpack|orbax)$")
+
+FORMATS = ("msgpack", "orbax")
 
 
-def _ckpt_path(ckpt_dir: str, step: int) -> str:
-    return os.path.join(ckpt_dir, f"ckpt_{step}.msgpack")
+def _ckpt_path(ckpt_dir: str, step: int, fmt: str = "msgpack") -> str:
+    return os.path.join(ckpt_dir, f"ckpt_{step}.{fmt}")
 
 
 def fetch_to_host(state: Any) -> Any:
@@ -54,42 +60,70 @@ def fetch_to_host(state: Any) -> Any:
 
 
 def save_checkpoint(ckpt_dir: str, state: Any, step: int,
-                    keep: int = 3) -> str:
-    """Fetch (collective-safe) + atomically write ``ckpt_<step>.msgpack``."""
-    return _write_checkpoint(ckpt_dir, fetch_to_host(state), step, keep)
+                    keep: int = 3, fmt: str = "msgpack") -> str:
+    """Fetch (collective-safe) + atomically write ``ckpt_<step>.<fmt>``."""
+    return _write_checkpoint(ckpt_dir, fetch_to_host(state), step, keep,
+                             fmt=fmt)
 
 
 def _write_checkpoint(ckpt_dir: str, host_state: Any, step: int,
-                      keep: int) -> str:
+                      keep: int, fmt: str = "msgpack") -> str:
     """Write an already-on-host state; prune to ``keep`` newest."""
+    if fmt not in FORMATS:
+        raise ValueError(f"unknown checkpoint format {fmt!r}; "
+                         f"have {FORMATS}")
     os.makedirs(ckpt_dir, exist_ok=True)
-    data = serialization.to_bytes(host_state)
-    path = _ckpt_path(ckpt_dir, step)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
+    path = _ckpt_path(ckpt_dir, step, fmt)
+    if fmt == "orbax":
+        import orbax.checkpoint as ocp
+
+        # State dict first: orbax round-trips plain nested dicts; the
+        # NamedTuple/typed structure is re-imposed on restore via
+        # flax.serialization. Orbax's own save is tmp-dir + rename, so
+        # atomicity matches the msgpack path.
+        ocp.PyTreeCheckpointer().save(
+            os.path.abspath(path),
+            serialization.to_state_dict(host_state),
+            force=True)
+    else:
+        data = serialization.to_bytes(host_state)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
     with open(os.path.join(ckpt_dir, "checkpoint"), "w") as f:
         f.write(os.path.basename(path) + "\n")
-    steps = sorted(all_checkpoint_steps(ckpt_dir))
-    for old in steps[:-keep]:
+    for old_step, old_fmt in sorted(_checkpoints(ckpt_dir))[:-keep]:
+        old = _ckpt_path(ckpt_dir, old_step, old_fmt)
         try:
-            os.remove(_ckpt_path(ckpt_dir, old))
+            if os.path.isdir(old):
+                import shutil
+                shutil.rmtree(old)
+            else:
+                os.remove(old)
         except OSError:
             pass
     return path
 
 
-def all_checkpoint_steps(ckpt_dir: str):
+def _checkpoints(ckpt_dir: str):
+    """[(step, fmt)] for every checkpoint present, either format."""
     if not os.path.isdir(ckpt_dir):
         return []
-    return [int(m.group(1)) for name in os.listdir(ckpt_dir)
+    return [(int(m.group(1)), m.group(2)) for name in os.listdir(ckpt_dir)
             if (m := _CKPT_RE.match(name))]
 
 
+def all_checkpoint_steps(ckpt_dir: str):
+    return [step for step, _ in _checkpoints(ckpt_dir)]
+
+
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
-    steps = all_checkpoint_steps(ckpt_dir)
-    return _ckpt_path(ckpt_dir, max(steps)) if steps else None
+    cks = _checkpoints(ckpt_dir)
+    if not cks:
+        return None
+    step, fmt = max(cks)
+    return _ckpt_path(ckpt_dir, step, fmt)
 
 
 def restore_checkpoint(ckpt_dir: str, target: Any,
@@ -100,10 +134,16 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
     path = latest_checkpoint(ckpt_dir)
     if path is None:
         return target
-    with open(path, "rb") as f:
-        data = f.read()
     host_target = fetch_to_host(target)
-    restored = serialization.from_bytes(host_target, data)
+    if path.endswith(".orbax"):
+        import orbax.checkpoint as ocp
+
+        raw = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+        restored = serialization.from_state_dict(host_target, raw)
+    else:
+        with open(path, "rb") as f:
+            data = f.read()
+        restored = serialization.from_bytes(host_target, data)
     if sharding is not None:
         restored = jax.device_put(restored, sharding)
     return restored
@@ -122,10 +162,22 @@ class CheckpointManager:
 
     def __init__(self, ckpt_dir: str, every_steps: int, keep: int = 3,
                  is_chief: Optional[bool] = None, async_save: bool = False,
-                 every_secs: Optional[float] = None):
+                 every_secs: Optional[float] = None,
+                 fmt: str = "msgpack"):
         self.ckpt_dir = ckpt_dir
         self.every_steps = max(1, every_steps)
         self.keep = keep
+        self.fmt = fmt
+        if fmt == "orbax" and jax.process_count() > 1:
+            # orbax Checkpointer.save is itself a collective (it runs
+            # sync_global_processes barriers on ALL hosts), which this
+            # manager's chief-only write design would deadlock. The
+            # msgpack codec has no such constraint.
+            raise ValueError(
+                "ckpt_format='orbax' is single-process only under the "
+                "chief-only CheckpointManager; multi-host runs need "
+                "ckpt_format='msgpack'")
+        self._last_saved_step = None
         self.is_chief = (jax.process_index() == 0) if is_chief is None \
             else is_chief
         self.async_save = async_save
@@ -169,6 +221,14 @@ class CheckpointManager:
     def maybe_save(self, state: Any, step: int, force: bool = False) -> bool:
         if not force and step % self.every_steps != 0:
             return False
+        if step == self._last_saved_step:
+            # Nothing new: the loop's state only changes between steps, so
+            # a boundary save followed by the final forced save at the
+            # same step would rewrite identical bytes — and the orbax
+            # codec's same-path re-save has an rmtree-before-write window
+            # that is NOT crash-atomic. Skip instead.
+            return False
+        self._last_saved_step = step
         # Collective fetch BEFORE the chief check: with tensor-parallel
         # state on a multi-host mesh the gather is a collective, so every
         # process participates; only the chief touches the filesystem.
@@ -184,9 +244,9 @@ class CheckpointManager:
             self.flush()  # ordered writes + surface prior errors
             self._pending = self._pool.submit(
                 _write_checkpoint, self.ckpt_dir, host_state, step,
-                self.keep)
+                self.keep, self.fmt)
         else:
             _write_checkpoint(self.ckpt_dir, host_state, step,
-                              keep=self.keep)
+                              keep=self.keep, fmt=self.fmt)
         self._last_time = time.monotonic()
         return True

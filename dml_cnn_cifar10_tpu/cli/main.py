@@ -168,6 +168,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--async_checkpoint", type="bool", default=False,
                    help="serialize+write checkpoints on a background "
                         "thread (training overlaps the disk IO)")
+    p.add_argument("--ckpt_format", type=str, default="msgpack",
+                   choices=["msgpack", "orbax"],
+                   help="checkpoint codec: single-file flax msgpack or the "
+                        "orbax directory format (restore auto-detects)")
+    p.add_argument("--check_numerics", type="bool", default=False,
+                   help="halt at the next metrics boundary on non-finite "
+                        "loss without checkpointing the poisoned state "
+                        "(faithful parity runs NaN by design — keep off)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--tensorboard_dir", type=str, default=None,
                    help="write TensorBoard event files (chief only; the "
@@ -189,6 +197,8 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         checkpoint_every_secs=args.checkpoint_every_secs,
         log_dir=args.log_dir,
         metrics_jsonl=args.metrics_jsonl,
+        check_numerics=args.check_numerics,
+        ckpt_format=args.ckpt_format,
         tensorboard_dir=args.tensorboard_dir,
         profile_dir=args.profile_dir,
         seed=args.seed,

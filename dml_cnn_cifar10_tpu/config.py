@@ -234,6 +234,12 @@ class TrainConfig:
     # agree on it at the preemption-sync boundary (train/loop.py).
     checkpoint_every_secs: Optional[float] = None
     keep_checkpoints: int = 3
+    # Checkpoint codec: "msgpack" (single flax file) or "orbax" (the
+    # JAX-ecosystem standard directory format — interoperable with
+    # external orbax tooling). Restore auto-detects per checkpoint.
+    # orbax is single-process only: its save is itself a collective,
+    # which the chief-only writer would deadlock (ckpt/checkpoint.py).
+    ckpt_format: str = "msgpack"
     # Overlap checkpoint serialize+write with training on a background
     # writer thread (the device->host fetch stays synchronous — donated
     # step buffers would otherwise race the reader).
@@ -260,6 +266,13 @@ class TrainConfig:
     # may leave the step loop alone or the peers hang in the next
     # collective. Single-process runs react to the signal immediately.
     preempt_sync_every: int = 10
+    # Failure detection: halt at the next metrics boundary when the train
+    # loss goes non-finite, WITHOUT checkpointing the poisoned state (the
+    # last good checkpoint stays the resume point). Off by default —
+    # faithful-mode parity runs NaN by reference hyperparameter design
+    # (LR 0.1 on raw 0-255 pixels) and must keep running like the
+    # reference does.
+    check_numerics: bool = False
     metrics_jsonl: Optional[str] = None   # structured metrics sink
     # TensorBoard event-file dir (chief only) — the MTS wrote summaries to
     # --log_dir by default (cifar10cnn.py:222); opt-in here.

@@ -265,9 +265,32 @@ class Trainer:
         ckpt_mgr = ckpt_lib.CheckpointManager(
             cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints,
             async_save=cfg.async_checkpoint,
-            every_secs=cfg.checkpoint_every_secs)
+            every_secs=cfg.checkpoint_every_secs, fmt=cfg.ckpt_format)
         timer = StepTimer(cfg.batch_size * k)
         train_loss, test_accuracy = [], []
+        last_metrics = None
+
+        def guarded_save(state, step, force=False):
+            """ckpt_mgr.maybe_save, but under check_numerics no save may
+            persist a non-finite state: the loss of the LAST dispatch is
+            fetched (one round trip, only when a save is actually due)
+            and a poisoned state halts instead of overwriting the last
+            good checkpoint."""
+            if cfg.check_numerics and last_metrics is not None:
+                due = force or (step % ckpt_mgr.every_steps == 0
+                                and step != ckpt_mgr._last_saved_step)
+                if due:
+                    loss = float(jax.device_get(last_metrics["loss"]))
+                    if not np.isfinite(loss):
+                        _numerics_halt(loss, step)
+            return ckpt_mgr.maybe_save(state, step, force=force)
+
+        def _numerics_halt(loss, step):
+            self.logger.log("numerics_halt", step=step)
+            raise FloatingPointError(
+                f"non-finite train loss ({loss}) at step {step}; "
+                f"halting without checkpointing the poisoned state "
+                f"(check_numerics=True)")
 
         print("Starting Training")  # parity: cifar10cnn.py:225
         i = 0  # local step, like the reference's `i` (cifar10cnn.py:224)
@@ -281,6 +304,7 @@ class Trainer:
             with PreemptionGuard() as preempt, profile_trace(cfg.profile_dir):
                 while global_step < total_steps and not stop:
                     state, metrics = step_fn(state, *next(prefetch))
+                    last_metrics = metrics
                     global_step += k
                     timer.tick()
 
@@ -304,13 +328,18 @@ class Trainer:
                                         train_accuracy=acc,
                                         images_per_sec=timer.images_per_sec,
                                         lr=_current_lr(cfg, global_step))
+                        if cfg.check_numerics and not np.isfinite(loss):
+                            # Loss is a replicated metric, so every
+                            # process raises on the same boundary — no
+                            # peer hangs.
+                            _numerics_halt(loss, global_step)
                     if (i + k) % cfg.eval_every == 0:
                         ta = self.evaluate(state, test_it)
                         test_accuracy.append(ta)
                         self.logger.eval_print(ta)
                         self.logger.log("eval", step=global_step,
                                         test_accuracy=ta)
-                    ckpt_mgr.maybe_save(state, global_step)
+                    guarded_save(state, global_step)
                     i += k
                     n_dispatch += 1
                     # Preemption: a single process reacts immediately; a
@@ -325,7 +354,7 @@ class Trainer:
                         # reference's MonitoredTrainingSession saved every
                         # 600 s by default, cifar10cnn.py:222).
                         if ckpt_mgr.time_due():
-                            ckpt_mgr.maybe_save(state, global_step, force=True)
+                            guarded_save(state, global_step, force=True)
                     elif n_dispatch % sync_stride == 0:
                         from jax.experimental import multihost_utils
                         # One DCN allgather carries both flags: no process may
@@ -336,16 +365,14 @@ class Trainer:
                                         ckpt_mgr.time_due()]))
                         stop = bool(np.asarray(flags)[..., 0].any())
                         if bool(np.asarray(flags)[..., 1].any()):
-                            ckpt_mgr.maybe_save(state, global_step, force=True)
+                            guarded_save(state, global_step, force=True)
 
                 # Final save covers both normal completion and preemption: the
                 # in-flight step finished, so the checkpoint loses zero work.
                 # It runs INSIDE the guard so a second signal during the
                 # write (Ctrl-C twice, pool re-sending SIGTERM) can't kill the
                 # process before the atomic rename lands.
-                ckpt_mgr.maybe_save(state, global_step, force=True)
-                ckpt_mgr.close()  # drain + stop the async writer thread
-                prefetch.close()
+                guarded_save(state, global_step, force=True)
                 if stop:
                     print(f"[preempt] signal {preempt.signum}: checkpointed at "
                           f"step {global_step}, exiting cleanly")
@@ -354,10 +381,14 @@ class Trainer:
                 self.logger.log("done", step=global_step,
                                 images_per_sec=timer.images_per_sec)
         finally:
-            # Crash paths flush too: tensorboardX's daemon
-            # writer dies unflushed at interpreter exit, and
-            # an OOM/NaN abort is exactly when the last
-            # scalars matter.
+            # Crash paths clean up too: the async checkpoint writer must
+            # drain (surfacing any background write error alongside the
+            # original exception), the prefetch thread must stop, and
+            # tensorboardX's daemon writer dies unflushed at interpreter
+            # exit — an OOM/NaN abort is exactly when the last scalars
+            # matter.
+            ckpt_mgr.close()
+            prefetch.close()
             self.logger.flush()
         # Release the fit-scoped resident closures — their partials pin
         # the train/test splits in HBM.

@@ -189,3 +189,33 @@ def test_time_based_cadence(tmp_path, data_cfg):
     steps = ckpt_lib.all_checkpoint_steps(cfg.log_dir)
     assert 8 in steps          # final save
     assert any(s < 8 for s in steps)  # a clock-triggered one landed early
+
+
+def test_orbax_format_roundtrip_and_mixed_retention(tmp_path, data_cfg):
+    """The orbax directory codec: save/restore round-trip through the
+    Trainer, auto-detected restore, and retention that prunes across
+    BOTH formats (a run can switch codecs mid-flight)."""
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+    from tests.conftest import tiny_train_cfg
+
+    cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=4)
+    cfg.checkpoint_every = 2
+    cfg.ckpt_format = "orbax"
+    r1 = Trainer(cfg).fit()
+    assert r1.final_step == 4
+    assert os.path.isdir(os.path.join(cfg.log_dir, "ckpt_4.orbax"))
+
+    # Resume from the orbax checkpoint with the msgpack codec configured:
+    # restore auto-detects, new saves use the new codec, retention spans
+    # both.
+    cfg2 = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=8)
+    cfg2.checkpoint_every = 2
+    cfg2.keep_checkpoints = 2
+    t2 = Trainer(cfg2)
+    state = t2.init_or_restore()
+    assert int(np.asarray(state.step)) == 4
+    r2 = t2.fit(state=state)
+    assert r2.final_step == 8
+    steps = sorted(ckpt_lib.all_checkpoint_steps(cfg2.log_dir))
+    assert steps == [6, 8]          # orbax 2/4 pruned by retention
+    assert os.path.isfile(os.path.join(cfg2.log_dir, "ckpt_8.msgpack"))

@@ -174,3 +174,30 @@ def test_multihost_preemption_agrees(tmp_path, data_cfg):
         assert "preempted=True" in lines[-1], lines[-1]
         steps.append(int(lines[-1].split("step=")[1].split()[0]))
     assert steps[0] == steps[1], f"processes exited at different steps {steps}"
+
+
+def test_check_numerics_halts_without_poisoned_checkpoint(tmp_path,
+                                                          data_cfg):
+    """The faithful LR-0.1-on-raw-pixels combo NaNs within a few steps (a
+    reference property); with check_numerics the driver halts at the
+    metrics boundary and the NaN state is NOT checkpointed."""
+    import dataclasses
+
+    import pytest
+
+    from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+    from tests.conftest import tiny_train_cfg
+
+    cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=20)
+    cfg.data = dataclasses.replace(cfg.data, normalize="none")  # raw 0-255
+    cfg.optim.learning_rate = 0.1
+    cfg.output_every = 10
+    cfg.eval_every = 20
+    # Checkpoint cadence FIRES BEFORE the first metrics boundary: the
+    # guard must halt at the save itself, never persisting NaN weights.
+    cfg.checkpoint_every = 5
+    cfg.check_numerics = True
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        Trainer(cfg).fit()
+    assert ckpt_lib.all_checkpoint_steps(cfg.log_dir) == []
