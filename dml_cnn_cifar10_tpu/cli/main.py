@@ -156,6 +156,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "tutorial's [0.2,1.8] is 0.8)")
     p.add_argument("--grad_clip_norm", type=float, default=None,
                    help="global-norm gradient clipping")
+    p.add_argument("--ema_decay", type=float, default=0.0,
+                   help="parameter EMA decay for eval (0 = off; 0.999 "
+                        "typical) — training optimizes raw params, eval "
+                        "uses the average")
     p.add_argument("--schedule", type=str, default="exponential",
                    choices=["exponential", "cosine", "constant"],
                    help="LR schedule family (exponential = reference "
@@ -218,6 +222,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.optim.weight_decay = args.weight_decay
     cfg.optim.label_smoothing = args.label_smoothing
     cfg.optim.grad_clip_norm = args.grad_clip_norm
+    cfg.optim.ema_decay = args.ema_decay
     cfg.optim.schedule = args.schedule
     cfg.optim.warmup_steps = args.warmup_steps
     cfg.optim.cosine_decay_steps = args.cosine_decay_steps
@@ -322,9 +327,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         # The host fetch inside export_forward is a collective when state
         # is sharded multi-host: every process participates, the chief
         # writes.
+        # Export the EMA weights (and EMA BN stats) when the optimizer
+        # tracks them — the same weights eval mode scores.
+        params = state.opt.get("ema", state.params)
+        mstate = state.opt.get("ema_mstate", state.model_state) \
+            if trainer.model_def.has_state else None
         blob = export_lib.export_forward(
-            trainer.model_def, cfg.model, cfg.data, state.params,
-            state.model_state if trainer.model_def.has_state else None)
+            trainer.model_def, cfg.model, cfg.data, params, mstate)
         if jax.process_index() == 0:
             os.makedirs(os.path.dirname(os.path.abspath(path)),
                         exist_ok=True)

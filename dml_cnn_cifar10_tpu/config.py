@@ -178,6 +178,11 @@ class OptimConfig:
     adam_b2: float = 0.999
     adam_eps: float = 1e-8
     grad_clip_norm: Optional[float] = None
+    # Exponential moving average of the params, updated every step and
+    # used for EVAL only (the train step keeps optimizing the raw
+    # params). 0 disables. The standard ViT/ResNet recipe stabilizer; no
+    # reference counterpart.
+    ema_decay: float = 0.0
     # Gradient accumulation: split each global batch into this many
     # microbatches inside the compiled step (lax.scan), average the grads,
     # apply ONE optimizer update. Trains large effective batches in bounded

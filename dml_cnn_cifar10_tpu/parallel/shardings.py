@@ -189,7 +189,7 @@ def state_pspecs(model_name: str, state: Any, pipe: bool = False,
     state memory scales 1/|data|; BN state stays replicated — it is
     pmean'd cross-replica, not per-shard)."""
     opt = {k: (param_pspecs(model_name, v, pipe=pipe, fsdp_data=fsdp_data)
-               if k in ("momentum", "mu", "nu")
+               if k in ("momentum", "mu", "nu", "ema")
                else jax.tree.map(lambda _: P(), v))
            for k, v in state.opt.items()}
     return type(state)(

@@ -73,11 +73,15 @@ def init_train_state(
     ``train_state_shardings`` tree to both (as ``Trainer`` does).
     """
     params = model_def.init(key, model_cfg, data_cfg)
-    state = TrainState(
-        params=params,
-        opt=optim_lib.sgd_init(params, optim_cfg),
-        model_state=model_def.init_state(params),
-    )
+    opt = optim_lib.sgd_init(params, optim_cfg)
+    model_state = model_def.init_state(params)
+    if optim_cfg.ema_decay and model_def.has_state and model_state:
+        # BatchNorm running stats track the RAW param trajectory; eval
+        # with EMA params needs matching averaged stats, so the EMA
+        # covers model_state too ("ema_mstate" — replicated like the
+        # live model_state by the sharding rules' default).
+        opt["ema_mstate"] = jax.tree.map(jnp.array, model_state)
+    state = TrainState(params=params, opt=opt, model_state=model_state)
     if state_sharding is not None:
         state = jax.device_put(state, state_sharding)
     elif mesh is not None:
@@ -187,6 +191,11 @@ def _step_body(loss_fn, optim_cfg: OptimConfig):
             loss, acc = lsum / accum, asum / accum
         new_params, new_opt = optim_lib.sgd_update(grads, state.opt,
                                                    state.params, optim_cfg)
+        if "ema_mstate" in state.opt:
+            d = optim_lib.ema_decay_at(optim_cfg, new_opt["step"])
+            new_opt["ema_mstate"] = jax.tree.map(
+                lambda e, m: (d * e + (1 - d) * m).astype(e.dtype),
+                state.opt["ema_mstate"], new_model_state)
         metrics = {"loss": loss, "accuracy": acc}
         return TrainState(new_params, new_opt, new_model_state), metrics
 
@@ -395,14 +404,20 @@ def _eval_logits_fn(model_def: ModelDef, model_cfg: ModelConfig, mesh):
                                      mesh is not None) else {}
 
     def logits_fn(state: TrainState, images):
+        # When the optimizer tracks a parameter EMA, eval uses it (the
+        # standard recipe: train on raw params, evaluate the average),
+        # paired with the matching EMA of the BN running stats. Key
+        # presence is a static pytree property — resolved at trace.
+        params = state.opt.get("ema", state.params)
         if model_def.has_state:
-            logits, _ = model_def.apply(state.params, state.model_state,
+            mstate = state.opt.get("ema_mstate", state.model_state)
+            logits, _ = model_def.apply(params, mstate,
                                         images, model_cfg, train=False)
         elif model_def.has_aux:
-            logits, _ = model_def.apply(state.params, images, model_cfg,
+            logits, _ = model_def.apply(params, images, model_cfg,
                                         train=False, **mesh_kwargs)
         else:
-            logits = model_def.apply(state.params, images, model_cfg,
+            logits = model_def.apply(params, images, model_cfg,
                                      train=False, **mesh_kwargs)
         return logits
 
@@ -538,6 +553,11 @@ def _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh: Mesh):
                                                    state.params, optim_cfg)
         if model_def.has_state:
             new_model_state = lax.pmean(new_model_state, "data")
+        if "ema_mstate" in state.opt:
+            d = optim_lib.ema_decay_at(optim_cfg, new_opt["step"])
+            new_opt["ema_mstate"] = jax.tree.map(
+                lambda e, m: (d * e + (1 - d) * m).astype(e.dtype),
+                state.opt["ema_mstate"], new_model_state)
         return (TrainState(new_params, new_opt, new_model_state),
                 {"loss": loss, "accuracy": acc})
 

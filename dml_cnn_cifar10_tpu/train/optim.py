@@ -82,7 +82,24 @@ def sgd_init(params: Any, cfg: OptimConfig) -> OptState:
             state["momentum"] = jax.tree.map(jnp.zeros_like, params)
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    if cfg.ema_decay:
+        if not 0.0 <= cfg.ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay must be in [0, 1) (got {cfg.ema_decay}); 1.0 "
+                "would freeze the EMA at random init forever")
+        # Eval-time parameter EMA, seeded at the initial params.
+        state["ema"] = jax.tree.map(jnp.array, params)
     return state
+
+
+def ema_decay_at(cfg: OptimConfig, t) -> jax.Array:
+    """Warmup-ramped EMA decay: ``min(d, (1+t)/(10+t))`` for update count
+    ``t`` — the standard schedule (optax/TF EMA) that keeps the early
+    average close to the live params instead of the random init (a flat
+    d=0.999 would leave ~37% init weight after 1000 steps)."""
+    t = jnp.asarray(t, jnp.float32)
+    return jnp.minimum(jnp.asarray(cfg.ema_decay, jnp.float32),
+                       (1.0 + t) / (10.0 + t))
 
 
 def _clipped(grads: Any, cfg: OptimConfig) -> Any:
@@ -102,8 +119,21 @@ def sgd_update(
     The step counter increments on apply, mirroring ``minimize(...,
     global_step=global_step)`` (``cifar10cnn.py:163``). SGD couples weight
     decay into the gradient (classic L2); AdamW decays decoupled, applied
-    directly to the weights (Loshchilov & Hutter).
+    directly to the weights (Loshchilov & Hutter). ``cfg.ema_decay`` also
+    tracks an eval-time parameter EMA across every family.
     """
+    new_params, new_state = _base_update(grads, state, params, cfg)
+    if cfg.ema_decay:
+        d = ema_decay_at(cfg, new_state["step"])
+        new_state["ema"] = jax.tree.map(
+            lambda e, p: (d * e + (1 - d) * p).astype(e.dtype),
+            state["ema"], new_params)
+    return new_params, new_state
+
+
+def _base_update(
+    grads: Any, state: OptState, params: Any, cfg: OptimConfig
+) -> Tuple[Any, OptState]:
     step = state["step"]
     lr = learning_rate(cfg, step)
     grads = _clipped(grads, cfg)
@@ -188,7 +218,9 @@ def as_optax(cfg: OptimConfig):
 
     sgd/adamw/lamb compose to the same math as :func:`sgd_update` (LAMB is
     test-pinned to ``optax.lamb``). LARS is the closest optax composition
-    — see the inline note on the lr-vs-trace ordering difference."""
+    — see the inline note on the lr-vs-trace ordering difference.
+    ``cfg.ema_decay`` is NOT represented: the parameter EMA is eval-side
+    state the driver tracks, not part of the gradient transform."""
     import optax
 
     def schedule(count):

@@ -1,0 +1,145 @@
+"""Parameter EMA: train on raw params, evaluate the moving average.
+
+No reference counterpart — the standard ViT/ResNet recipe stabilizer.
+The EMA lives in the optimizer state (key "ema", so the fsdp/tp sharding
+rules cover it like any moment buffer), updates every step across every
+optimizer family, and the eval paths pick it automatically.
+"""
+
+import jax
+import numpy as np
+
+from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                        ParallelConfig)
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import shardings
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+from dml_cnn_cifar10_tpu.train import optim
+
+DATA = DataConfig(normalize="scale")
+
+
+def test_ema_math_one_step(rng):
+    """ema_1 = d*params_0 + (1-d)*params_1, across optimizer families."""
+    for name in ("sgd", "adamw"):
+        cfg = OptimConfig(optimizer=name, learning_rate=0.05,
+                          schedule="constant", ema_decay=0.9)
+        params = {"w": np.asarray(rng.normal(0, 1, (4, 3)), np.float32)}
+        grads = {"w": np.asarray(rng.normal(0, 1, (4, 3)), np.float32)}
+        state = optim.sgd_init(params, cfg)
+        np.testing.assert_array_equal(np.asarray(state["ema"]["w"]),
+                                      params["w"])
+        new_params, new_state = optim.sgd_update(grads, state, params, cfg)
+        # Warmup-ramped decay: at t=1 the effective decay is
+        # min(d, (1+1)/(10+1)) = 2/11, so early EMAs track the live
+        # params instead of random init.
+        d = min(0.9, 2.0 / 11.0)
+        want = d * params["w"] + (1 - d) * np.asarray(new_params["w"])
+        np.testing.assert_allclose(np.asarray(new_state["ema"]["w"]), want,
+                                   rtol=1e-6)
+
+
+def test_eval_uses_ema_params(rng):
+    """After a violent step, raw-params eval and EMA eval must differ —
+    and the eval step must be the EMA one (equal to logits computed with
+    the EMA weights by hand)."""
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    model_def = get_model("cnn")
+    mcfg = ModelConfig(logit_relu=False)
+    ocfg = OptimConfig(learning_rate=0.5, schedule="constant",
+                       ema_decay=0.99)
+    sh = step_lib.train_state_shardings(mesh, model_def, mcfg, DATA, ocfg)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, mcfg, DATA, ocfg, mesh,
+        state_sharding=sh)
+    train = step_lib.make_train_step(model_def, mcfg, ocfg, mesh,
+                                     state_sharding=sh)
+    ev = step_lib.make_eval_step(model_def, mcfg, mesh, state_sharding=sh)
+
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    for _ in range(3):
+        state, _ = train(state, im, lb)
+
+    got = ev(state, im, lb)["accuracy"]
+    ema_params = jax.device_get(state.opt["ema"])
+    raw_params = jax.device_get(state.params)
+    ema_logits = model_def.apply(ema_params, images, mcfg, train=False)
+    raw_logits = model_def.apply(raw_params, images, mcfg, train=False)
+    assert not np.allclose(np.asarray(ema_logits), np.asarray(raw_logits))
+    want = float(np.mean(np.argmax(np.asarray(ema_logits), -1) == labels))
+    np.testing.assert_allclose(float(jax.device_get(got)), want, atol=1e-6)
+
+
+def test_ema_shards_and_checkpoints(tmp_path, rng):
+    """EMA buffers shard over data under fsdp and survive a checkpoint
+    round-trip."""
+    from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    model_def = get_model("cnn")
+    mcfg = ModelConfig(logit_relu=False)
+    ocfg = OptimConfig(learning_rate=0.05, schedule="constant",
+                       ema_decay=0.999)
+    sh = step_lib.train_state_shardings(mesh, model_def, mcfg, DATA, ocfg,
+                                        fsdp=True)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, mcfg, DATA, ocfg, mesh,
+        state_sharding=sh)
+    assert shardings.assert_some_leaf_sharded(state.opt["ema"], axis="data")
+
+    train = step_lib.make_train_step(model_def, mcfg, ocfg, mesh,
+                                     state_sharding=sh)
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    state, _ = train(state, *mesh_lib.shard_batch(mesh, images, labels))
+
+    ckpt_lib.save_checkpoint(str(tmp_path), state, step=1)
+    fresh = step_lib.init_train_state(
+        jax.random.key(5), model_def, mcfg, DATA, ocfg, mesh,
+        state_sharding=sh)
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), fresh, sharding=sh)
+    for a, b in zip(jax.tree.leaves(jax.device_get(state.opt["ema"])),
+                    jax.tree.leaves(jax.device_get(restored.opt["ema"]))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ema_decay_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="ema_decay"):
+        optim.sgd_init({"w": np.ones(2, np.float32)},
+                       OptimConfig(ema_decay=1.0))
+
+
+def test_ema_covers_bn_state(rng):
+    """BatchNorm models track an EMA of the running stats too
+    ("ema_mstate"), and eval pairs it with the EMA params."""
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    model_def = get_model("resnet18")
+    mcfg = ModelConfig(name="resnet18", logit_relu=False)
+    ocfg = OptimConfig(learning_rate=0.05, schedule="constant",
+                      ema_decay=0.99)
+    sh = step_lib.train_state_shardings(mesh, model_def, mcfg, DATA, ocfg)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, mcfg, DATA, ocfg, mesh,
+        state_sharding=sh)
+    assert "ema_mstate" in state.opt
+    train = step_lib.make_train_step(model_def, mcfg, ocfg, mesh,
+                                     state_sharding=sh)
+    ev = step_lib.make_eval_step(model_def, mcfg, mesh, state_sharding=sh)
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    for _ in range(2):
+        state, _ = train(state, im, lb)
+    # The stats EMA moved off the live stats and off init.
+    live = jax.device_get(state.model_state)
+    ema = jax.device_get(state.opt["ema_mstate"])
+    diffs = [not np.allclose(np.asarray(a), np.asarray(b))
+             for a, b in zip(jax.tree.leaves(live), jax.tree.leaves(ema))]
+    assert any(diffs)
+    acc = ev(state, im, lb)["accuracy"]
+    assert np.isfinite(float(jax.device_get(acc)))
