@@ -156,6 +156,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "tutorial's [0.2,1.8] is 0.8)")
     p.add_argument("--grad_clip_norm", type=float, default=None,
                    help="global-norm gradient clipping")
+    p.add_argument("--async_staleness", type=int, default=0,
+                   help="emulate the reference's async-PS gradient "
+                        "staleness deterministically: grads taken at a "
+                        "snapshot S-1 updates old (0/1 = synchronous)")
     p.add_argument("--ema_decay", type=float, default=0.0,
                    help="parameter EMA decay for eval (0 = off; 0.999 "
                         "typical) — training optimizes raw params, eval "
@@ -223,6 +227,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.optim.label_smoothing = args.label_smoothing
     cfg.optim.grad_clip_norm = args.grad_clip_norm
     cfg.optim.ema_decay = args.ema_decay
+    cfg.optim.async_staleness = args.async_staleness
     cfg.optim.schedule = args.schedule
     cfg.optim.warmup_steps = args.warmup_steps
     cfg.optim.cosine_decay_steps = args.cosine_decay_steps

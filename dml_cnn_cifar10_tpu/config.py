@@ -178,6 +178,15 @@ class OptimConfig:
     adam_b2: float = 0.999
     adam_eps: float = 1e-8
     grad_clip_norm: Optional[float] = None
+    # Async-PS staleness emulation (SURVEY §2.3's one semantic delta:
+    # the reference's workers compute gradients on parameters that are
+    # up to W-1 updates old, W = worker count — cifar10cnn.py:162,
+    # no SyncReplicasOptimizer). S >= 2 reproduces that staleness
+    # DETERMINISTICALLY: gradients are taken at a round-robin snapshot
+    # S-1 updates old and applied to the live params, so async-vs-sync
+    # convergence can be compared exactly. 0/1 = synchronous (default).
+    # Costs S extra param copies in the optimizer state.
+    async_staleness: int = 0
     # Exponential moving average of the params, updated every step and
     # used for EVAL only (the train step keeps optimizing the raw
     # params). 0 disables. The standard ViT/ResNet recipe stabilizer; no

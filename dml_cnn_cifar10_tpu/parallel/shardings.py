@@ -188,8 +188,11 @@ def state_pspecs(model_name: str, state: Any, pipe: bool = False,
     params AND moments are sharded over ``data`` (ZeRO-3: the dominant
     state memory scales 1/|data|; BN state stays replicated — it is
     pmean'd cross-replica, not per-shard)."""
+    # "stale" (the async-staleness ring) carries a leading [S] axis; the
+    # rules index from the trailing dims, so the same per-param specs
+    # apply — the extra leading dim just stays unsharded.
     opt = {k: (param_pspecs(model_name, v, pipe=pipe, fsdp_data=fsdp_data)
-               if k in ("momentum", "mu", "nu", "ema")
+               if k in ("momentum", "mu", "nu", "ema", "stale")
                else jax.tree.map(lambda _: P(), v))
            for k, v in state.opt.items()}
     return type(state)(

@@ -162,10 +162,25 @@ def _step_body(loss_fn, optim_cfg: OptimConfig):
         acc = metrics_lib.batch_accuracy(logits, labels)
         return grads, loss, acc, new_model_state
 
+    staleness = max(0, optim_cfg.async_staleness)
+
     def step(state: TrainState, images, labels):
+        # Async-PS staleness emulation: the forward/backward runs at a
+        # snapshot S-1 updates old (slot t%S of the ring), the update
+        # applies to the LIVE params — exactly a PS worker whose fetch
+        # raced S-1 other workers' applies (cifar10cnn.py:162,230;
+        # SURVEY §3.3), made deterministic.
+        if staleness >= 2:
+            slot = state.opt["step"] % staleness
+            fwd_params = jax.tree.map(
+                lambda b: lax.dynamic_index_in_dim(b, slot, 0,
+                                                   keepdims=False),
+                state.opt["stale"])
+        else:
+            fwd_params = state.params
         if accum == 1:
             grads, loss, acc, new_model_state = grad_and_metrics(
-                state.params, state.model_state, images, labels)
+                fwd_params, state.model_state, images, labels)
         else:
             b = images.shape[0]
             if b % accum:
@@ -176,7 +191,7 @@ def _step_body(loss_fn, optim_cfg: OptimConfig):
 
             def micro(carry, xs):
                 gsum, lsum, asum, mstate = carry
-                g, l, a, mstate = grad_and_metrics(state.params, mstate,
+                g, l, a, mstate = grad_and_metrics(fwd_params, mstate,
                                                    xs[0], xs[1])
                 return (jax.tree.map(jnp.add, gsum, g), lsum + l, asum + a,
                         mstate), None
@@ -191,6 +206,13 @@ def _step_body(loss_fn, optim_cfg: OptimConfig):
             loss, acc = lsum / accum, asum / accum
         new_params, new_opt = optim_lib.sgd_update(grads, state.opt,
                                                    state.params, optim_cfg)
+        if staleness >= 2:
+            # The slot just consumed receives the freshly updated params
+            # (the worker pushes its apply and re-fetches).
+            new_opt["stale"] = jax.tree.map(
+                lambda b, p: lax.dynamic_update_index_in_dim(
+                    b, p.astype(b.dtype), slot, 0),
+                state.opt["stale"], new_params)
         if "ema_mstate" in state.opt:
             d = optim_lib.ema_decay_at(optim_cfg, new_opt["step"])
             new_opt["ema_mstate"] = jax.tree.map(
@@ -230,6 +252,21 @@ def make_train_step(
             raise ValueError(
                 "grad_accum > 1 is not implemented on the "
                 "explicit_collectives path; use the GSPMD (default) step")
+        if optim_cfg.async_staleness >= 2:
+            raise ValueError(
+                "async_staleness needs the GSPMD (default) step, not "
+                "explicit_collectives")
+
+    if (optim_cfg.async_staleness >= 2 and mesh is not None
+            and mesh.shape.get("pipe", 1) > 1):
+        # The pipe layout rule shards the LEADING axis of stacked
+        # leaves, which for the stale ring is the snapshot axis S, not
+        # depth — the layouts conflict. (Pipelined async emulation has
+        # no meaningful reference counterpart either.)
+        raise ValueError(
+            "async_staleness does not compose with pipeline parallelism "
+            "(the pipe sharding rule would claim the snapshot ring's "
+            "leading axis)")
         return _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh)
 
     loss_fn = _forward_loss(model_def, model_cfg, mesh=mesh,

@@ -1,0 +1,116 @@
+"""Async-PS staleness emulation (the reference's one semantic delta).
+
+The reference's workers apply gradients computed on parameters up to
+W-1 updates old (async PS, no SyncReplicasOptimizer —
+``cifar10cnn.py:162``; SURVEY §2.3). ``async_staleness=S`` reproduces
+that staleness deterministically via a round-robin snapshot ring, so
+async-vs-sync convergence is directly comparable — the validation the
+SURVEY's "hard parts" list asks for, without nondeterministic racing.
+"""
+
+import jax
+import numpy as np
+
+from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                        ParallelConfig)
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+from dml_cnn_cifar10_tpu.train import optim
+
+DATA = DataConfig(normalize="scale")
+CFG = ModelConfig(logit_relu=False)
+
+
+def _run(seed, staleness, nsteps=6, lr=0.05):
+    rng = np.random.default_rng(seed)  # same batch for every run
+    ocfg = OptimConfig(learning_rate=lr, schedule="constant",
+                       async_staleness=staleness)
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    model_def = get_model("cnn")
+    sh = step_lib.train_state_shardings(mesh, model_def, CFG, DATA, ocfg)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, CFG, DATA, ocfg, mesh,
+        state_sharding=sh)
+    train = step_lib.make_train_step(model_def, CFG, ocfg, mesh,
+                                     state_sharding=sh)
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    losses = []
+    for _ in range(nsteps):
+        state, metrics = train(state, im, lb)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    return state, losses
+
+
+def test_staleness_ring_semantics():
+    """Ring init shape + division of labor: sgd_update owns the param
+    update, the step body owns the slot write."""
+    cfg = OptimConfig(learning_rate=0.1, schedule="constant",
+                      async_staleness=2)
+    w = {"w": np.asarray([1.0], np.float32)}
+    state = optim.sgd_init(w, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(state["stale"]["w"]), [[1.0], [1.0]])
+    # The ring update itself lives in the step body; here we pin init
+    # shape + that plain sgd_update leaves the ring untouched (the step
+    # body owns the slot write).
+    _, new_state = optim.sgd_update({"w": np.ones(1, np.float32)}, state,
+                                    w, cfg)
+    assert "stale" not in new_state  # re-attached by the step body
+
+
+def test_stale_ring_trajectory():
+    """With S=2 (same batch every step): step 0 reads slot 0 = init, so
+    it matches the sync run; step 1 reads slot 1 which is STILL init —
+    the loss repeats step 0's exactly (the fingerprint of a worker
+    computing on params it fetched before any update landed); from step
+    2 the trajectory diverges from sync."""
+    _, sync_losses = _run(0, staleness=0, nsteps=4)
+    _, stale_losses = _run(0, staleness=2, nsteps=4)
+    np.testing.assert_allclose(sync_losses[0], stale_losses[0], rtol=1e-6)
+    np.testing.assert_allclose(stale_losses[1], stale_losses[0], rtol=1e-6)
+    assert not np.allclose(sync_losses[1], stale_losses[1])
+    assert not np.allclose(sync_losses[2:], stale_losses[2:])
+
+
+def test_stale_still_converges():
+    """Staleness 3 on a separable problem still trains (loss decreases)
+    — the async semantics are a different trajectory, not divergence."""
+    _, losses = _run(0, staleness=3, nsteps=10, lr=0.02)
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_staleness_rejects_explicit_collectives():
+    import pytest
+
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    with pytest.raises(ValueError, match="async_staleness"):
+        step_lib.make_train_step(
+            get_model("cnn"), CFG,
+            OptimConfig(async_staleness=2), mesh,
+            explicit_collectives=True)
+
+
+def test_staleness_guards():
+    """SGD-coupled wd and pipeline meshes are rejected with explanations
+    (both would silently break the async-semantics claim)."""
+    import pytest
+
+    with pytest.raises(ValueError, match="weight_decay"):
+        optim.sgd_init({"w": np.ones(2, np.float32)},
+                       OptimConfig(async_staleness=2, weight_decay=1e-4))
+    # decoupled decay is fine
+    optim.sgd_init({"w": np.ones((4, 4), np.float32)},
+                   OptimConfig(optimizer="adamw", async_staleness=2,
+                               weight_decay=1e-4))
+    pipe_mesh = mesh_lib.build_mesh(
+        ParallelConfig(data_axis=4, pipe_axis=2))
+    with pytest.raises(ValueError, match="pipeline"):
+        step_lib.make_train_step(
+            get_model("vit_tiny"),
+            ModelConfig(name="vit_tiny", vit_depth=2, vit_dim=32,
+                        vit_heads=2, patch_size=8, logit_relu=False),
+            OptimConfig(async_staleness=2), pipe_mesh)
