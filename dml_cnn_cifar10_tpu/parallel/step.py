@@ -256,6 +256,8 @@ def make_train_step(
             raise ValueError(
                 "async_staleness needs the GSPMD (default) step, not "
                 "explicit_collectives")
+        return _make_explicit_train_step(model_def, model_cfg, optim_cfg,
+                                         mesh)
 
     if (optim_cfg.async_staleness >= 2 and mesh is not None
             and mesh.shape.get("pipe", 1) > 1):
@@ -267,7 +269,6 @@ def make_train_step(
             "async_staleness does not compose with pipeline parallelism "
             "(the pipe sharding rule would claim the snapshot ring's "
             "leading axis)")
-        return _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh)
 
     loss_fn = _forward_loss(model_def, model_cfg, mesh=mesh,
                              label_smoothing=optim_cfg.label_smoothing)

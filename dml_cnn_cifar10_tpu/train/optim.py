@@ -83,18 +83,20 @@ def sgd_init(params: Any, cfg: OptimConfig) -> OptState:
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     if cfg.async_staleness >= 2:
-        if cfg.optimizer == "sgd" and cfg.weight_decay:
-            # SGD couples L2 decay into the gradient — a real async
-            # worker would compute that term at its STALE snapshot, but
-            # sgd_update necessarily couples at the live params, so the
-            # emulation would silently deviate. AdamW/LAMB decay
-            # decoupled at apply time (a PS-side op in the async world),
-            # which IS faithful; SGD must run wd=0 like the reference.
+        if cfg.optimizer in ("sgd", "lars") and cfg.weight_decay:
+            # SGD and LARS couple L2 decay into the gradient — a real
+            # async worker would compute that term at its STALE
+            # snapshot, but the update necessarily couples at the live
+            # params, so the emulation would silently deviate. AdamW /
+            # LAMB decay decoupled at apply time (a PS-side op in the
+            # async world), which IS faithful; gradient-coupled
+            # families must run wd=0 like the reference.
             raise ValueError(
-                "async_staleness with SGD-coupled weight_decay would "
-                "not reproduce async semantics (the L2 term would use "
-                "live params); use weight_decay=0 (the reference "
-                "config) or a decoupled-decay optimizer (adamw/lamb)")
+                f"async_staleness with {cfg.optimizer}-coupled "
+                "weight_decay would not reproduce async semantics (the "
+                "L2 term would use live params); use weight_decay=0 "
+                "(the reference config) or a decoupled-decay optimizer "
+                "(adamw/lamb)")
         # Round-robin snapshot ring for async-PS staleness emulation
         # (config.py:async_staleness): slot t%S serves the forward pass
         # at step t and receives the post-update params.

@@ -114,3 +114,26 @@ def test_staleness_guards():
             ModelConfig(name="vit_tiny", vit_depth=2, vit_dim=32,
                         vit_heads=2, patch_size=8, logit_relu=False),
             OptimConfig(async_staleness=2), pipe_mesh)
+
+
+def test_explicit_path_actually_selected(monkeypatch):
+    """Guard order must leave the explicit-collectives branch reachable:
+    make_train_step(explicit_collectives=True) returns the shard_map
+    step, never silently the GSPMD one (regression: a guard insertion
+    once made the branch's return unreachable)."""
+    sentinel = object()
+    monkeypatch.setattr(step_lib, "_make_explicit_train_step",
+                        lambda *a, **k: sentinel)
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    got = step_lib.make_train_step(get_model("cnn"), CFG, OptimConfig(),
+                                   mesh, explicit_collectives=True)
+    assert got is sentinel
+
+
+def test_lars_coupled_wd_also_guarded():
+    import pytest
+
+    with pytest.raises(ValueError, match="lars-coupled"):
+        optim.sgd_init({"w": np.ones((4, 4), np.float32)},
+                       OptimConfig(optimizer="lars", async_staleness=2,
+                                   weight_decay=1e-4))

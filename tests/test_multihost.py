@@ -24,6 +24,7 @@ force_cpu()
 task_index, n_procs, port, data_dir, log_dir = (
     int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4], sys.argv[5])
 steps_per_dispatch = int(sys.argv[6]) if len(sys.argv) > 6 else 1
+fsdp = bool(int(sys.argv[7])) if len(sys.argv) > 7 else False
 import jax
 
 from dml_cnn_cifar10_tpu.config import TrainConfig, DataConfig
@@ -44,9 +45,12 @@ cfg = TrainConfig(
 )
 cfg.model.logit_relu = False
 cfg.optim.learning_rate = 0.05
+cfg.parallel.fsdp = fsdp
 
 trainer = Trainer(cfg, task_index=task_index)
 res = trainer.fit()
+nonaddr = any(not x.is_fully_addressable
+              for x in jax.tree.leaves(res.state.params))
 from dml_cnn_cifar10_tpu.parallel import multihost as mh
 print("RESULT " + json.dumps({
     "task": task_index,
@@ -54,6 +58,7 @@ print("RESULT " + json.dumps({
     "loss": res.train_loss[-1],
     "test_accuracy": res.test_accuracy[-1],
     "is_chief": mh.is_chief(),
+    "fsdp_nonaddressable": nonaddr,
 }))
 """
 
@@ -79,7 +84,17 @@ def test_two_process_chunked_dispatch(tmp_path, data_cfg):
     _run_two_process(tmp_path, data_cfg, steps_per_dispatch=4)
 
 
-def _run_two_process(tmp_path, data_cfg, steps_per_dispatch):
+def test_two_process_fsdp(tmp_path, data_cfg):
+    """ZeRO/FSDP across REAL process boundaries: params shard over the
+    2-process data axis (leaves are not fully addressable from either
+    process), the collective fetch_to_host reassembles them for the
+    chief's checkpoint, and both processes stay in lockstep."""
+    results = _run_two_process(tmp_path, data_cfg, steps_per_dispatch=1,
+                               fsdp=True)
+    assert all(r["fsdp_nonaddressable"] for r in results)
+
+
+def _run_two_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False):
     n = 2
     port = _free_port()
     data_dir = str(tmp_path / "data")
@@ -100,7 +115,8 @@ def _run_two_process(tmp_path, data_cfg, steps_per_dispatch):
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(i), str(n), str(port),
-             data_dir, log_dir, str(steps_per_dispatch)],
+             data_dir, log_dir, str(steps_per_dispatch),
+             str(int(fsdp))],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=REPO)
         for i in range(n)
@@ -132,3 +148,4 @@ def _run_two_process(tmp_path, data_cfg, steps_per_dispatch):
     assert sorted(r["is_chief"] for r in results) == [False, True]
     from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt
     assert ckpt.all_checkpoint_steps(log_dir) == [8]
+    return results
