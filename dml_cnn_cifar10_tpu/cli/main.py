@@ -184,6 +184,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="halt at the next metrics boundary on non-finite "
                         "loss without checkpointing the poisoned state "
                         "(faithful parity runs NaN by design — keep off)")
+    p.add_argument("--peak_tflops", type=float, default=None,
+                   help="per-chip peak TFLOP/s; enables the MFU metric "
+                        "in the jsonl stream")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--tensorboard_dir", type=str, default=None,
                    help="write TensorBoard event files (chief only; the "
@@ -205,6 +208,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         checkpoint_every_secs=args.checkpoint_every_secs,
         log_dir=args.log_dir,
         metrics_jsonl=args.metrics_jsonl,
+        peak_tflops=args.peak_tflops,
         check_numerics=args.check_numerics,
         ckpt_format=args.ckpt_format,
         tensorboard_dir=args.tensorboard_dir,

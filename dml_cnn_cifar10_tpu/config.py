@@ -288,6 +288,9 @@ class TrainConfig:
     # reference does.
     check_numerics: bool = False
     metrics_jsonl: Optional[str] = None   # structured metrics sink
+    # Per-chip peak TFLOP/s for the MFU metric (e.g. ~49 fp32 / 197 bf16
+    # on v5e). None logs achieved TFLOP/s only.
+    peak_tflops: Optional[float] = None
     # TensorBoard event-file dir (chief only) — the MTS wrote summaries to
     # --log_dir by default (cifar10cnn.py:222); opt-in here.
     tensorboard_dir: Optional[str] = None

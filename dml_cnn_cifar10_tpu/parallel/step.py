@@ -434,7 +434,18 @@ def make_train_chunk_resident(
         out_shardings=(state_sh, repl),
         donate_argnums=2,
     )
-    return functools.partial(jitted, dataset_images, dataset_labels)
+    fn = functools.partial(jitted, dataset_images, dataset_labels)
+
+    def lower(*abs_args):
+        # Expose AOT lowering through the partial so the driver's
+        # flops probe (utils/profiling.compiled_flops) works on the
+        # resident path too: prepend the bound dataset avals.
+        from dml_cnn_cifar10_tpu.utils.profiling import abstractify
+        return jitted.lower(*abstractify((dataset_images,
+                                          dataset_labels)), *abs_args)
+
+    fn.lower = lower
+    return fn
 
 
 def _eval_logits_fn(model_def: ModelDef, model_cfg: ModelConfig, mesh):

@@ -34,7 +34,10 @@ from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
 from dml_cnn_cifar10_tpu.utils.preemption import PreemptionGuard
-from dml_cnn_cifar10_tpu.utils.profiling import StepTimer, profile_trace
+from dml_cnn_cifar10_tpu.utils.profiling import (DrainMeter, StepTimer,
+                                                 abstractify,
+                                                 compiled_flops,
+                                                 profile_trace)
 
 
 @dataclasses.dataclass
@@ -292,6 +295,20 @@ class Trainer:
                 f"halting without checkpointing the poisoned state "
                 f"(check_numerics=True)")
 
+        # FLOPs per dispatch (XLA cost analysis of the compiled step).
+        # The AOT lower().compile() the probe needs does NOT share the
+        # call-path executable cache — it recompiles (seconds for the
+        # chunked step) — so it runs ONCE on a background thread,
+        # launched right after the first dispatch; metrics boundaries
+        # read the cell non-blockingly and omit the perf keys until it
+        # lands ({} = pending, {"flops": 0.0} = probe failed).
+        step_abs = None
+        flops_cell = {}
+        probe_thread = None
+        # Drain-anchored throughput for the metrics stream (see
+        # DrainMeter: async dispatch makes host intervals meaningless).
+        meter = DrainMeter(cfg.batch_size)
+
         print("Starting Training")  # parity: cifar10cnn.py:225
         i = 0  # local step, like the reference's `i` (cifar10cnn.py:224)
         global_step = start_step
@@ -303,7 +320,21 @@ class Trainer:
         try:
             with PreemptionGuard() as preempt, profile_trace(cfg.profile_dir):
                 while global_step < total_steps and not stop:
-                    state, metrics = step_fn(state, *next(prefetch))
+                    drained = False
+                    batch = next(prefetch)
+                    if step_abs is None:
+                        step_abs = abstractify((state, *batch))
+                    state, metrics = step_fn(state, *batch)
+                    if probe_thread is None:
+                        import threading
+
+                        def _probe(fn=step_fn, abs_args=step_abs):
+                            flops_cell["flops"] = compiled_flops(
+                                fn, abs_args) or 0.0
+
+                        probe_thread = threading.Thread(target=_probe,
+                                                        daemon=True)
+                        probe_thread.start()
                     last_metrics = metrics
                     global_step += k
                     timer.tick()
@@ -321,13 +352,36 @@ class Trainer:
                         pair = jax.device_get(
                             jnp.stack([metrics["loss"],
                                        jnp.asarray(acc_arr, jnp.float32)]))
+                        rate = meter.rate(global_step)
+                        drained = True
                         loss, acc = float(pair[0]), float(pair[1])
                         train_loss.append(loss)
+                        perf = {}
+                        flops_probe = flops_cell.get("flops")
+                        if flops_probe and rate > 0:
+                            # steps/sec x flops/step. Two accounting
+                            # facts (both verified on this backend):
+                            # XLA cost analysis reports the PER-DEVICE
+                            # share of the partitioned program (already
+                            # per-chip, no device_count divide), and it
+                            # counts a lax.scan BODY ONCE — the probed
+                            # value is per (micro)step, so grad-accum
+                            # microbatches scale back in. Models that
+                            # scan their own layer stack (ViT) still
+                            # undercount by depth; exact for the CNN.
+                            tf = (flops_probe
+                                  * max(1, cfg.optim.grad_accum)
+                                  * (rate / cfg.batch_size) / 1e12)
+                            perf["tflops_per_sec_per_chip"] = round(tf, 3)
+                            if cfg.peak_tflops:
+                                perf["mfu"] = round(
+                                    tf / cfg.peak_tflops, 4)
                         self.logger.train_print(global_step, i + k - 1, acc)
                         self.logger.log("train", step=global_step, loss=loss,
                                         train_accuracy=acc,
-                                        images_per_sec=timer.images_per_sec,
-                                        lr=_current_lr(cfg, global_step))
+                                        images_per_sec=rate,
+                                        lr=_current_lr(cfg, global_step),
+                                        **perf)
                         if cfg.check_numerics and not np.isfinite(loss):
                             # Loss is a replicated metric, so every
                             # process raises on the same boundary — no
@@ -339,7 +393,9 @@ class Trainer:
                         self.logger.eval_print(ta)
                         self.logger.log("eval", step=global_step,
                                         test_accuracy=ta)
-                    guarded_save(state, global_step)
+                        drained = True
+                    if guarded_save(state, global_step):
+                        drained = True
                     i += k
                     n_dispatch += 1
                     # Preemption: a single process reacts immediately; a
@@ -354,7 +410,8 @@ class Trainer:
                         # reference's MonitoredTrainingSession saved every
                         # 600 s by default, cifar10cnn.py:222).
                         if ckpt_mgr.time_due():
-                            guarded_save(state, global_step, force=True)
+                            if guarded_save(state, global_step, force=True):
+                                drained = True
                     elif n_dispatch % sync_stride == 0:
                         from jax.experimental import multihost_utils
                         # One DCN allgather carries both flags: no process may
@@ -365,7 +422,13 @@ class Trainer:
                                         ckpt_mgr.time_due()]))
                         stop = bool(np.asarray(flags)[..., 0].any())
                         if bool(np.asarray(flags)[..., 1].any()):
-                            guarded_save(state, global_step, force=True)
+                            if guarded_save(state, global_step, force=True):
+                                drained = True
+                    if drained:
+                        # End-of-iteration mark: the next rate window
+                        # starts AFTER this iteration's eval/checkpoint
+                        # work, so only training dispatches are timed.
+                        meter.mark(global_step)
 
                 # Final save covers both normal completion and preemption: the
                 # in-flight step finished, so the checkpoint loses zero work.

@@ -41,6 +41,63 @@ class StepTimer:
         return self.steps_per_sec * self.batch_size
 
 
+class DrainMeter:
+    """Drain-anchored throughput meter.
+
+    Dispatches are async: host loop intervals measure ENQUEUE rate, not
+    execution (the ``block_until_ready`` hazard ``bench.py`` documents).
+    Every device fetch is a true drain, so the exact training rate is
+    (steps between drains) / (wall time between drains) — provided the
+    window holds only training dispatches. Protocol: call :meth:`rate`
+    right after a boundary's metric fetch, and :meth:`mark` at the END
+    of any iteration that drained (metrics fetch, eval sweep, checkpoint
+    fetch), so eval/checkpoint work never pollutes the next window.
+    """
+
+    def __init__(self, images_per_step: float):
+        self.images_per_step = images_per_step
+        self._mark: Optional[tuple] = None
+
+    def rate(self, step: int) -> float:
+        """images/sec since the previous mark; 0.0 before the first."""
+        if self._mark is None:
+            return 0.0
+        prev_step, prev_t = self._mark
+        dt = time.perf_counter() - prev_t
+        if dt <= 0 or step <= prev_step:
+            return 0.0
+        return (step - prev_step) * self.images_per_step / dt
+
+    def mark(self, step: int) -> None:
+        self._mark = (step, time.perf_counter())
+
+
+def abstractify(tree):
+    """Pytree of arrays → ``ShapeDtypeStruct``s (sharding preserved) —
+    the avals needed to look a compiled executable up via ``lower``."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=getattr(x, "sharding",
+                                                        None)), tree)
+
+
+def compiled_flops(jitted_fn, abstract_args) -> Optional[float]:
+    """FLOPs of one dispatch from XLA's cost analysis via AOT
+    ``lower().compile()``. NOTE: the AOT path keeps its own executable
+    cache — the first call recompiles (hundreds of ms to seconds for a
+    real train step) even when the call path already compiled, so the
+    driver runs this on a background thread, never inline in the step
+    loop. None when the backend doesn't report flops."""
+    try:
+        cost = jitted_fn.lower(*abstract_args).compile().cost_analysis()
+        flops = cost.get("flops", 0.0)
+        return float(flops) if flops and flops > 0 else None
+    except Exception:
+        return None
+
+
 @contextlib.contextmanager
 def profile_trace(log_dir: Optional[str]):
     """Capture an XLA profiler trace into ``log_dir`` when set."""
