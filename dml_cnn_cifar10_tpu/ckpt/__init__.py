@@ -4,6 +4,8 @@ from dml_cnn_cifar10_tpu.ckpt.checkpoint import (  # noqa: F401
     CheckpointManager,
     all_checkpoint_steps,
     latest_checkpoint,
+    load_data_state,
     restore_checkpoint,
     save_checkpoint,
+    save_data_state,
 )

@@ -101,9 +101,39 @@ def _write_checkpoint(ckpt_dir: str, host_state: Any, step: int,
                 shutil.rmtree(old)
             else:
                 os.remove(old)
+            sidecar = os.path.join(ckpt_dir,
+                                   f"data_state_{old_step}.json")
+            if os.path.isfile(sidecar):
+                os.remove(sidecar)
         except OSError:
             pass
     return path
+
+
+def save_data_state(ckpt_dir: str, step: int, counts: dict) -> None:
+    """Sidecar for exact-resume data order: the cumulative number of
+    batches each stream has CONSUMED by ``step`` (identical on every
+    process under SPMD lockstep — the chief writes it next to its
+    checkpoint). Atomic like the checkpoint itself."""
+    import json
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"data_state_{step}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(counts, f)
+    os.replace(tmp, path)
+
+
+def load_data_state(ckpt_dir: str, step: int):
+    """Counts written by :func:`save_data_state`, or None."""
+    import json
+
+    path = os.path.join(ckpt_dir, f"data_state_{step}.json")
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
 
 
 def _checkpoints(ckpt_dir: str):

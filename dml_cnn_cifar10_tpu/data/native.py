@@ -116,6 +116,8 @@ class NativeShuffleBatchIterator(pipe.ShuffleBatchIterator):
     # the reference's RandomShuffleQueue); it has no index view into the
     # decoded arrays, so the HBM-resident path can't reproduce its stream.
     supports_index_stream = False
+    # The C++ pool's draw stream is not replayable from Python.
+    supports_skip = False
 
     def next_index_chunk(self, k: int):
         raise NotImplementedError(

@@ -146,6 +146,66 @@ class ShuffleBatchIterator:
     # and the resident data path is gated off (train/loop.py).
     supports_index_stream = True
 
+    # True when skip_batches can fast-forward the stream — the basis of
+    # exact-resume data order (train/loop.py). The native loader's C++
+    # pool has no replayable draw stream, so it sets this False.
+    supports_skip = True
+
+    # The augmentations skip_batches knows how to replay. New fields in
+    # DataConfig._AUG_OFF must get a mirror draw below (and coverage in
+    # tests/test_exact_resume.py::test_skip_batches_matches_consumed_
+    # stream) — skip_batches raises loudly otherwise, so drift between
+    # _finish's draws and the replay can't be silent.
+    _SKIP_MIRRORED_AUGS = frozenset(
+        {"random_crop", "random_flip", "random_brightness",
+         "random_contrast"})
+
+    def skip_batches(self, n: int, aug: bool = False) -> None:
+        """Fast-forward the stream by ``n`` batches WITHOUT materializing
+        them: replays exactly the index draws (and, with ``aug=True``,
+        the per-batch augmentation draws ``_finish`` makes on the
+        host-decode path) so batch ``n`` after a skip is bit-identical
+        to batch ``n`` of an unskipped same-seed iterator. This is how a
+        resumed run continues the data stream where the previous run's
+        CONSUMPTION stopped — prefetch lookahead regenerates, it is not
+        part of the consumed position. tests/test_exact_resume.py::
+        test_skip_batches_matches_consumed_stream pins the equivalence;
+        keep the draw mirror in sync with ``_finish``."""
+        cfg = self.cfg
+        b = self.batch_size
+        burn_aug = aug and self.train and cfg.augmented
+        if not burn_aug:
+            # No per-batch rng draws besides the index stream, and one
+            # chunked draw is cursor-equivalent to n single draws (the
+            # same equivalence next_index_chunk relies on) — O(1)-ish
+            # even when resuming a 500k-step run.
+            if n > 0:
+                self._next_indices(b * n)
+            return
+        active = {name for name, off in cfg._AUG_OFF
+                  if getattr(cfg, name) != off}
+        unmirrored = active - self._SKIP_MIRRORED_AUGS
+        if unmirrored:
+            raise NotImplementedError(
+                f"skip_batches has no draw mirror for {sorted(unmirrored)}"
+                " — add its rng replay here and to the exact-resume test"
+                " before using it with exact resume")
+        for _ in range(n):
+            self._next_indices(b)
+            if cfg.random_crop:
+                self.rng.integers(
+                    0, cfg.image_height - cfg.crop_height + 1, size=b)
+                self.rng.integers(
+                    0, cfg.image_width - cfg.crop_width + 1, size=b)
+            if cfg.random_flip:
+                self.rng.random(b)
+            if cfg.random_brightness:
+                self.rng.uniform(-cfg.random_brightness,
+                                 cfg.random_brightness, b)
+            if cfg.random_contrast:
+                self.rng.uniform(1.0 - cfg.random_contrast,
+                                 1.0 + cfg.random_contrast, b)
+
     def next_index_chunk(self, k: int) -> np.ndarray:
         """``[k, B]`` int32 shuffled indices into the local decoded arrays
         (``self.images``/``self.labels``) — the same stream as

@@ -202,6 +202,32 @@ class Trainer:
         resident = (k > 1 and cfg.resident_data and num_shards == 1
                     and getattr(train_it, "supports_index_stream", False)
                     and train_it.images.nbytes <= cfg.resident_data_max_bytes)
+        # Exact-resume data order: fast-forward the fresh streams to the
+        # cumulative consumption recorded at the checkpoint being
+        # resumed, so interrupted+resumed training is bit-identical to
+        # an uninterrupted run (the reference's MTS restart replays the
+        # stream from scratch — a documented improvement). Must happen
+        # BEFORE the prefetch threads start drawing. Augmentation draws
+        # are replayed only on paths whose ``_finish`` makes them: the
+        # per-step train stream (k==1) and the host-fed acc stream.
+        # Scope: params + stream position are exact at ANY resume step;
+        # the metric/eval CADENCE is keyed to the LOCAL step (reference
+        # parity, cifar10cnn.py:232), so resuming at a step that is not
+        # a cadence multiple (possible only via wall-clock or preemption
+        # saves) shifts WHEN eval batches are drawn relative to the
+        # uninterrupted run.
+        base_counts = {"train": 0, "acc": 0, "test": 0}
+        exact_ok = all(getattr(it, "supports_skip", False)
+                       for it in (train_it, acc_it, test_it))
+        if start_step > 0 and exact_ok:
+            prior = ckpt_lib.load_data_state(cfg.log_dir, start_step)
+            if prior:
+                base_counts.update(
+                    {name: int(prior.get(name, 0)) for name in base_counts})
+                train_it.skip_batches(base_counts["train"], aug=(k == 1))
+                acc_it.skip_batches(base_counts["acc"], aug=not resident)
+                test_it.skip_batches(base_counts["test"])
+        consumed = {"acc": 0, "test": 0}
         if resident:
             # HBM-resident data path: dataset lives on device, the host
             # ships only shuffled index arrays; gather+decode+K steps are
@@ -286,7 +312,18 @@ class Trainer:
                     loss = float(jax.device_get(last_metrics["loss"]))
                     if not np.isfinite(loss):
                         _numerics_halt(loss, step)
-            return ckpt_mgr.maybe_save(state, step, force=force)
+            saved = ckpt_mgr.maybe_save(state, step, force=force)
+            if saved and exact_ok:
+                # Sidecar pairing the checkpoint with the streams'
+                # cumulative consumption (counts identical on every
+                # process under SPMD lockstep; the chief — the only one
+                # with saved=True — writes).
+                ckpt_lib.save_data_state(cfg.log_dir, step, {
+                    "train": base_counts["train"] + (step - start_step),
+                    "acc": base_counts["acc"] + consumed["acc"],
+                    "test": base_counts["test"] + consumed["test"],
+                })
+            return saved
 
         def _numerics_halt(loss, step):
             self.logger.log("numerics_halt", step=step)
@@ -349,6 +386,7 @@ class Trainer:
                         else:
                             acc_arr = self.eval_step(
                                 state, *self._placed(next(acc_it)))["accuracy"]
+                        consumed["acc"] += 1
                         pair = jax.device_get(
                             jnp.stack([metrics["loss"],
                                        jnp.asarray(acc_arr, jnp.float32)]))
@@ -389,6 +427,11 @@ class Trainer:
                             _numerics_halt(loss, global_step)
                     if (i + k) % cfg.eval_every == 0:
                         ta = self.evaluate(state, test_it)
+                        if not cfg.eval_full_test_set:
+                            # Full sweeps are sequential slices (no
+                            # stream draws); single-batch eval consumes
+                            # one shuffled test batch.
+                            consumed["test"] += 1
                         test_accuracy.append(ta)
                         self.logger.eval_print(ta)
                         self.logger.log("eval", step=global_step,
