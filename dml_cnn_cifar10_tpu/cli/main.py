@@ -184,6 +184,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="halt at the next metrics boundary on non-finite "
                         "loss without checkpointing the poisoned state "
                         "(faithful parity runs NaN by design — keep off)")
+    p.add_argument("--preempt_sync_every", type=int, default=10,
+                   help="steps between multi-host preemption/clock-save "
+                        "agreement allgathers (single-process reacts "
+                        "immediately)")
     p.add_argument("--peak_tflops", type=float, default=None,
                    help="per-chip peak TFLOP/s; enables the MFU metric "
                         "in the jsonl stream")
@@ -209,6 +213,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         log_dir=args.log_dir,
         metrics_jsonl=args.metrics_jsonl,
         peak_tflops=args.peak_tflops,
+        preempt_sync_every=args.preempt_sync_every,
         check_numerics=args.check_numerics,
         ckpt_format=args.ckpt_format,
         tensorboard_dir=args.tensorboard_dir,

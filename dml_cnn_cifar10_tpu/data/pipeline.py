@@ -175,12 +175,17 @@ class ShuffleBatchIterator:
         b = self.batch_size
         burn_aug = aug and self.train and cfg.augmented
         if not burn_aug:
-            # No per-batch rng draws besides the index stream, and one
+            # No per-batch rng draws besides the index stream, and a
             # chunked draw is cursor-equivalent to n single draws (the
-            # same equivalence next_index_chunk relies on) — O(1)-ish
-            # even when resuming a 500k-step run.
-            if n > 0:
-                self._next_indices(b * n)
+            # same equivalence next_index_chunk relies on). Draw at most
+            # one epoch of indices at a time so resuming a 500k-step run
+            # fast-forwards in O(dataset) memory, not O(consumed).
+            remaining = b * n
+            cap = max(self.n, 1)
+            while remaining > 0:
+                take = min(remaining, cap)
+                self._next_indices(take)
+                remaining -= take
             return
         active = {name for name, off in cfg._AUG_OFF
                   if getattr(cfg, name) != off}
