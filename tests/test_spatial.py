@@ -25,11 +25,11 @@ def _mesh(data, seq):
     return mesh_lib.build_mesh(ParallelConfig(data_axis=data, seq_axis=seq))
 
 
-def _run(model_cfg, mesh, images, labels, nsteps=3):
+def _run(model_cfg, mesh, images, labels, nsteps=3, fsdp=False):
     model_def = get_model(model_cfg.name)
     optim = OptimConfig(learning_rate=0.01)
     sh = step_lib.train_state_shardings(mesh, model_def, model_cfg, DATA,
-                                        optim)
+                                        optim, fsdp=fsdp)
     state = step_lib.init_train_state(
         jax.random.key(0), model_def, model_cfg, DATA, optim, mesh,
         state_sharding=sh)
@@ -152,3 +152,18 @@ def test_spatial_chunked_step(rng):
     state, metrics = chunk(state, im, lb)
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
     assert int(jax.device_get(state.step)) == 2
+
+
+def test_spatial_composes_with_fsdp(rng):
+    """Input H over seq + state over data in one step: the two shardings
+    are orthogonal (activations vs weights) and must compose — same math
+    as plain dp, state really partitioned."""
+    cfg = ModelConfig(logit_relu=False)
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    _, loss_dp, _ = _run(cfg, _mesh(8, 1), images, labels)
+    st, losses, im = _run(cfg, _mesh(4, 2), images, labels, fsdp=True)
+    assert im.sharding.spec == P("data", "seq", None, None)
+    from dml_cnn_cifar10_tpu.parallel import shardings
+    assert shardings.assert_some_leaf_sharded(st.params, axis="data")
+    np.testing.assert_allclose(loss_dp, losses, rtol=1e-5, atol=1e-6)
