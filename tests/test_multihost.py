@@ -31,12 +31,13 @@ from dml_cnn_cifar10_tpu.config import TrainConfig, DataConfig
 from dml_cnn_cifar10_tpu.parallel import multihost
 from dml_cnn_cifar10_tpu.train.loop import Trainer
 
+total_steps = int(sys.argv[8]) if len(sys.argv) > 8 else 8
 hosts = [f"localhost:{port}"] * n_procs  # coordinator = hosts[0]
 multihost.initialize_from_hosts(hosts, task_index)
 assert jax.process_count() == n_procs
 
 cfg = TrainConfig(
-    batch_size=32, total_steps=8, output_every=4, eval_every=8,
+    batch_size=32, total_steps=total_steps, output_every=4, eval_every=8,
     checkpoint_every=8, log_dir=log_dir,
     steps_per_dispatch=steps_per_dispatch,
     data=DataConfig(dataset="synthetic", data_dir=data_dir,
@@ -56,6 +57,7 @@ print("RESULT " + json.dumps({
     "task": task_index,
     "final_step": res.final_step,
     "loss": res.train_loss[-1],
+    "losses": res.train_loss,
     "test_accuracy": res.test_accuracy[-1],
     "is_chief": mh.is_chief(),
     "fsdp_nonaddressable": nonaddr,
@@ -94,7 +96,30 @@ def test_two_process_fsdp(tmp_path, data_cfg):
     assert all(r["fsdp_nonaddressable"] for r in results)
 
 
-def _run_two_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False):
+def test_two_process_exact_resume(tmp_path, data_cfg):
+    """The exact-resume contract across REAL process boundaries: a
+    2-process run stopped at 8 and resumed to 16 logs the same losses
+    at the same steps as a straight 16-step 2-process run (chief-written
+    sidecar, per-process shard streams fast-forwarded)."""
+    straight = _run_two_process(tmp_path / "a", data_cfg,
+                                steps_per_dispatch=1, total_steps=16,
+                                final_step=16)
+    _run_two_process(tmp_path / "b", data_cfg, steps_per_dispatch=1,
+                     total_steps=8, final_step=8)
+    resumed = _run_two_process(tmp_path / "b", data_cfg,
+                               steps_per_dispatch=1, total_steps=16,
+                               final_step=16)
+    # A true resume logs ONLY the post-restore boundaries (train_loss is
+    # rebuilt per fit) — a silent from-scratch restart would log four.
+    assert len(resumed[0]["losses"]) == 2
+    # The straight run's boundary losses at steps 12/16 must reappear
+    # exactly in the resumed run (its local boundaries re-align because
+    # 8 is a cadence multiple).
+    assert straight[0]["losses"][-2:] == resumed[0]["losses"]
+
+
+def _run_two_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False,
+                     total_steps=8, final_step=8):
     n = 2
     port = _free_port()
     data_dir = str(tmp_path / "data")
@@ -116,7 +141,7 @@ def _run_two_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False):
         subprocess.Popen(
             [sys.executable, str(script), str(i), str(n), str(port),
              data_dir, log_dir, str(steps_per_dispatch),
-             str(int(fsdp))],
+             str(int(fsdp)), str(total_steps)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=REPO)
         for i in range(n)
@@ -136,7 +161,7 @@ def _run_two_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False):
         assert lines, f"no RESULT line in:\n{out}"
         results.append(json.loads(lines[-1][len("RESULT "):]))
 
-    assert all(r["final_step"] == 8 for r in results)
+    assert all(r["final_step"] == final_step for r in results)
     # Loss/accuracy come out of the same replicated SPMD computation, so
     # every process must report identical values.
     assert results[0]["loss"] == results[1]["loss"]
@@ -147,5 +172,8 @@ def _run_two_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False):
     # (the single writer), and the shared dir has the final-step checkpoint.
     assert sorted(r["is_chief"] for r in results) == [False, True]
     from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt
-    assert ckpt.all_checkpoint_steps(log_dir) == [8]
+    # Chief-only single writer, cadence-only steps: [8] for the 8-step
+    # runs, [8, 16] after the resumed leg.
+    assert sorted(ckpt.all_checkpoint_steps(log_dir)) == list(
+        range(8, final_step + 1, 8))
     return results
