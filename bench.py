@@ -40,6 +40,7 @@ def main() -> None:
     cfg.batch_size = 128
     cfg.log_dir = "/tmp/bench_logs_unused"
     cfg.checkpoint_every = 10**9             # no checkpoint I/O in the loop
+    cfg.data.prefetch = 4                    # measured +1.6% over depth 2
     # The raw-chunk path reads the base iterator's in-memory permutation
     # directly; the native loader's C++ shuffle pool would be dead weight.
     cfg.data.use_native_loader = False

@@ -1,0 +1,15 @@
+"""docs/CLI.md must match the argparse definition (generated doc)."""
+
+import os
+
+
+def test_cli_doc_is_fresh():
+    from tools.gen_cli_doc import render
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "docs", "CLI.md")
+    assert os.path.isfile(path), "run: python tools/gen_cli_doc.py"
+    with open(path) as f:
+        on_disk = f.read()
+    assert on_disk == render(), (
+        "docs/CLI.md is stale — run: python tools/gen_cli_doc.py")
