@@ -1,0 +1,8 @@
+"""``python -m dml_cnn_cifar10_tpu`` — same CLI as ``cifar10cnn.py``."""
+
+import sys
+
+from dml_cnn_cifar10_tpu.cli.main import main
+
+if __name__ == "__main__":
+    sys.exit(main())
