@@ -165,15 +165,26 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
     if path is None:
         return target
     host_target = fetch_to_host(target)
-    if path.endswith(".orbax"):
-        import orbax.checkpoint as ocp
+    try:
+        if path.endswith(".orbax"):
+            import orbax.checkpoint as ocp
 
-        raw = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
-        restored = serialization.from_state_dict(host_target, raw)
-    else:
-        with open(path, "rb") as f:
-            data = f.read()
-        restored = serialization.from_bytes(host_target, data)
+            raw = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+            restored = serialization.from_state_dict(host_target, raw)
+        else:
+            with open(path, "rb") as f:
+                data = f.read()
+            restored = serialization.from_bytes(host_target, data)
+    except ValueError as e:
+        # Usually a config mismatch against the run that wrote the
+        # checkpoint; a corrupted file (partial copy, bit rot — msgpack
+        # unpack errors are ValueErrors too) reads the same way, so name
+        # both instead of a bare pytree-keys traceback.
+        raise ValueError(
+            f"failed to restore checkpoint {path}: either it was "
+            f"written with a different config (--model, --optimizer, "
+            f"--ema_decay, --async_staleness ...) or the file is "
+            f"corrupted/truncated. Original error: {e}") from e
     if sharding is not None:
         restored = jax.device_put(restored, sharding)
     return restored

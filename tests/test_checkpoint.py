@@ -219,3 +219,21 @@ def test_orbax_format_roundtrip_and_mixed_retention(tmp_path, data_cfg):
     steps = sorted(ckpt_lib.all_checkpoint_steps(cfg2.log_dir))
     assert steps == [6, 8]          # orbax 2/4 pruned by retention
     assert os.path.isfile(os.path.join(cfg2.log_dir, "ckpt_8.msgpack"))
+
+
+def test_mismatched_config_restore_error(tmp_path, data_cfg):
+    """Restoring with a different model/optimizer names the likely cause
+    instead of a bare flax pytree traceback."""
+    import pytest
+
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+    from tests.conftest import tiny_train_cfg
+
+    cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=2)
+    cfg.checkpoint_every = 2
+    Trainer(cfg).fit()
+
+    cfg2 = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=4)
+    cfg2.model.name = "resnet18"
+    with pytest.raises(ValueError, match="different config"):
+        Trainer(cfg2).init_or_restore()
