@@ -19,7 +19,6 @@ state so parameter memory is updated in place in HBM.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
