@@ -66,12 +66,27 @@ def save_checkpoint(ckpt_dir: str, state: Any, step: int,
                              fmt=fmt)
 
 
+def _check_orbax_single_process(fmt: str) -> None:
+    """orbax Checkpointer.save is itself a collective (it runs
+    sync_global_processes barriers on ALL hosts), which the chief-only
+    write design here would deadlock. The msgpack codec has no such
+    constraint. Checked at the write site so BOTH entry points —
+    CheckpointManager and a direct save_checkpoint(..., fmt='orbax') —
+    are covered."""
+    if fmt == "orbax" and jax.process_count() > 1:
+        raise ValueError(
+            "ckpt_format='orbax' is single-process only under the "
+            "chief-only checkpoint design; multi-host runs need "
+            "ckpt_format='msgpack'")
+
+
 def _write_checkpoint(ckpt_dir: str, host_state: Any, step: int,
                       keep: int, fmt: str = "msgpack") -> str:
     """Write an already-on-host state; prune to ``keep`` newest."""
     if fmt not in FORMATS:
         raise ValueError(f"unknown checkpoint format {fmt!r}; "
                          f"have {FORMATS}")
+    _check_orbax_single_process(fmt)
     os.makedirs(ckpt_dir, exist_ok=True)
     path = _ckpt_path(ckpt_dir, step, fmt)
     if fmt == "orbax":
@@ -209,15 +224,9 @@ class CheckpointManager:
         self.every_steps = max(1, every_steps)
         self.keep = keep
         self.fmt = fmt
-        if fmt == "orbax" and jax.process_count() > 1:
-            # orbax Checkpointer.save is itself a collective (it runs
-            # sync_global_processes barriers on ALL hosts), which this
-            # manager's chief-only write design would deadlock. The
-            # msgpack codec has no such constraint.
-            raise ValueError(
-                "ckpt_format='orbax' is single-process only under the "
-                "chief-only CheckpointManager; multi-host runs need "
-                "ckpt_format='msgpack'")
+        # Fail at construction, not at the first due save 500 steps in
+        # (the write path re-checks for direct save_checkpoint callers).
+        _check_orbax_single_process(fmt)
         self._last_saved_step = None
         self.is_chief = (jax.process_index() == 0) if is_chief is None \
             else is_chief
@@ -259,15 +268,30 @@ class CheckpointManager:
                 self._pool.shutdown(wait=True)
                 self._pool = None
 
-    def maybe_save(self, state: Any, step: int, force: bool = False) -> bool:
+    def due(self, step: int, force: bool = False) -> bool:
+        """True when ``maybe_save(state, step, force)`` would attempt a
+        write — the ONE source of truth for the cadence predicate, so
+        callers that must act before a save (numerics guards) can't
+        drift from the manager's own logic.
+
+        The ``step != _last_saved_step`` half exists because the loop's
+        state only changes between steps: a boundary save followed by
+        the final forced save at the same step would rewrite identical
+        bytes — and the orbax codec's same-path re-save has an
+        rmtree-before-write window that is NOT crash-atomic. Skip
+        instead."""
         if not force and step % self.every_steps != 0:
             return False
-        if step == self._last_saved_step:
-            # Nothing new: the loop's state only changes between steps, so
-            # a boundary save followed by the final forced save at the
-            # same step would rewrite identical bytes — and the orbax
-            # codec's same-path re-save has an rmtree-before-write window
-            # that is NOT crash-atomic. Skip instead.
+        return step != self._last_saved_step
+
+    def maybe_save(self, state: Any, step: int, force: bool = False,
+                   data_state: Optional[dict] = None) -> bool:
+        """Save if :meth:`due`. ``data_state`` (the exact-resume stream
+        counts) is committed by the same writer AFTER the checkpoint
+        bytes land, so a crash mid-write can never leave a sidecar whose
+        checkpoint never existed — the pair commits atomically in
+        order even under ``async_save``."""
+        if not self.due(step, force):
             return False
         self._last_saved_step = step
         # Collective fetch BEFORE the chief check: with tensor-parallel
@@ -284,10 +308,16 @@ class CheckpointManager:
         if self.async_save:
             self.flush()  # ordered writes + surface prior errors
             self._pending = self._pool.submit(
-                _write_checkpoint, self.ckpt_dir, host_state, step,
-                self.keep, self.fmt)
+                self._write_with_sidecar, host_state, step, data_state)
         else:
-            _write_checkpoint(self.ckpt_dir, host_state, step,
-                              keep=self.keep, fmt=self.fmt)
+            self._write_with_sidecar(host_state, step, data_state)
         self._last_time = time.monotonic()
         return True
+
+    def _write_with_sidecar(self, host_state: Any, step: int,
+                            data_state: Optional[dict]) -> str:
+        path = _write_checkpoint(self.ckpt_dir, host_state, step,
+                                 keep=self.keep, fmt=self.fmt)
+        if data_state is not None:
+            save_data_state(self.ckpt_dir, step, data_state)
+        return path

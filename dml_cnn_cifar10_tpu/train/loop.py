@@ -305,25 +305,23 @@ class Trainer:
             fetched (one round trip, only when a save is actually due)
             and a poisoned state halts instead of overwriting the last
             good checkpoint."""
-            if cfg.check_numerics and last_metrics is not None:
-                due = force or (step % ckpt_mgr.every_steps == 0
-                                and step != ckpt_mgr._last_saved_step)
-                if due:
-                    loss = float(jax.device_get(last_metrics["loss"]))
-                    if not np.isfinite(loss):
-                        _numerics_halt(loss, step)
-            saved = ckpt_mgr.maybe_save(state, step, force=force)
-            if saved and exact_ok:
-                # Sidecar pairing the checkpoint with the streams'
-                # cumulative consumption (counts identical on every
-                # process under SPMD lockstep; the chief — the only one
-                # with saved=True — writes).
-                ckpt_lib.save_data_state(cfg.log_dir, step, {
-                    "train": base_counts["train"] + (step - start_step),
-                    "acc": base_counts["acc"] + consumed["acc"],
-                    "test": base_counts["test"] + consumed["test"],
-                })
-            return saved
+            if (cfg.check_numerics and last_metrics is not None
+                    and ckpt_mgr.due(step, force)):
+                loss = float(jax.device_get(last_metrics["loss"]))
+                if not np.isfinite(loss):
+                    _numerics_halt(loss, step)
+            # Sidecar pairing the checkpoint with the streams' cumulative
+            # consumption (counts identical on every process under SPMD
+            # lockstep). The manager's writer commits it AFTER the
+            # checkpoint bytes land — chief-only, ordered even when
+            # async — so the pair can never be half-written.
+            data_state = {
+                "train": base_counts["train"] + (step - start_step),
+                "acc": base_counts["acc"] + consumed["acc"],
+                "test": base_counts["test"] + consumed["test"],
+            } if exact_ok else None
+            return ckpt_mgr.maybe_save(state, step, force=force,
+                                       data_state=data_state)
 
         def _numerics_halt(loss, step):
             self.logger.log("numerics_halt", step=step)
@@ -363,11 +361,38 @@ class Trainer:
                         step_abs = abstractify((state, *batch))
                     state, metrics = step_fn(state, *batch)
                     if probe_thread is None:
+                        # First dispatch returned ⇒ trace+compile are done
+                        # and device execution is only now starting: anchor
+                        # the drain meter here so the FIRST boundary
+                        # reports a real post-compile rate instead of 0.0.
+                        meter.mark(global_step)
                         import threading
 
                         def _probe(fn=step_fn, abs_args=step_abs):
-                            flops_cell["flops"] = compiled_flops(
-                                fn, abs_args) or 0.0
+                            f = compiled_flops(fn, abs_args) or 0.0
+                            if f and k > 1:
+                                # Verify, don't assume, that this backend
+                                # counts the K-step scan body ONCE: probe
+                                # the scan-free per-step fn too; a
+                                # chunk/step flops ratio near K means the
+                                # scan was unrolled or counted
+                                # per-iteration — scale back by K.
+                                d = cfg.data
+                                img = jax.ShapeDtypeStruct(
+                                    (cfg.batch_size, d.crop_height,
+                                     d.crop_width, d.num_channels),
+                                    jnp.float32)
+                                lab = jax.ShapeDtypeStruct(
+                                    (cfg.batch_size,), jnp.int32)
+                                f1 = compiled_flops(
+                                    self.train_step,
+                                    (abs_args[0], img, lab)) or 0.0
+                                if f1 and f >= (1 + k) / 2 * f1:
+                                    flops_cell["assume"] = "per_iteration"
+                                    f = f / k
+                                elif f1:
+                                    flops_cell["assume"] = "scan_once"
+                            flops_cell["flops"] = f
 
                         probe_thread = threading.Thread(target=_probe,
                                                         daemon=True)
@@ -397,16 +422,17 @@ class Trainer:
                         perf = {}
                         flops_probe = flops_cell.get("flops")
                         if flops_probe and rate > 0:
-                            # steps/sec x flops/step. Two accounting
-                            # facts (both verified on this backend):
-                            # XLA cost analysis reports the PER-DEVICE
-                            # share of the partitioned program (already
-                            # per-chip, no device_count divide), and it
-                            # counts a lax.scan BODY ONCE — the probed
-                            # value is per (micro)step, so grad-accum
-                            # microbatches scale back in. Models that
-                            # scan their own layer stack (ViT) still
-                            # undercount by depth; exact for the CNN.
+                            # steps/sec x flops/step. XLA cost analysis
+                            # reports the PER-DEVICE share of the
+                            # partitioned program (already per-chip, no
+                            # device_count divide). Whether it counted
+                            # the K-step scan body once was VERIFIED by
+                            # the probe's chunk-vs-step cross-check
+                            # (flops_scan in the metrics records which
+                            # case held); grad-accum microbatches scale
+                            # back in. Models that scan their own layer
+                            # stack (ViT) still undercount by depth;
+                            # exact for the CNN.
                             tf = (flops_probe
                                   * max(1, cfg.optim.grad_accum)
                                   * (rate / cfg.batch_size) / 1e12)
@@ -414,6 +440,11 @@ class Trainer:
                             if cfg.peak_tflops:
                                 perf["mfu"] = round(
                                     tf / cfg.peak_tflops, 4)
+                            if "assume" in flops_cell:
+                                # Logged once: which scan-accounting case
+                                # the cross-check found on this backend.
+                                perf["flops_scan"] = flops_cell.pop(
+                                    "assume")
                         self.logger.train_print(global_step, i + k - 1, acc)
                         self.logger.log("train", step=global_step, loss=loss,
                                         train_accuracy=acc,
