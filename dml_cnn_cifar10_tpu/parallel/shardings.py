@@ -220,6 +220,28 @@ def state_shardings(mesh: Mesh, model_name: str, state: Any,
                         is_leaf=lambda x: isinstance(x, P))
 
 
+def specs_name_axis(tree: Any, axis: str) -> bool:
+    """True iff any ``NamedSharding``/``PartitionSpec`` leaf in ``tree``
+    names ``axis`` with >1 devices — e.g. detects an FSDP (``data``-axis)
+    parameter layout from the sharding tree alone, so step builders don't
+    need a separate flag."""
+    leaves = jax.tree.leaves(
+        tree, is_leaf=lambda x: isinstance(x, (NamedSharding, P)))
+    for leaf in leaves:
+        if isinstance(leaf, NamedSharding):
+            if leaf.mesh.shape.get(axis, 1) <= 1:
+                continue
+            spec = leaf.spec
+        elif isinstance(leaf, P):
+            spec = leaf
+        else:
+            continue
+        if any(axis in (p if isinstance(p, tuple) else (p,))
+               for p in spec if p is not None):
+            return True
+    return False
+
+
 def assert_some_leaf_sharded(state: Any, axis: str = "model") -> bool:
     """True iff at least one leaf is actually partitioned over ``axis``
     (spec names the axis AND the axis has >1 devices, i.e. the leaf really

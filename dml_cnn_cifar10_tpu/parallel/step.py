@@ -25,7 +25,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig, OptimConfig
 from dml_cnn_cifar10_tpu.models.registry import ModelDef
@@ -70,22 +70,31 @@ def init_train_state(
     Placement defaults to replicated — symmetric with ``make_train_step``'s
     default in_shardings. For tensor parallelism pass the SAME
     ``train_state_shardings`` tree to both (as ``Trainer`` does).
+
+    The whole construction is ONE jitted program when a mesh/sharding is
+    given (``out_shardings`` places every leaf directly): initializing a
+    deep model leaf-by-leaf eagerly costs one device dispatch per tensor
+    — ~60 round trips for a ResNet, ~20 s of pure RTT on a remote-tunnel
+    TPU — where the fused init is a single dispatch.
     """
-    params = model_def.init(key, model_cfg, data_cfg)
-    opt = optim_lib.sgd_init(params, optim_cfg)
-    model_state = model_def.init_state(params)
-    if optim_cfg.ema_decay and model_def.has_state and model_state:
-        # BatchNorm running stats track the RAW param trajectory; eval
-        # with EMA params needs matching averaged stats, so the EMA
-        # covers model_state too ("ema_mstate" — replicated like the
-        # live model_state by the sharding rules' default).
-        opt["ema_mstate"] = jax.tree.map(jnp.array, model_state)
-    state = TrainState(params=params, opt=opt, model_state=model_state)
+    def build(key):
+        params = model_def.init(key, model_cfg, data_cfg)
+        opt = optim_lib.sgd_init(params, optim_cfg)
+        model_state = model_def.init_state(params)
+        if optim_cfg.ema_decay and model_def.has_state and model_state:
+            # BatchNorm running stats track the RAW param trajectory; eval
+            # with EMA params needs matching averaged stats, so the EMA
+            # covers model_state too ("ema_mstate" — replicated like the
+            # live model_state by the sharding rules' default).
+            opt["ema_mstate"] = jax.tree.map(jnp.array, model_state)
+        return TrainState(params=params, opt=opt, model_state=model_state)
+
     if state_sharding is not None:
-        state = jax.device_put(state, state_sharding)
-    elif mesh is not None:
-        state = jax.device_put(state, mesh_lib.replicated(mesh))
-    return state
+        return jax.jit(build, out_shardings=state_sharding)(key)
+    if mesh is not None:
+        return jax.jit(build,
+                       out_shardings=mesh_lib.replicated(mesh))(key)
+    return build(key)
 
 
 def train_state_shardings(
@@ -141,6 +150,38 @@ def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,
         return loss, (logits, new_state)
 
     return loss_fn
+
+
+def _fsdp_gather_wrap(loss_fn, mesh: Optional[Mesh], model_cfg: ModelConfig,
+                      state_sharding: Optional[TrainState]):
+    """ZeRO-3's gather-before-compute, stated explicitly.
+
+    When the parameter STORAGE layout shards over ``data`` (FSDP), leaving
+    the layout implicit lets GSPMD propagate the data-axis weight sharding
+    into forward/backward, where it meets batch-over-``data`` activations
+    at reshape boundaries the partitioner cannot reshard efficiently (the
+    "Involuntary full rematerialization" the 8-device dryrun surfaced on
+    the CNN's flatten↔conv edge). Constraining params to their base
+    (tensor-parallel-only) layout at the point of use compiles to one
+    all-gather per step before compute; the constraint's transpose applies
+    the same layout to the gradient cotangents, and XLA's
+    all-reduce-reassociation turns the grad psum + storage-layout slice
+    back into a reduce-scatter — exactly the ZeRO-3 schedule.
+    """
+    if mesh is None or state_sharding is None:
+        return loss_fn
+    if not shardings_lib.specs_name_axis(state_sharding.params, "data"):
+        return loss_fn
+    pipe = mesh.shape.get("pipe", 1) > 1
+
+    def gathered(params, model_state, images, labels):
+        specs = shardings_lib.param_pspecs(model_cfg.name, params, pipe=pipe)
+        shs = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                           is_leaf=lambda x: isinstance(x, P))
+        params = lax.with_sharding_constraint(params, shs)
+        return loss_fn(params, model_state, images, labels)
+
+    return gathered
 
 
 def _step_body(loss_fn, optim_cfg: OptimConfig):
@@ -269,8 +310,10 @@ def make_train_step(
             "(the pipe sharding rule would claim the snapshot ring's "
             "leading axis)")
 
-    loss_fn = _forward_loss(model_def, model_cfg, mesh=mesh,
-                             label_smoothing=optim_cfg.label_smoothing)
+    loss_fn = _fsdp_gather_wrap(
+        _forward_loss(model_def, model_cfg, mesh=mesh,
+                      label_smoothing=optim_cfg.label_smoothing),
+        mesh, model_cfg, state_sharding)
     step = _step_body(loss_fn, optim_cfg)
 
     if mesh is None:
@@ -352,8 +395,10 @@ def make_train_chunk(
     host only shuffles bytes, H2D moves uint8.
     """
     chunk = _chunk_body(
-        _forward_loss(model_def, model_cfg, mesh=mesh,
-                      label_smoothing=optim_cfg.label_smoothing),
+        _fsdp_gather_wrap(
+            _forward_loss(model_def, model_cfg, mesh=mesh,
+                          label_smoothing=optim_cfg.label_smoothing),
+            mesh, model_cfg, state_sharding),
         optim_cfg, data_cfg)
 
     if mesh is None:
@@ -406,8 +451,10 @@ def make_train_chunk_resident(
             "make_train_chunk_resident requires data_cfg (the gathered "
             "dataset rows are raw uint8 and must be decoded on device)")
     body = _chunk_body(
-        _forward_loss(model_def, model_cfg, mesh=mesh,
-                      label_smoothing=optim_cfg.label_smoothing),
+        _fsdp_gather_wrap(
+            _forward_loss(model_def, model_cfg, mesh=mesh,
+                          label_smoothing=optim_cfg.label_smoothing),
+            mesh, model_cfg, state_sharding),
         optim_cfg, data_cfg)
 
     spatial = mesh_lib.spatial_enabled(model_def, mesh)

@@ -21,6 +21,7 @@ from dml_cnn_cifar10_tpu.models.registry import get_model
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import shardings
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
+import pytest
 
 DATA = DataConfig(normalize="scale")
 
@@ -93,6 +94,7 @@ def test_fsdp_state_actually_sharded():
     assert state.params["full3"]["bias"].sharding.spec == P()
 
 
+@pytest.mark.slow
 def test_fsdp_matches_dp(rng):
     """fsdp must be a pure layout change: same losses, same final params
     as replicated dp, to fp32 tolerance (reduce-scatter vs all-reduce can
@@ -109,6 +111,7 @@ def test_fsdp_matches_dp(rng):
                                    rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_fsdp_composes_with_tp(rng):
     """data=4 (fsdp) x model=2 (tp): the col-parallel kernel carries BOTH
     axes and the step still matches pure dp."""
@@ -137,6 +140,7 @@ def test_fsdp_composes_with_tp(rng):
     np.testing.assert_allclose(loss_dp, losses, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_fsdp_adamw_vit(rng):
     """AdamW mu/nu shard over ``data`` and train finitely on a ViT."""
     cfg = ModelConfig(name="vit_tiny", vit_depth=2, vit_dim=64, vit_heads=2,
@@ -150,6 +154,38 @@ def test_fsdp_adamw_vit(rng):
     assert int(jax.device_get(st.step)) == 2
 
 
+@pytest.mark.slow
+def test_fsdp_tp_compiles_without_involuntary_remat(rng, capfd):
+    """Regression for the 8-device dryrun artifact (round 1): the fsdp x tp
+    CNN step used to compile with an SPMD "Involuntary full
+    rematerialization" warning — the data-axis storage sharding of
+    full1/kernel leaked into the backward flatten reshape. The ZeRO-3
+    gather-before-compute constraint (step._fsdp_gather_wrap) must keep the
+    partitioned program free of that fallback. capfd sees the C++ absl log
+    on fd 2."""
+    cfg = ModelConfig(logit_relu=False)
+    mesh = _mesh(data=4, model=2)
+    model_def = get_model("cnn")
+    optim = OptimConfig(learning_rate=0.01)
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, DATA, optim,
+                                        fsdp=True)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, cfg, DATA, optim, mesh,
+        state_sharding=sh)
+    train = step_lib.make_train_step(model_def, cfg, optim, mesh,
+                                     state_sharding=sh)
+    # Distinct batch size => fresh XLA compile (a cache hit would not
+    # re-emit the warning and the assert would pass vacuously).
+    images, labels = _batch(rng, n=32)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    capfd.readouterr()  # drain anything prior
+    state, metrics = train(state, im, lb)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err
+
+
+@pytest.mark.slow
 def test_fsdp_checkpoint_roundtrip(tmp_path, rng):
     """Save from fsdp-sharded state, restore into the same layout: the
     host fetch assembles the global arrays, restore re-sharding matches."""
