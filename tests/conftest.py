@@ -16,6 +16,15 @@ import pytest  # noqa: E402
 from dml_cnn_cifar10_tpu.config import DataConfig, TrainConfig  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy integration test (multi-process runs, long compiles, "
+        "full Trainer e2e). The smoke pass excludes them: "
+        "pytest -m 'not slow' finishes in ~1-2 min; the full suite runs "
+        "everything (ARCHITECTURE §7).")
+
+
 @pytest.fixture(scope="session")
 def synth_data_dir(tmp_path_factory) -> str:
     return str(tmp_path_factory.mktemp("cifar_synth"))

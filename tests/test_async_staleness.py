@@ -8,6 +8,7 @@ async-vs-sync convergence is directly comparable — the validation the
 SURVEY's "hard parts" list asks for, without nondeterministic racing.
 """
 
+import pytest
 import jax
 import numpy as np
 
@@ -61,6 +62,7 @@ def test_staleness_ring_semantics():
     assert "stale" not in new_state  # re-attached by the step body
 
 
+@pytest.mark.slow
 def test_stale_ring_trajectory():
     """With S=2 (same batch every step): step 0 reads slot 0 = init, so
     it matches the sync run; step 1 reads slot 1 which is STILL init —
@@ -75,6 +77,7 @@ def test_stale_ring_trajectory():
     assert not np.allclose(sync_losses[2:], stale_losses[2:])
 
 
+@pytest.mark.slow
 def test_stale_still_converges():
     """Staleness 3 on a separable problem still trains (loss decreases)
     — the async semantics are a different trajectory, not divergence."""
@@ -84,7 +87,6 @@ def test_stale_still_converges():
 
 
 def test_staleness_rejects_explicit_collectives():
-    import pytest
 
     mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
     with pytest.raises(ValueError, match="async_staleness"):
@@ -97,7 +99,6 @@ def test_staleness_rejects_explicit_collectives():
 def test_staleness_guards():
     """SGD-coupled wd and pipeline meshes are rejected with explanations
     (both would silently break the async-semantics claim)."""
-    import pytest
 
     with pytest.raises(ValueError, match="weight_decay"):
         optim.sgd_init({"w": np.ones(2, np.float32)},
@@ -131,7 +132,6 @@ def test_explicit_path_actually_selected(monkeypatch):
 
 
 def test_lars_coupled_wd_also_guarded():
-    import pytest
 
     with pytest.raises(ValueError, match="lars-coupled"):
         optim.sgd_init({"w": np.ones((4, 4), np.float32)},
@@ -139,6 +139,7 @@ def test_lars_coupled_wd_also_guarded():
                                    weight_decay=1e-4))
 
 
+@pytest.mark.slow
 def test_staleness_composes_with_grad_accum():
     """Microbatched gradients at the stale snapshot must equal the
     unaccumulated stale trajectory (mean of equal microbatch means ==

@@ -1,5 +1,6 @@
 """Checkpoint save → restore → bit-identical resume (SURVEY §4)."""
 
+import pytest
 import os
 
 import jax
@@ -108,7 +109,6 @@ def test_async_manager_matches_sync(tmp_path):
 def test_async_writer_error_surfaces(tmp_path):
     """A failing background write raises at the next flush/maybe_save."""
     import jax.numpy as jnp
-    import pytest
 
     from dml_cnn_cifar10_tpu.ckpt import checkpoint as ck
 
@@ -122,6 +122,7 @@ def test_async_writer_error_surfaces(tmp_path):
     ma.close()
 
 
+@pytest.mark.slow
 def test_trainer_async_checkpoint(data_cfg, tmp_path):
     from dml_cnn_cifar10_tpu.ckpt import checkpoint as ck
     from dml_cnn_cifar10_tpu.train.loop import Trainer
@@ -134,6 +135,7 @@ def test_trainer_async_checkpoint(data_cfg, tmp_path):
     assert ck.all_checkpoint_steps(cfg.log_dir)  # final save landed
 
 
+@pytest.mark.slow
 def test_adamw_state_roundtrips(tmp_path, data_cfg):
     """AdamW moments (mu/nu) survive save -> restore -> resume."""
     import dataclasses
@@ -160,6 +162,7 @@ def test_adamw_state_roundtrips(tmp_path, data_cfg):
     assert r2.final_step == 20
 
 
+@pytest.mark.slow
 def test_time_based_cadence(tmp_path, data_cfg):
     """MTS parity: the wall-clock trigger (save_checkpoint_secs analog)
     saves at steps the step cadence would skip, and the clock resets on
@@ -191,6 +194,7 @@ def test_time_based_cadence(tmp_path, data_cfg):
     assert any(s < 8 for s in steps)  # a clock-triggered one landed early
 
 
+@pytest.mark.slow
 def test_orbax_format_roundtrip_and_mixed_retention(tmp_path, data_cfg):
     """The orbax directory codec: save/restore round-trip through the
     Trainer, auto-detected restore, and retention that prunes across
@@ -221,10 +225,10 @@ def test_orbax_format_roundtrip_and_mixed_retention(tmp_path, data_cfg):
     assert os.path.isfile(os.path.join(cfg2.log_dir, "ckpt_8.msgpack"))
 
 
+@pytest.mark.slow
 def test_mismatched_config_restore_error(tmp_path, data_cfg):
     """Restoring with a different model/optimizer names the likely cause
     instead of a bare flax pytree traceback."""
-    import pytest
 
     from dml_cnn_cifar10_tpu.train.loop import Trainer
     from tests.conftest import tiny_train_cfg

@@ -9,8 +9,10 @@ from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
 from dml_cnn_cifar10_tpu.models.registry import get_model
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
+import pytest
 
 
+@pytest.mark.slow
 def test_chunk_matches_stepwise(rng):
     model_def = get_model("cnn")
     model_cfg = ModelConfig(logit_relu=False)
@@ -44,6 +46,7 @@ def test_chunk_matches_stepwise(rng):
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_raw_uint8_chunk_matches_host_decode(rng):
     """The bench path — make_train_chunk(data_cfg=...) fed raw uint8 —
     trains the same math as stepwise training on host-decoded batches."""
@@ -86,6 +89,7 @@ def test_raw_uint8_chunk_matches_host_decode(rng):
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_resident_chunk_matches_raw_chunk(rng):
     """The HBM-resident data path (device-side gather from the in-HBM
     dataset by index) trains the same math as the host-gather raw-uint8

@@ -173,3 +173,30 @@ def test_cifar100_record_layout(tmp_path):
     images, labels = rec.decode_records(records, cfg, label_offset=1)
     assert images.shape[1:] == (32, 32, 3)
     assert labels.max() < 100
+
+
+def test_imagenet_synth_wide_label_roundtrip(tmp_path):
+    """imagenet_synth records: 2-byte big-endian label + CHW image at
+    configurable geometry. Class ids past 255 must survive the encode →
+    decode round trip (a single CIFAR label byte cannot hold them)."""
+    from dml_cnn_cifar10_tpu.data import download
+
+    cfg = DataConfig(dataset="imagenet_synth", data_dir=str(tmp_path),
+                     image_height=16, image_width=16, crop_height=12,
+                     crop_width=12, num_classes=1000,
+                     synthetic_train_records=512,
+                     synthetic_test_records=64, use_native_loader=False)
+    generate_synthetic_dataset(cfg)
+    assert download.label_bytes(cfg) == 2 and download.wide_label(cfg)
+    records = rec.read_record_file(download.train_files(cfg)[0],
+                                   cfg.record_bytes + 1)
+    assert records.shape[1] == 2 + 16 * 16 * 3
+    images, labels = rec.decode_records(records, cfg, wide_label=True)
+    assert images.shape[1:] == (16, 16, 3)
+    assert labels.min() >= 0 and labels.max() < 1000
+    assert labels.max() > 255  # wide labels actually exercised
+    # The full pipeline decodes the same way.
+    it = pipe.input_pipeline(cfg, 32, train=True)
+    batch = next(it)
+    assert batch.images.shape == (32, 12, 12, 3)
+    assert 0 <= batch.labels.min() and batch.labels.max() < 1000

@@ -12,6 +12,7 @@ import numpy as np
 
 from dml_cnn_cifar10_tpu.train.loop import Trainer
 from tests.conftest import tiny_train_cfg
+import pytest
 
 
 def _run(data_cfg, tmpdir, **kw):
@@ -20,6 +21,7 @@ def _run(data_cfg, tmpdir, **kw):
     return jax.device_get(result.state.params)
 
 
+@pytest.mark.slow
 def test_same_seed_bitwise_identical(data_cfg, tmp_path):
     a = _run(data_cfg, str(tmp_path / "a"))
     b = _run(data_cfg, str(tmp_path / "b"))
@@ -27,6 +29,7 @@ def test_same_seed_bitwise_identical(data_cfg, tmp_path):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.slow
 def test_same_seed_bitwise_identical_chunked(data_cfg, tmp_path):
     """The chunked path (background raw-chunk prefetch + device decode) is
     equally deterministic — the prefetch thread changes timing, never
@@ -37,6 +40,7 @@ def test_same_seed_bitwise_identical_chunked(data_cfg, tmp_path):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.slow
 def test_different_seed_differs(data_cfg, tmp_path):
     a = _run(data_cfg, str(tmp_path / "a"))
     b = _run(data_cfg, str(tmp_path / "b"), seed=1)

@@ -1,6 +1,7 @@
 """End-to-end Trainer tests on synthetic data (SURVEY §4: short training run
 asserting loss decreases and accuracy beats chance; checkpoint-resume)."""
 
+import pytest
 import os
 
 import numpy as np
@@ -9,6 +10,7 @@ from dml_cnn_cifar10_tpu.train.loop import Trainer
 from tests.conftest import tiny_train_cfg
 
 
+@pytest.mark.slow
 def test_trainer_end_to_end(data_cfg, tmp_path, capsys):
     cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=60)
     cfg.metrics_jsonl = os.path.join(str(tmp_path), "metrics.jsonl")
@@ -30,6 +32,7 @@ def test_trainer_end_to_end(data_cfg, tmp_path, capsys):
     assert os.path.isfile(os.path.join(cfg.log_dir, "checkpoint"))
 
 
+@pytest.mark.slow
 def test_trainer_resume_from_checkpoint(data_cfg, tmp_path):
     """Stop at 30, build a fresh Trainer on the same log_dir, resume to 60 —
     the StopAtStepHook-on-global-step contract (cifar10cnn.py:219,222)."""
@@ -56,6 +59,7 @@ def test_trainer_full_test_set_eval(data_cfg, tmp_path):
     assert 0.0 <= acc <= 1.0
 
 
+@pytest.mark.slow
 def test_trainer_explicit_collectives_mode(data_cfg, tmp_path):
     cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=12)
     cfg.parallel.explicit_collectives = True
@@ -64,10 +68,10 @@ def test_trainer_explicit_collectives_mode(data_cfg, tmp_path):
     assert np.isfinite(result.train_loss[0])
 
 
+@pytest.mark.slow
 def test_trainer_chunked_dispatch(data_cfg, tmp_path, capsys):
     """steps_per_dispatch > 1: the chunked (raw-uint8 + device-decode)
     path drives the same loop with identical observable cadence."""
-    import pytest
 
     cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=60,
                          steps_per_dispatch=10)
@@ -90,6 +94,7 @@ def test_trainer_chunked_dispatch(data_cfg, tmp_path, capsys):
         Trainer(bad)
 
 
+@pytest.mark.slow
 def test_trainer_chunked_dispatch_native_loader(data_cfg, tmp_path):
     """Chunk mode + the C++ loader: raw chunks stream from the native
     bounded shuffle pool."""
@@ -103,6 +108,7 @@ def test_trainer_chunked_dispatch_native_loader(data_cfg, tmp_path):
     assert np.isfinite(result.train_loss).all()
 
 
+@pytest.mark.slow
 def test_trainer_bfloat16_compute(data_cfg, tmp_path):
     """compute_dtype=bfloat16 (the TPU-native activations dtype, exposed
     as --compute_dtype) trains end-to-end and learns."""
@@ -114,6 +120,7 @@ def test_trainer_bfloat16_compute(data_cfg, tmp_path):
     assert result.test_accuracy[-1] > 0.15
 
 
+@pytest.mark.slow
 def test_profile_trace_writes_files(data_cfg, tmp_path):
     """--profile_dir captures a jax.profiler trace during fit."""
     cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=10)

@@ -17,6 +17,7 @@ from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
 from dml_cnn_cifar10_tpu.models.registry import get_model
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
+import pytest
 
 DATA = DataConfig(normalize="scale")
 CFG = ModelConfig(logit_relu=False)
@@ -37,6 +38,7 @@ def _batch(rng, n=16):
             rng.integers(0, 10, n).astype(np.int32))
 
 
+@pytest.mark.slow
 def test_resume_across_mesh_shapes(tmp_path, rng):
     """Train on an 8-device dp mesh, save; resume on a 4-device dp x tp
     mesh with fsdp — step count, params, and forward math all carry over."""
@@ -84,6 +86,7 @@ def test_resume_across_mesh_shapes(tmp_path, rng):
     assert int(jax.device_get(cont_b.step)) == 4
 
 
+@pytest.mark.slow
 def test_trainer_resume_on_different_parallelism(tmp_path, data_cfg):
     """Driver-level: fit() on dp, resume fit() with fsdp+tp from the same
     log_dir (the restart-with-same---log_dir contract, now elastic)."""

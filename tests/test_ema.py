@@ -6,6 +6,7 @@ rules cover it like any moment buffer), updates every step across every
 optimizer family, and the eval paths pick it automatically.
 """
 
+import pytest
 import jax
 import numpy as np
 
@@ -40,6 +41,7 @@ def test_ema_math_one_step(rng):
                                    rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_eval_uses_ema_params(rng):
     """After a violent step, raw-params eval and EMA eval must differ —
     and the eval step must be the EMA one (equal to logits computed with
@@ -73,6 +75,7 @@ def test_eval_uses_ema_params(rng):
     np.testing.assert_allclose(float(jax.device_get(got)), want, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_ema_shards_and_checkpoints(tmp_path, rng):
     """EMA buffers shard over data under fsdp and survive a checkpoint
     round-trip."""
@@ -107,13 +110,13 @@ def test_ema_shards_and_checkpoints(tmp_path, rng):
 
 
 def test_ema_decay_validation():
-    import pytest
 
     with pytest.raises(ValueError, match="ema_decay"):
         optim.sgd_init({"w": np.ones(2, np.float32)},
                        OptimConfig(ema_decay=1.0))
 
 
+@pytest.mark.slow
 def test_ema_covers_bn_state(rng):
     """BatchNorm models track an EMA of the running stats too
     ("ema_mstate"), and eval pairs it with the EMA params."""

@@ -17,6 +17,7 @@ import numpy as np
 from dml_cnn_cifar10_tpu.data import pipeline as pipe
 from dml_cnn_cifar10_tpu.train.loop import Trainer
 from tests.conftest import tiny_train_cfg
+import pytest
 
 
 def test_skip_batches_matches_consumed_stream(data_cfg):
@@ -63,6 +64,7 @@ def _cfg(data_cfg, tmpdir, total_steps, **kw):
     return cfg
 
 
+@pytest.mark.slow
 def test_resume_is_bitwise_identical_plain_path(tmp_path, data_cfg):
     """8 straight steps == 4 steps + restart + 4 steps, bit-for-bit, on
     the per-step host path (with host-side augmentation draws)."""
@@ -78,6 +80,7 @@ def test_resume_is_bitwise_identical_plain_path(tmp_path, data_cfg):
                                   resumed.test_accuracy[-1:])
 
 
+@pytest.mark.slow
 def test_resume_is_bitwise_identical_resident_path(tmp_path, data_cfg):
     """Same contract on the chunked HBM-resident path (index streams)."""
     kw = dict(steps_per_dispatch=2)
@@ -90,6 +93,7 @@ def test_resume_is_bitwise_identical_resident_path(tmp_path, data_cfg):
         np.testing.assert_array_equal(x, y)
 
 
+@pytest.mark.slow
 def test_resume_without_sidecar_still_works(tmp_path, data_cfg):
     """A checkpoint without the sidecar (older run, or native loader)
     resumes fine — weights restore, the stream just restarts."""

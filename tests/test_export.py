@@ -69,6 +69,7 @@ def test_export_resnet_with_bn_state(rng):
     assert np.isfinite(out).all()
 
 
+@pytest.mark.slow
 def test_cli_eval_and_export_modes(tmp_path, capsys):
     """--mode train then --mode eval (full sweep, reference format line)
     then --mode export (artifact on disk, loadable)."""

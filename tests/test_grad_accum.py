@@ -22,6 +22,7 @@ def _states_close(a, b, rtol=1e-5, atol=1e-6):
 
 
 @pytest.mark.parametrize("model_name", ["cnn", "resnet18"])
+@pytest.mark.slow
 def test_accum_matches_full_batch(model_name, rng):
     model_def = get_model(model_name)
     model_cfg = ModelConfig(name=model_name, logit_relu=False)

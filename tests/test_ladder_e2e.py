@@ -14,8 +14,10 @@ from dml_cnn_cifar10_tpu.config import DataConfig, ParallelConfig
 from dml_cnn_cifar10_tpu.data import ensure_dataset
 from dml_cnn_cifar10_tpu.train.loop import Trainer
 from tests.conftest import tiny_train_cfg
+import pytest
 
 
+@pytest.mark.slow
 def test_resnet18_trainer_e2e(tmp_path, data_cfg):
     cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=6)
     cfg.output_every = cfg.eval_every = cfg.checkpoint_every = 3
@@ -26,6 +28,7 @@ def test_resnet18_trainer_e2e(tmp_path, data_cfg):
     assert np.isfinite(r.train_loss).all()
 
 
+@pytest.mark.slow
 def test_vit_moe_trainer_e2e(tmp_path, data_cfg):
     """MoE ViT through the Trainer on a dp x tp mesh: expert parallelism,
     aux load-balance loss, and the registry defaults all exercised at the
@@ -45,6 +48,7 @@ def test_vit_moe_trainer_e2e(tmp_path, data_cfg):
     assert np.isfinite(r.train_loss).all()
 
 
+@pytest.mark.slow
 def test_cifar100_trainer_e2e(tmp_path):
     """CIFAR-100: 2 label bytes per record, 100-way head — the first
     ladder rung. Synthetic files are pre-generated so the air-gapped run
@@ -74,3 +78,39 @@ def test_cifar100_trainer_e2e(tmp_path):
     # The head really is 100-wide (not silently 10).
     head = r.state.params["full3"]["kernel"]
     assert head.shape[-1] == 100
+
+
+@pytest.mark.slow
+def test_resnet50_imagenet_synth_trainer_e2e(tmp_path):
+    """The ResNet-50/ImageNet rung (BASELINE.json configs[3]) end-to-end:
+    ImageNet-shaped synthetic records (wide 2-byte labels, 1000 classes,
+    crop > 64 so the model selects the 7x7/s2 + 3x3/s2 ImageNet stem —
+    models/resnet.py) through the real Trainer. Geometry is shrunk (80->72)
+    to keep the CPU run tractable; the full 256->224 path is the CLI's
+    --dataset imagenet_synth default and differs only in numbers."""
+    data = DataConfig(
+        dataset="imagenet_synth",
+        data_dir=str(tmp_path / "imgnet"),
+        image_height=80, image_width=80,
+        crop_height=72, crop_width=72,
+        num_classes=1000,
+        synthetic_train_records=64,
+        synthetic_test_records=16,
+        use_native_loader=False,
+        shuffle_buffer=64,
+        normalize="scale",
+    )
+    ensure_dataset(data)
+    cfg = tiny_train_cfg(data, str(tmp_path), total_steps=2)
+    cfg.output_every = cfg.eval_every = cfg.checkpoint_every = 2
+    cfg.batch_size = 8
+    cfg.data = data
+    cfg.model.name = "resnet50"
+    cfg.model.num_classes = 1000
+    cfg.optim.learning_rate = 0.01
+    r = Trainer(cfg).fit()
+    assert r.final_step == 2
+    assert np.isfinite(r.train_loss).all()
+    # ImageNet stem (7x7 conv) and 1000-wide head actually selected.
+    assert r.state.params["stem"]["conv"].shape[0] == 7
+    assert r.state.params["fc"]["kernel"].shape[-1] == 1000

@@ -6,6 +6,7 @@ numerically against optax.lamb; LARS against a NumPy hand-computation of
 You et al.'s local-LR formula.
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -25,6 +26,7 @@ def _tree(rng):
     }
 
 
+@pytest.mark.slow
 def test_lamb_matches_optax(rng):
     import optax
 
@@ -91,6 +93,7 @@ def test_lars_zero_norm_guard():
                for x in jax.tree.leaves(new_params))
 
 
+@pytest.mark.slow
 def test_lars_trains_under_fsdp(rng):
     """LARS momentum buffers shard like params (same 'momentum' key the
     sharding rules already map) and a large-batch step runs on the
@@ -123,7 +126,6 @@ def test_lars_trains_under_fsdp(rng):
 
 
 def test_lamb_rejects_momentum():
-    import pytest
 
     with pytest.raises(ValueError, match="momentum"):
         optim.sgd_init({"w": jnp.ones(2)},

@@ -1,6 +1,7 @@
 """Model unit tests: shapes, param counts, init statistics, quirk switches
 (SURVEY §4)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -89,6 +90,7 @@ def test_conv2d_matches_manual_nhwc():
                                rtol=1e-2, atol=1e-2)
 
 
+@pytest.mark.slow
 def test_bfloat16_compute_path():
     model = ModelConfig(compute_dtype="bfloat16")
     params = cnn.init_params(jax.random.key(0), model, DataConfig())

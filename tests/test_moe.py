@@ -34,6 +34,7 @@ def _dense_expert(params, e_idx, x):
     return h @ params["w2"][e_idx] + params["b2"][e_idx]
 
 
+@pytest.mark.slow
 def test_moe_routes_to_argmax_expert():
     """Ample capacity: each token's output == its argmax expert's MLP
     scaled by the router prob."""
@@ -121,6 +122,7 @@ def test_moe_rules_shard_experts():
     assert specs["blocks"]["qkv"]["kernel"] == P(None, None, "model")
 
 
+@pytest.mark.slow
 def test_ep_train_matches_dp(rng):
     """Experts sharded over model axis == pure layout change."""
     images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
@@ -141,6 +143,7 @@ def test_vit_moe_requires_experts():
             ModelConfig(name="vit_moe", moe_experts=0), DATA)
 
 
+@pytest.mark.slow
 def test_moe_aux_loss_reaches_training_loss(rng):
     """The train loss must include the aux term: zeroing moe_aux_coef
     changes the loss by exactly coef * aux > 0."""
@@ -157,6 +160,7 @@ def test_moe_aux_loss_reaches_training_loss(rng):
 
 # ---- top-2 (GShard) routing ----
 
+@pytest.mark.slow
 def test_top2_combines_two_experts():
     """Ample capacity: each token's output == renormalized-weighted sum of
     its two highest-prob experts' MLPs."""
@@ -180,6 +184,7 @@ def test_top2_combines_two_experts():
     assert np.isfinite(float(aux))
 
 
+@pytest.mark.slow
 def test_top2_first_choice_priority_under_pressure():
     """Capacity exactly fits the first choices: EVERY rank-0 assignment
     survives and EVERY rank-1 assignment drops — the 'a token loses its
@@ -215,6 +220,7 @@ def test_top2_first_choice_priority_under_pressure():
         np.testing.assert_allclose(got[ti], want, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_top1_unchanged_by_topk_refactor():
     """top_k=1 keeps the Switch semantics: output scaled by raw p1."""
     params = _moe_params()
@@ -232,6 +238,7 @@ def test_top1_unchanged_by_topk_refactor():
         np.testing.assert_allclose(got[ti], want, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_top2_vit_moe_trains(rng):
     import dataclasses
 

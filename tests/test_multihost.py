@@ -9,6 +9,7 @@ global batch, exactly like each reference worker feeding its own queue
 (``cifar10cnn.py:201``).
 """
 
+import pytest
 import json
 import os
 import socket
@@ -73,12 +74,14 @@ def _free_port() -> int:
     return port
 
 
+@pytest.mark.slow
 def test_two_process_distributed_training(tmp_path, data_cfg):
     """Two OS processes, one SPMD program: both finish all steps, agree on
     the (replicated) loss, and the chief writes the only checkpoint."""
     _run_two_process(tmp_path, data_cfg, steps_per_dispatch=1)
 
 
+@pytest.mark.slow
 def test_two_process_chunked_dispatch(tmp_path, data_cfg):
     """Same, on the chunked path: each process feeds raw uint8 chunk
     shards via make_array_from_process_local_data with a leading K dim,
@@ -86,6 +89,7 @@ def test_two_process_chunked_dispatch(tmp_path, data_cfg):
     _run_two_process(tmp_path, data_cfg, steps_per_dispatch=4)
 
 
+@pytest.mark.slow
 def test_two_process_fsdp(tmp_path, data_cfg):
     """ZeRO/FSDP across REAL process boundaries: params shard over the
     2-process data axis (leaves are not fully addressable from either
@@ -96,6 +100,7 @@ def test_two_process_fsdp(tmp_path, data_cfg):
     assert all(r["fsdp_nonaddressable"] for r in results)
 
 
+@pytest.mark.slow
 def test_two_process_exact_resume(tmp_path, data_cfg):
     """The exact-resume contract across REAL process boundaries: a
     2-process run stopped at 8 and resumed to 16 logs the same losses

@@ -88,14 +88,14 @@ def test_create_rejects_bad_geometry(lib, data_cfg):
     files = download.train_files(data_cfg)
     paths = b"\0".join(p.encode() for p in files) + b"\0"
     handle = lib.recordio_create(paths, len(files), 3073, 1, 0,
-                                 32, 32, 3, 100, 50, 7)  # min_after>capacity
+                                 32, 32, 3, 100, 50, 7, 0)  # min_after>capacity
     assert not handle
 
 
 def test_missing_file_surfaces_error(lib):
     import ctypes
     paths = b"/nonexistent/nope.bin\0"
-    handle = lib.recordio_create(paths, 1, 3073, 1, 0, 32, 32, 3, 10, 50, 7)
+    handle = lib.recordio_create(paths, 1, 3073, 1, 0, 32, 32, 3, 10, 50, 7, 0)
     assert handle
     imgs = np.empty((8, 32, 32, 3), np.uint8)
     labs = np.empty((8,), np.int32)
@@ -114,7 +114,7 @@ def test_empty_record_files_surface_error(lib, tmp_path):
     f = tmp_path / "empty.bin"
     f.write_bytes(b"\x01" * 100)  # < one 3073-byte record
     paths = str(f).encode() + b"\0"
-    handle = lib.recordio_create(paths, 1, 3073, 1, 0, 32, 32, 3, 10, 50, 7)
+    handle = lib.recordio_create(paths, 1, 3073, 1, 0, 32, 32, 3, 10, 50, 7, 0)
     assert handle
     imgs = np.empty((4, 32, 32, 3), np.uint8)
     labs = np.empty((4,), np.int32)
@@ -144,3 +144,26 @@ def test_pipeline_uses_native_when_enabled(data_cfg):
     batch = next(it)
     assert batch.images.shape == (16, 24, 24, 3)
     it.close()
+
+
+def test_wide_label_decode_parity(tmp_path):
+    """imagenet_synth wide labels (big-endian uint16) through the C++
+    pool: every streamed label must be a label that exists in the NumPy
+    decode of the same files, and ids past 255 must appear."""
+    cfg = DataConfig(dataset="imagenet_synth", data_dir=str(tmp_path),
+                     image_height=8, image_width=8, crop_height=8,
+                     crop_width=8, num_classes=1000,
+                     synthetic_train_records=256,
+                     synthetic_test_records=32, shuffle_buffer=64)
+    download.generate_synthetic_dataset(cfg)
+    imgs, labs = pipe._load_split(download.train_files(cfg), cfg)
+    want = set(int(x) for x in labs)
+    assert max(want) > 255
+    it = _native_it(cfg, batch_size=64)
+    seen = set()
+    for _ in range(4):
+        batch = next(it)
+        seen.update(int(x) for x in batch.labels)
+    it.close()
+    assert seen <= want
+    assert max(seen) > 255

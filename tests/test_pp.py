@@ -48,6 +48,7 @@ def _sequential(x, stacked):
 
 
 @pytest.mark.parametrize("pipe,micro", [(4, None), (4, 8), (2, 4)])
+@pytest.mark.slow
 def test_pipeline_matches_sequential(pipe, micro):
     mesh = _mesh(data=8 // pipe, pipe=pipe)
     stacked = _toy_stack()
@@ -119,6 +120,7 @@ def _run(model_cfg, mesh, images, labels, nsteps=2):
     return state, losses
 
 
+@pytest.mark.slow
 def test_pp_train_step_matches_dp(rng):
     images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
     labels = rng.integers(0, 10, 16).astype(np.int32)

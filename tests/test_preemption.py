@@ -2,6 +2,7 @@
 checkpoint; restart resumes from the saved step (SURVEY §5 failure
 detection, upgraded from the reference's restart-only story)."""
 
+import pytest
 import os
 import signal
 import subprocess
@@ -31,6 +32,7 @@ def _args(data_dir, log_dir, total_steps, jsonl=None):
     return a
 
 
+@pytest.mark.slow
 def test_sigterm_checkpoints_and_resumes(tmp_path, data_cfg):
     data_dir = data_cfg.data_dir
     log_dir = str(tmp_path / "logs")
@@ -117,6 +119,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 def test_multihost_preemption_agrees(tmp_path, data_cfg):
     """SIGTERM delivered to ONE of two SPMD processes: the flag is
     allgathered at a sync boundary, BOTH processes checkpoint and exit
@@ -176,6 +179,7 @@ def test_multihost_preemption_agrees(tmp_path, data_cfg):
     assert steps[0] == steps[1], f"processes exited at different steps {steps}"
 
 
+@pytest.mark.slow
 def test_check_numerics_halts_without_poisoned_checkpoint(tmp_path,
                                                           data_cfg):
     """The faithful LR-0.1-on-raw-pixels combo NaNs within a few steps (a
@@ -183,7 +187,6 @@ def test_check_numerics_halts_without_poisoned_checkpoint(tmp_path,
     metrics boundary and the NaN state is NOT checkpointed."""
     import dataclasses
 
-    import pytest
 
     from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
     from dml_cnn_cifar10_tpu.train.loop import Trainer

@@ -105,6 +105,7 @@ def test_device_random_flip(rng):
     np.testing.assert_array_equal(out[flipped], center[flipped][:, :, ::-1])
 
 
+@pytest.mark.slow
 def test_augmented_chunk_trains(rng):
     """make_train_chunk with an augmented data config: fresh crops per
     chunk, deterministic per (seed, step)."""

@@ -11,12 +11,14 @@ from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
 from dml_cnn_cifar10_tpu.models.registry import get_model
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
+import pytest
 
 DATA = DataConfig(crop_height=32, crop_width=32, normalize="scale")
 VIT = ModelConfig(name="vit_tiny", pool="mean", logit_relu=False,
                   vit_depth=3, vit_dim=64, vit_heads=2, patch_size=4)
 
 
+@pytest.mark.slow
 def test_remat_same_training_math(rng):
     images = rng.normal(0.5, 0.25, (8, 32, 32, 3)).astype(np.float32)
     labels = rng.integers(0, 10, 8).astype(np.int32)
@@ -39,6 +41,7 @@ def test_remat_same_training_math(rng):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_remat_composes_with_sp(rng):
     images = rng.normal(0.5, 0.25, (8, 32, 32, 3)).astype(np.float32)
     labels = rng.integers(0, 10, 8).astype(np.int32)
@@ -56,6 +59,7 @@ def test_remat_composes_with_sp(rng):
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow
 def test_remat_composes_with_pp(rng):
     """remat wraps the pipeline stage body too (not silently ignored)."""
     images = rng.normal(0.5, 0.25, (8, 32, 32, 3)).astype(np.float32)

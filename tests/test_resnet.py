@@ -33,6 +33,7 @@ def r18():
     return cfg, data, params, state
 
 
+@pytest.mark.slow
 def test_resnet18_shapes_and_params(r18):
     cfg, data, params, state = r18
     rng = np.random.default_rng(0)
@@ -50,6 +51,7 @@ def test_resnet18_shapes_and_params(r18):
     assert (jax.tree.structure(state) == jax.tree.structure(new_state))
 
 
+@pytest.mark.slow
 def test_resnet50_bottleneck_shapes():
     cfg, data = _cfgs("resnet50")
     params = resnet.init_params(jax.random.key(0), cfg, data, depth=50)
@@ -65,6 +67,7 @@ def test_resnet50_bottleneck_shapes():
     assert 23_400_000 < n < 23_700_000, n
 
 
+@pytest.mark.slow
 def test_imagenet_stem_for_large_inputs():
     cfg, _ = _cfgs("resnet50")
     data = DataConfig(image_height=224, image_width=224, crop_height=224,
@@ -125,6 +128,7 @@ def test_gamma_zero_blocks_start_as_identity(r18):
     assert abs(float(loss) - np.log(10)) < 1.0
 
 
+@pytest.mark.slow
 def test_explicit_shard_map_matches_auto_jit():
     """Cross-replica BN: shard_map with axis_name pmean of (E[x],E[x²]) must
     produce the same update as jit auto-partitioning's global batch stats."""
@@ -159,6 +163,7 @@ def test_explicit_shard_map_matches_auto_jit():
                                    atol=5e-5)
 
 
+@pytest.mark.slow
 def test_two_steps_no_structure_change():
     """Treedef stability: step 2 reuses the compiled step (same structure)."""
     model_def = get_model("resnet18")

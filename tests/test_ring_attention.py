@@ -28,6 +28,7 @@ def test_ring_matches_dense_seq_only():
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_composes_with_data_parallel():
     """2-way dp × 4-way sp on the same mesh."""
     mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=2, seq_axis=4))
@@ -80,6 +81,7 @@ def test_ring_under_jit_compiles_once():
     assert out1.shape == q.shape and out2.shape == q.shape
 
 
+@pytest.mark.slow
 def test_flash_stats_interface():
     """flash_attention_stats returns (acc, m, l) with acc f32
     unnormalized (the ring merge currency) and acc/l == dense attention."""
@@ -104,6 +106,7 @@ def test_flash_stats_interface():
                                atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_pallas_local_block_matches_dense():
     """Ring attention with the local block on the Pallas flash kernel
     (long shards: S_local = 256 >= 128) == dense attention."""
@@ -116,6 +119,7 @@ def test_ring_pallas_local_block_matches_dense():
                                atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_pallas_bf16_partials_stay_f32():
     """bf16 inputs: the stats interface keeps partials f32, so the ring
     merge matches dense attention at bf16-input tolerance."""

@@ -53,6 +53,7 @@ def _batch(rng, n=8):
 
 
 @pytest.mark.parametrize("axes", [(2, 1, 4), (4, 1, 2), (2, 2, 2)])
+@pytest.mark.slow
 def test_sp_train_matches_dp(axes, rng):
     """dp×tp×sp must be a pure layout change vs the dp-only mesh."""
     images, labels = _batch(rng)

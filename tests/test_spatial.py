@@ -17,6 +17,7 @@ from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig, OptimConfig,
 from dml_cnn_cifar10_tpu.models.registry import get_model
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
+import pytest
 
 DATA = DataConfig(normalize="scale")
 
@@ -53,6 +54,7 @@ def test_images_land_h_sharded(rng):
     assert im.addressable_shards[0].data.shape == (16 // 4, 24 // 2, 24, 3)
 
 
+@pytest.mark.slow
 def test_cnn_spatial_matches_dp(rng):
     """data=4 x seq=2 (H halved per shard) must equal pure dp: the halo
     exchange reconstructs exactly the rows SAME conv/pool padding needs."""
@@ -64,6 +66,7 @@ def test_cnn_spatial_matches_dp(rng):
     np.testing.assert_allclose(loss_dp, loss_sp, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_resnet_spatial_matches_dp(rng):
     """BatchNorm under spatial sharding: the batch statistics reduce over
     (B, H, W) — GSPMD turns the partial spatial sums into a cross-device
@@ -86,6 +89,7 @@ def test_vit_does_not_claim_spatial():
     assert get_model("resnet50").spatial
 
 
+@pytest.mark.slow
 def test_spatial_resident_matches_hostfed(rng):
     """The HBM-resident gather path pins the same spatial layout the
     host-fed chunk uses: identical math on identical indices."""
@@ -130,6 +134,7 @@ def test_spatial_resident_matches_hostfed(rng):
                                    rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_spatial_chunked_step(rng):
     """The K-step raw-uint8 chunk path under spatial sharding: device-side
     decode (crop from 32 to 24) composes with the H-sharded layout."""
@@ -154,6 +159,7 @@ def test_spatial_chunked_step(rng):
     assert int(jax.device_get(state.step)) == 2
 
 
+@pytest.mark.slow
 def test_spatial_composes_with_fsdp(rng):
     """Input H over seq + state over data in one step: the two shardings
     are orthogonal (activations vs weights) and must compose — same math

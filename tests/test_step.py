@@ -40,6 +40,7 @@ def test_mesh_shapes():
         mesh_lib.build_mesh(ParallelConfig(data_axis=3, model_axis=3))
 
 
+@pytest.mark.slow
 def test_sharded_step_matches_single_device(setup):
     """Sync data parallelism is semantics-preserving: the sharded global
     batch produces the same update as one device computing the full batch."""
@@ -65,6 +66,7 @@ def test_sharded_step_matches_single_device(setup):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_explicit_collectives_match_auto_sharding(setup):
     """shard_map + lax.pmean == jit auto-partitioning (same math, explicit
     vs compiler-inserted collectives)."""
@@ -90,6 +92,7 @@ def test_explicit_collectives_match_auto_sharding(setup):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_loss_decreases_on_separable_data(setup):
     """Integration (SURVEY §4): a short run must learn the synthetic
     class-separable data."""

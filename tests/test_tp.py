@@ -99,6 +99,7 @@ def test_cnn_params_actually_sharded():
 
 @pytest.mark.parametrize("name,momentum", [("cnn", 0.0), ("cnn", 0.9),
                                            ("vit_tiny", 0.0)])
+@pytest.mark.slow
 def test_tp_matches_dp(name, momentum, rng):
     """model_axis=2 must be a pure layout change: same losses, same final
     params as the dp-only mesh, to fp32 tolerance."""
@@ -143,6 +144,7 @@ def test_explicit_collectives_rejects_tp():
                                  explicit_collectives=True)
 
 
+@pytest.mark.slow
 def test_adamw_under_tp(rng):
     """AdamW's sharded mu/nu moments flow through a real tensor-parallel
     train step (spec-level coverage lives in test_train_math)."""

@@ -191,6 +191,7 @@ def test_adamw_matches_optax():
     assert int(state["step"]) == 5
 
 
+@pytest.mark.slow
 def test_adamw_trains_vit(rng):
     """AdamW through the full train step (the transformer-ladder recipe)."""
     from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig,
@@ -268,6 +269,7 @@ def test_label_smoothing_loss():
             logits, labels, label_smoothing=0.0)))
 
 
+@pytest.mark.slow
 def test_label_smoothing_through_train_step(rng):
     from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig,
                                             ParallelConfig)

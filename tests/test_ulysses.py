@@ -99,6 +99,7 @@ def _run(model_cfg, mesh, images, labels, nsteps=2):
 
 
 @pytest.mark.parametrize("axes", [(2, 1, 4), (4, 1, 2), (2, 2, 2)])
+@pytest.mark.slow
 def test_ulysses_train_matches_dp(axes, rng):
     images = rng.normal(0.5, 0.25, (8, 32, 32, 3)).astype(np.float32)
     labels = rng.integers(0, 10, 8).astype(np.int32)

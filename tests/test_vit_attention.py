@@ -21,6 +21,7 @@ def _vit_cfgs():
             DataConfig())
 
 
+@pytest.mark.slow
 def test_vit_shapes_and_param_count():
     cfg, data = _vit_cfgs()
     params = vit.init_params(jax.random.key(0), cfg, data)
@@ -43,6 +44,7 @@ def test_vit_rejects_indivisible_patch():
         vit.init_params(jax.random.key(0), cfg, data)
 
 
+@pytest.mark.slow
 def test_vit_train_step_runs():
     model_def = get_model("vit_tiny")
     cfg, data = _vit_cfgs()
@@ -59,6 +61,7 @@ def test_vit_train_step_runs():
 
 
 @pytest.mark.parametrize("s,d,h", [(128, 64, 2), (200, 64, 3), (384, 32, 1)])
+@pytest.mark.slow
 def test_flash_matches_xla(s, d, h):
     """Online-softmax kernel == fused XLA attention, including non-multiple
     -of-block sequence lengths (padding + in-kernel masking)."""
