@@ -57,7 +57,20 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["cnn", "resnet18", "resnet50", "vit_tiny",
                             "vit_moe"])
     p.add_argument("--dataset", type=str, default="cifar10",
-                   choices=["cifar10", "cifar100", "synthetic"])
+                   choices=["cifar10", "cifar100", "synthetic",
+                            "imagenet_synth"],
+                   help="imagenet_synth: generated ImageNet-shaped shards "
+                        "(256x256, 1000 classes, wide 2-byte labels) — the "
+                        "ResNet-50 ladder rung on an air-gapped box")
+    p.add_argument("--image_size", type=int, default=None,
+                   help="stored square image side (default: 32, or 256 "
+                        "for imagenet_synth)")
+    p.add_argument("--crop_size", type=int, default=None,
+                   help="model input side after crop (default: 24, or 224 "
+                        "for imagenet_synth)")
+    p.add_argument("--synthetic_train_records", type=int, default=None,
+                   help="generated train records for "
+                        "synthetic/imagenet_synth datasets")
     p.add_argument("--batch_size", type=int, default=128)
     p.add_argument("--total_steps", type=int, default=20000)
     p.add_argument("--output_every", type=int, default=200,
@@ -226,6 +239,18 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.data.random_contrast = args.random_contrast
     if args.dataset == "cifar100":
         cfg.data.num_classes = cfg.model.num_classes = 100
+    if args.dataset == "imagenet_synth":
+        # The ResNet-50 ImageNet-1k rung (BASELINE.json configs[3]):
+        # canonical 256-stored / 224-crop geometry, 1000 classes.
+        cfg.data.image_height = cfg.data.image_width = 256
+        cfg.data.crop_height = cfg.data.crop_width = 224
+        cfg.data.num_classes = cfg.model.num_classes = 1000
+    if args.image_size is not None:
+        cfg.data.image_height = cfg.data.image_width = args.image_size
+    if args.crop_size is not None:
+        cfg.data.crop_height = cfg.data.crop_width = args.crop_size
+    if args.synthetic_train_records is not None:
+        cfg.data.synthetic_train_records = args.synthetic_train_records
     cfg.model.name = args.model
     cfg.model.compute_dtype = args.compute_dtype
     cfg.optim.learning_rate = args.learning_rate

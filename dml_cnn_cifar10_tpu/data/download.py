@@ -24,6 +24,13 @@ CIFAR10_URL = "http://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz"
 CIFAR100_URL = "http://www.cs.toronto.edu/~kriz/cifar-100-binary.tar.gz"
 CIFAR10_FOLDER = "cifar-10-batches-bin"   # extract_folder (cifar10cnn.py:27)
 CIFAR100_FOLDER = "cifar-100-binary"
+# ImageNet-shaped synthetic rung (BASELINE.json configs[3] — "ResNet-50 on
+# ImageNet-1k"): same fixed-length binary framing at configurable geometry
+# (e.g. 256x256x3, 1000 classes). >255 classes no longer fit CIFAR's single
+# label byte, so these records lead with a 2-byte BIG-ENDIAN label
+# (wide_label below). ImageNet itself has no binary-record distribution and
+# this box has no egress; the shards are always generated synthetically.
+IMAGENET_SYNTH_FOLDER = "imagenet-synth-bin"
 
 
 def _progress(url: str):
@@ -59,6 +66,9 @@ def train_files(cfg: DataConfig) -> List[str]:
         return [os.path.join(base, f"data_batch_{i}.bin") for i in range(1, 6)]
     if cfg.dataset == "cifar100":
         return [os.path.join(cfg.data_dir, CIFAR100_FOLDER, "train.bin")]
+    if cfg.dataset == "imagenet_synth":
+        base = os.path.join(cfg.data_dir, IMAGENET_SYNTH_FOLDER)
+        return [os.path.join(base, f"train_{i}.bin") for i in range(1, 5)]
     raise ValueError(f"unknown dataset {cfg.dataset!r}")
 
 
@@ -68,12 +78,22 @@ def test_files(cfg: DataConfig) -> List[str]:
         return [os.path.join(cfg.data_dir, CIFAR10_FOLDER, "test_batch.bin")]
     if cfg.dataset == "cifar100":
         return [os.path.join(cfg.data_dir, CIFAR100_FOLDER, "test.bin")]
+    if cfg.dataset == "imagenet_synth":
+        return [os.path.join(cfg.data_dir, IMAGENET_SYNTH_FOLDER, "val.bin")]
     raise ValueError(f"unknown dataset {cfg.dataset!r}")
 
 
 def label_bytes(cfg: DataConfig) -> int:
-    """CIFAR-10 records lead with 1 label byte; CIFAR-100 with 2 (coarse+fine)."""
-    return 2 if cfg.dataset == "cifar100" else 1
+    """CIFAR-10 records lead with 1 label byte; CIFAR-100 with 2
+    (coarse+fine); imagenet_synth with 2 (one big-endian uint16)."""
+    return 2 if cfg.dataset in ("cifar100", "imagenet_synth") else 1
+
+
+def wide_label(cfg: DataConfig) -> bool:
+    """True when the 2 leading label bytes encode ONE big-endian uint16
+    (class counts past 255) rather than CIFAR-100's coarse+fine byte
+    pair."""
+    return cfg.dataset == "imagenet_synth"
 
 
 def generate_synthetic_dataset(cfg: DataConfig, seed: int = 0) -> None:
@@ -86,27 +106,48 @@ def generate_synthetic_dataset(cfg: DataConfig, seed: int = 0) -> None:
     """
     rng = np.random.default_rng(seed)
     nlb = label_bytes(cfg)
+    wide = wide_label(cfg)
     img_len = cfg.image_height * cfg.image_width * cfg.num_channels
     # One per-class mean-color table for the WHOLE dataset (train and test
     # shards must share the class→color mapping or nothing generalizes).
     means = rng.integers(30, 226, size=(cfg.num_classes, cfg.num_channels))
 
     def write(path: str, n: int) -> None:
-        if os.path.isfile(path):
+        # Skip only when the existing file matches the REQUESTED geometry
+        # and record count — a stale shard generated under different
+        # --image_size/--crop_size/--synthetic_*_records would otherwise
+        # be silently reused and mis-decoded downstream.
+        want_bytes = n * (nlb + img_len)
+        if os.path.isfile(path) and os.path.getsize(path) == want_bytes:
             return
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        labels = rng.integers(0, cfg.num_classes, size=n, dtype=np.uint8)
-        recs = np.empty((n, nlb + img_len), dtype=np.uint8)
-        for lb in range(nlb):
-            recs[:, lb] = labels  # coarse == fine for synthetic CIFAR-100
-        chw = rng.normal(
-            means[labels][:, :, None, None],
-            40.0,
-            size=(n, cfg.num_channels, cfg.image_height, cfg.image_width),
-        )
-        recs[:, nlb:] = np.clip(chw, 0, 255).astype(np.uint8).reshape(n, img_len)
-        with open(path, "wb") as f:
-            f.write(recs.tobytes())
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            # Bounded chunks: one float32 normal draw per chunk instead of
+            # a whole-shard float64 array (tens of GB at ImageNet
+            # geometry).
+            step = max(1, min(n, (64 << 20) // max(img_len, 1)))
+            for lo in range(0, n, step):
+                m = min(step, n - lo)
+                labels = rng.integers(0, cfg.num_classes, size=m,
+                                      dtype=np.int32)
+                recs = np.empty((m, nlb + img_len), dtype=np.uint8)
+                if wide:
+                    # big-endian uint16
+                    recs[:, 0] = (labels >> 8).astype(np.uint8)
+                    recs[:, 1] = (labels & 0xFF).astype(np.uint8)
+                else:
+                    for lb in range(nlb):
+                        # coarse == fine for synthetic CIFAR-100
+                        recs[:, lb] = labels.astype(np.uint8)
+                chw = rng.normal(
+                    means[labels][:, :, None, None], 40.0,
+                    size=(m, cfg.num_channels, cfg.image_height,
+                          cfg.image_width)).astype(np.float32)
+                recs[:, nlb:] = np.clip(chw, 0, 255).astype(
+                    np.uint8).reshape(m, img_len)
+                f.write(recs.tobytes())
+        os.replace(tmp, path)
 
     per_shard = max(1, cfg.synthetic_train_records // len(train_files(cfg)))
     for path in train_files(cfg):
@@ -122,7 +163,10 @@ def ensure_dataset(cfg: DataConfig) -> None:
     ``synthetic`` mode (or when the download fails — e.g. an air-gapped host)
     it falls back to :func:`generate_synthetic_dataset`.
     """
-    if cfg.dataset == "synthetic":
+    if cfg.dataset in ("synthetic", "imagenet_synth"):
+        # imagenet_synth is generate-only: ImageNet has no fixed-length
+        # binary distribution to download; the rung's record framing is
+        # this framework's own (wide labels + configurable geometry).
         generate_synthetic_dataset(cfg, seed=cfg.seed)
         return
     needed = train_files(cfg) + test_files(cfg)

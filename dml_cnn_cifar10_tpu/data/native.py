@@ -40,8 +40,31 @@ _lib_lock = threading.Lock()
 
 
 def _build_library() -> None:
-    subprocess.run(["make", "-C", _RUNTIME_DIR], check=True,
-                   capture_output=True)
+    # Serialize concurrent builders (multi-process tests on one box): a
+    # relink racing another process's dlopen would hand out a truncated
+    # .so. fcntl lock on a sidecar file; make itself is then idempotent.
+    import fcntl
+    lock_path = os.path.join(_RUNTIME_DIR, ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        subprocess.run(["make", "-C", _RUNTIME_DIR], check=True,
+                       capture_output=True)
+
+
+def _needs_build() -> bool:
+    """True when the .so is missing or older than its sources. The
+    timestamp check lives HERE (not in an unconditional make) so a host
+    with a prebuilt .so and no toolchain never shells out — but a stale
+    binary after a recordio.cc edit still rebuilds (loading it against
+    newer argtypes would silently mis-decode)."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    so_mtime = os.path.getmtime(_LIB_PATH)
+    for src in ("recordio.cc", "Makefile"):
+        path = os.path.join(_RUNTIME_DIR, src)
+        if os.path.exists(path) and os.path.getmtime(path) > so_mtime:
+            return True
+    return False
 
 
 def load_library() -> ctypes.CDLL:
@@ -50,14 +73,14 @@ def load_library() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        if _needs_build():
             _build_library()
         lib = ctypes.CDLL(_LIB_PATH)
         lib.recordio_create.restype = ctypes.c_void_p
         lib.recordio_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int64,
         ]
         lib.recordio_next_batch.restype = ctypes.c_int
         lib.recordio_next_batch.argtypes = [
@@ -104,7 +127,8 @@ class NativeShuffleBatchIterator(pipe.ShuffleBatchIterator):
             paths, len(files), record_bytes, nlb, nlb - 1,
             cfg.image_height, cfg.image_width, cfg.num_channels,
             min(cfg.shuffle_buffer, capacity), capacity,
-            np.uint64(seed * 2654435761 + 97531 + shard))
+            np.uint64(seed * 2654435761 + 97531 + shard),
+            int(download.wide_label(cfg)))
         if not self._handle:
             raise RuntimeError("recordio_create failed (bad geometry?)")
         self._img_buf = np.empty(

@@ -37,11 +37,12 @@ def _load_split(files: List[str], cfg: DataConfig):
     nlb = download.label_bytes(cfg)
     record_bytes = cfg.record_bytes + (nlb - 1)
     label_offset = nlb - 1  # CIFAR-100: fine label is the 2nd byte
+    wide = download.wide_label(cfg)  # imagenet_synth: big-endian uint16
     imgs, labs = [], []
     for path in files:
         r = rec.read_record_file(path, record_bytes)
         i, l = rec.decode_records(r, cfg, label_offset=label_offset,
-                                  dtype=np.uint8)
+                                  dtype=np.uint8, wide_label=wide)
         imgs.append(i)
         labs.append(l)
     return np.concatenate(imgs, axis=0), np.concatenate(labs, axis=0)

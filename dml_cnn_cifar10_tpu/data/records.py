@@ -31,7 +31,7 @@ def read_record_file(path: str, record_bytes: int) -> np.ndarray:
 
 def decode_records(
     records: np.ndarray, cfg: DataConfig, label_offset: int = 0,
-    dtype=np.float32
+    dtype=np.float32, wide_label: bool = False
 ) -> Tuple[np.ndarray, np.ndarray]:
     """uint8 records → (images [N,H,W,C] ``dtype``, labels [N] int32).
 
@@ -40,9 +40,15 @@ def decode_records(
     the remaining bytes are a CHW image transposed to HWC. The reference
     casts to float32 with no normalization (raw 0..255 values); the pipeline
     stores uint8 (4x less host RAM) and defers the cast to batch assembly.
+    ``wide_label``: the first TWO bytes are one big-endian uint16 label
+    (class counts past 255 — the imagenet_synth framing).
     """
     nlb = records.shape[1] - cfg.image_height * cfg.image_width * cfg.num_channels
-    labels = records[:, label_offset].astype(np.int32)
+    if wide_label:
+        labels = ((records[:, 0].astype(np.int32) << 8)
+                  | records[:, 1].astype(np.int32))
+    else:
+        labels = records[:, label_offset].astype(np.int32)
     chw = records[:, nlb:].reshape(
         -1, cfg.num_channels, cfg.image_height, cfg.image_width
     )

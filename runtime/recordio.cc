@@ -42,7 +42,8 @@ struct Loader {
   std::vector<std::string> files;
   int64_t record_bytes = 0;   // full record: label byte(s) + C*H*W
   int64_t label_offset = 0;   // which label byte (CIFAR-100 fine = 1)
-  int64_t label_bytes = 0;    // 1 (CIFAR-10) or 2 (CIFAR-100)
+  int64_t label_bytes = 0;    // 1 (CIFAR-10) or 2 (CIFAR-100/imagenet_synth)
+  int64_t label_wide = 0;     // 2 leading bytes are ONE big-endian uint16
   int64_t height = 0, width = 0, channels = 0;
   int64_t min_after = 0;      // min buffered records before dequeue
   int64_t capacity = 0;       // shuffle pool capacity
@@ -121,7 +122,10 @@ void producer_loop(Loader* L) {
 // Decode one record from the pool into batch slot b: CHW uint8 -> HWC.
 void decode_into(const Loader* L, const uint8_t* rec, uint8_t* images,
                  int32_t* labels, int64_t b) {
-  labels[b] = static_cast<int32_t>(rec[L->label_offset]);
+  labels[b] = L->label_wide
+                  ? (static_cast<int32_t>(rec[0]) << 8) |
+                        static_cast<int32_t>(rec[1])
+                  : static_cast<int32_t>(rec[L->label_offset]);
   const uint8_t* img = rec + L->label_bytes;
   const int64_t H = L->height, W = L->width, C = L->channels;
   uint8_t* out = images + b * H * W * C;
@@ -138,14 +142,17 @@ void decode_into(const Loader* L, const uint8_t* rec, uint8_t* images,
 extern "C" {
 
 // paths: NUL-separated concatenation of n_files file paths.
+// label_wide != 0: the 2 leading bytes are one big-endian uint16 label
+// (imagenet_synth framing, class counts past 255).
 void* recordio_create(const char* paths, int64_t n_files,
                       int64_t record_bytes, int64_t label_bytes,
                       int64_t label_offset, int64_t height, int64_t width,
                       int64_t channels, int64_t min_after, int64_t capacity,
-                      uint64_t seed) {
+                      uint64_t seed, int64_t label_wide) {
   if (n_files <= 0 || record_bytes <= 0 || capacity <= 0 ||
       min_after <= 0 || min_after > capacity ||
-      label_bytes + height * width * channels != record_bytes) {
+      label_bytes + height * width * channels != record_bytes ||
+      (label_wide && label_bytes != 2)) {
     return nullptr;
   }
   Loader* L = new Loader();
@@ -157,6 +164,7 @@ void* recordio_create(const char* paths, int64_t n_files,
   L->record_bytes = record_bytes;
   L->label_bytes = label_bytes;
   L->label_offset = label_offset;
+  L->label_wide = label_wide;
   L->height = height;
   L->width = width;
   L->channels = channels;
