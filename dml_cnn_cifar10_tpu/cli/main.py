@@ -122,6 +122,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "backward pass (activation memory O(1) in depth)")
     p.add_argument("--pipe_axis", type=int, default=1,
                    help="pipeline-parallel mesh degree (GPipe stages)")
+    p.add_argument("--pipe_microbatches", type=int, default=0,
+                   help="GPipe microbatches per step (0 = one per stage); "
+                        "more microbatches shrink the bubble fraction "
+                        "(M+P-1)/M at the cost of smaller per-microbatch "
+                        "compute")
     p.add_argument("--moe_experts", type=int, default=0,
                    help="experts per MoE block (vit_moe); sharded over "
                         "the model axis (expert parallelism)")
@@ -285,6 +290,15 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.parallel.model_axis = args.model_axis
     cfg.parallel.seq_axis = args.seq_axis
     cfg.parallel.pipe_axis = args.pipe_axis
+    if args.pipe_microbatches and args.pipe_axis <= 1:
+        # Silently measuring "plain dp" while believing it's an M=4P
+        # schedule is exactly the trap the moe_experts guard below
+        # already closes for its flag pair.
+        raise SystemExit(
+            f"--pipe_microbatches={args.pipe_microbatches} requires "
+            f"--pipe_axis > 1 (got {args.pipe_axis}); without a pipe "
+            f"axis there is no schedule to microbatch")
+    cfg.model.pipe_microbatches = args.pipe_microbatches
     if args.moe_experts and args.model != "vit_moe":
         raise SystemExit(
             f"--moe_experts requires --model vit_moe (got {args.model})")

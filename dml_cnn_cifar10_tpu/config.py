@@ -130,6 +130,12 @@ class ModelConfig:
     # dense full-sequence kernel per head slice (needs heads % seq_axis
     # == 0, best MXU utilization at moderate seq degree).
     sp_mode: str = "ring"                 # ring | ulysses
+    # GPipe microbatches per step under pipeline parallelism (0 = one per
+    # stage). The bubble fraction is (M+P-1)/M: at the M=P default every
+    # stage idles ~half the ticks; M = 4P costs 1/4 the bubble in
+    # exchange for microbatches 1/4 the size. The global batch must be
+    # divisible by data_axis * M.
+    pipe_microbatches: int = 0
     # Mixture-of-Experts (model name "vit_moe"): every block's MLP becomes
     # a routed expert bank (ops/moe.py) — moe_top_k=1 Switch routing,
     # 2 GShard — with experts sharded over the ``model`` mesh axis

@@ -227,7 +227,9 @@ def apply_with_aux(params: Params, images: jax.Array, cfg: ModelConfig,
         if cfg.remat:
             # Same memory lever inside each pipeline stage body.
             stage_fn = jax.checkpoint(stage_fn)
-        x = pipeline.pipeline_blocks(x, p["blocks"], stage_fn, mesh)
+        x = pipeline.pipeline_blocks(
+            x, p["blocks"], stage_fn, mesh,
+            num_microbatches=cfg.pipe_microbatches or None)
     else:
         def block_fn(h, bp):
             return _block(h, bp, cfg.vit_heads,

@@ -132,11 +132,21 @@ class Trainer:
             if self._resident_full_eval is not None:
                 fn, total = self._resident_full_eval
                 return int(jax.device_get(fn(state))) / max(total, 1)
-            correct = 0
+            # Accumulate the correct-count ON DEVICE across the sweep and
+            # fetch once: a per-batch int() fetch is a full host<->device
+            # round trip x M batches per eval (~100 ms each on a tunneled
+            # TPU), and under multi-host it serialized every process on
+            # every batch. The adds are async dispatches; the single
+            # device_get at the end is the only drain — O(1) fetches
+            # under any process count.
+            correct = None
             for batch in test_it.full_sweep_padded():
-                m = self.eval_step(state, *self._placed(batch))
-                correct += int(m["correct"])
-            return correct / max(test_it.total_records, 1)
+                c = self.eval_step(state, *self._placed(batch))["correct"]
+                correct = c if correct is None else correct + c
+            if correct is None:
+                return 0.0
+            return int(jax.device_get(correct)) / max(
+                test_it.total_records, 1)
         if self._resident_test_eval is not None:
             idx = jax.device_put(test_it.next_index_chunk(1)[0],
                                  self._idx1_sharding)

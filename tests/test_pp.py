@@ -139,3 +139,24 @@ def test_pp_and_sp_both_raise(rng):
     labels = rng.integers(0, 10, 8).astype(np.int32)
     with pytest.raises(ValueError, match="cannot both"):
         _run(VIT_PP, _mesh(data=2, seq=2, pipe=2), images, labels, nsteps=1)
+
+
+@pytest.mark.slow
+def test_pp_more_microbatches_matches_dp(rng):
+    """M > P (the bubble-amortizing schedule, tools/bench_pp.py): same
+    math as dp, with the microbatch count actually threaded through."""
+    cfg = dataclasses.replace(VIT_PP, pipe_microbatches=8)
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    _, loss_dp = _run(VIT_PP, _mesh(data=8), images, labels)
+    _, loss_pp = _run(cfg, _mesh(data=2, pipe=4), images, labels)
+    np.testing.assert_allclose(loss_dp, loss_pp, rtol=2e-5, atol=2e-6)
+
+
+def test_pp_microbatch_divisibility_error():
+    """Global batch must divide data_axis * M."""
+    cfg = dataclasses.replace(VIT_PP, pipe_microbatches=8)
+    images = np.zeros((8, 24, 24, 3), np.float32)  # 8 % (2*8) != 0
+    labels = np.zeros((8,), np.int32)
+    with pytest.raises(ValueError, match="not divisible"):
+        _run(cfg, _mesh(data=2, pipe=4), images, labels, nsteps=1)

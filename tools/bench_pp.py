@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Pipeline-parallel vs data-parallel benchmark (VERDICT round-1 #7).
+
+Times the full ViT training step at a fixed global batch over several
+mesh layouts on the 8-virtual-device CPU mesh (the only multi-device
+substrate on this box — one real TPU chip cannot host a pipe axis).
+CPU timings are a schedule-overhead proxy, not TPU absolute numbers:
+they expose the GPipe bubble ((M+P-1)/M extra stage-compute) and the
+ppermute/psum traffic, which is what the layout decision rides on.
+
+Usage: python tools/bench_pp.py [--steps 8] [--batch 32] [--depth 8]
+Prints one markdown table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from dml_cnn_cifar10_tpu.utils.platform import force_cpu
+
+force_cpu(virtual_devices=8)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig,  # noqa: E402
+                                        OptimConfig, ParallelConfig)
+from dml_cnn_cifar10_tpu.models.registry import get_model  # noqa: E402
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib  # noqa: E402
+from dml_cnn_cifar10_tpu.parallel import step as step_lib  # noqa: E402
+
+
+def time_layout(name, pcfg, model_cfg, batch, steps):
+    mesh = mesh_lib.build_mesh(pcfg)
+    data_cfg = DataConfig(crop_height=16, crop_width=16)
+    optim_cfg = OptimConfig(learning_rate=0.01)
+    model_def = get_model(model_cfg.name)
+    sh = step_lib.train_state_shardings(mesh, model_def, model_cfg,
+                                        data_cfg, optim_cfg)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, model_cfg, data_cfg, optim_cfg,
+        mesh, state_sharding=sh)
+    train = step_lib.make_train_step(model_def, model_cfg, optim_cfg,
+                                     mesh, state_sharding=sh)
+    rng = np.random.default_rng(0)
+    im = rng.normal(0.5, 0.25, (batch, 16, 16, 3)).astype(np.float32)
+    lb = rng.integers(0, 10, batch).astype(np.int32)
+    im, lb = mesh_lib.shard_batch(mesh, im, lb)
+    state, m = train(state, im, lb)         # compile + warm
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = train(state, im, lb)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    loss = float(jax.device_get(m["loss"]))
+    return name, dt * 1e3, batch / dt, loss
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--depth", type=int, default=8)
+    args = p.parse_args()
+
+    base = dict(name="vit_tiny", pool="mean", logit_relu=False,
+                vit_depth=args.depth, vit_dim=64, vit_heads=2, patch_size=4,
+                use_pallas_attention=False)
+    layouts = [
+        ("dp=8", ParallelConfig(data_axis=8), ModelConfig(**base)),
+        ("dp=4 x pp=2 (M=P)", ParallelConfig(data_axis=4, pipe_axis=2),
+         ModelConfig(**base)),
+        ("dp=4 x pp=2 (M=4P)", ParallelConfig(data_axis=4, pipe_axis=2),
+         ModelConfig(**base, pipe_microbatches=8)),
+        ("dp=2 x pp=4 (M=P)", ParallelConfig(data_axis=2, pipe_axis=4),
+         ModelConfig(**base)),
+        ("dp=2 x pp=4 (M=4P)", ParallelConfig(data_axis=2, pipe_axis=4),
+         ModelConfig(**base, pipe_microbatches=16)),
+    ]
+    rows = [time_layout(n, pc, mc, args.batch, args.steps)
+            for n, pc, mc in layouts]
+    ref = rows[0][1]
+    print(f"\nViT depth={args.depth} dim=64 global batch={args.batch}, "
+          f"{args.steps} timed steps, 8 virtual CPU devices\n")
+    print("| layout | step ms | images/sec | vs dp=8 | final loss |")
+    print("|---|---|---|---|---|")
+    for name, ms, ips, loss in rows:
+        print(f"| {name} | {ms:.1f} | {ips:.0f} | {ref / ms:.2f}x | "
+              f"{loss:.4f} |")
+
+
+if __name__ == "__main__":
+    main()
