@@ -32,9 +32,9 @@ import jax
 
 from flax import serialization
 
-_CKPT_RE = re.compile(r"ckpt_(\d+)\.(msgpack|orbax)$")
+_CKPT_RE = re.compile(r"ckpt_(\d+)\.(msgpack|orbax|sharded)$")
 
-FORMATS = ("msgpack", "orbax")
+FORMATS = ("msgpack", "orbax", "sharded")
 
 
 def _ckpt_path(ckpt_dir: str, step: int, fmt: str = "msgpack") -> str:
@@ -61,7 +61,20 @@ def fetch_to_host(state: Any) -> Any:
 
 def save_checkpoint(ckpt_dir: str, state: Any, step: int,
                     keep: int = 3, fmt: str = "msgpack") -> str:
-    """Fetch (collective-safe) + atomically write ``ckpt_<step>.<fmt>``."""
+    """Fetch (collective-safe) + atomically write ``ckpt_<step>.<fmt>``.
+
+    ``fmt='sharded'`` skips the full-state gather entirely: every
+    process writes only its own shards (O(state/N) bytes, no
+    allgather) — call it from ALL processes (see ckpt/sharded.py).
+    """
+    if fmt == "sharded":
+        from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
+        os.makedirs(ckpt_dir, exist_ok=True)
+        path = _ckpt_path(ckpt_dir, step, fmt)
+        sharded_lib.save_sharded(path, state)
+        if jax.process_index() == 0:
+            _finalize_checkpoint(ckpt_dir, path, keep)
+        return path
     return _write_checkpoint(ckpt_dir, fetch_to_host(state), step, keep,
                              fmt=fmt)
 
@@ -106,6 +119,12 @@ def _write_checkpoint(ckpt_dir: str, host_state: Any, step: int,
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, path)
+    _finalize_checkpoint(ckpt_dir, path, keep)
+    return path
+
+
+def _finalize_checkpoint(ckpt_dir: str, path: str, keep: int) -> None:
+    """Point the ``checkpoint`` index at ``path``; prune to ``keep``."""
     with open(os.path.join(ckpt_dir, "checkpoint"), "w") as f:
         f.write(os.path.basename(path) + "\n")
     for old_step, old_fmt in sorted(_checkpoints(ckpt_dir))[:-keep]:
@@ -122,7 +141,6 @@ def _write_checkpoint(ckpt_dir: str, host_state: Any, step: int,
                 os.remove(sidecar)
         except OSError:
             pass
-    return path
 
 
 def save_data_state(ckpt_dir: str, step: int, counts: dict) -> None:
@@ -152,11 +170,25 @@ def load_data_state(ckpt_dir: str, step: int):
 
 
 def _checkpoints(ckpt_dir: str):
-    """[(step, fmt)] for every checkpoint present, either format."""
+    """[(step, fmt)] for every COMMITTED checkpoint present, any format.
+
+    A ``.sharded`` directory counts only once its ``MANIFEST.json``
+    exists — the manifest is that codec's commit point (tmp+rename is
+    the others'), so a crash mid-save can never make ``latest_checkpoint``
+    select a partial directory over the previous complete checkpoint.
+    """
     if not os.path.isdir(ckpt_dir):
         return []
-    return [(int(m.group(1)), m.group(2)) for name in os.listdir(ckpt_dir)
-            if (m := _CKPT_RE.match(name))]
+    out = []
+    for name in os.listdir(ckpt_dir):
+        m = _CKPT_RE.match(name)
+        if not m:
+            continue
+        if m.group(2) == "sharded" and not os.path.isfile(
+                os.path.join(ckpt_dir, name, "MANIFEST.json")):
+            continue  # uncommitted partial save
+        out.append((int(m.group(1)), m.group(2)))
+    return out
 
 
 def all_checkpoint_steps(ckpt_dir: str):
@@ -179,6 +211,18 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
     path = latest_checkpoint(ckpt_dir)
     if path is None:
         return target
+    if path.endswith(".sharded"):
+        from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
+
+        # No fetch_to_host here: restore_sharded reads only the
+        # TARGET'S TREE STRUCTURE and rebuilds every value from the
+        # shard files — an allgather of the about-to-be-overwritten
+        # values would be exactly the O(full-state) cost this codec
+        # exists to avoid.
+        restored = sharded_lib.restore_sharded(path, target)
+        if sharding is not None:
+            restored = jax.device_put(restored, sharding)
+        return restored
     host_target = fetch_to_host(target)
     try:
         if path.endswith(".orbax"):
@@ -294,6 +338,27 @@ class CheckpointManager:
         if not self.due(step, force):
             return False
         self._last_saved_step = step
+        if self.fmt == "sharded":
+            # Pod-scale path (ckpt/sharded.py): no full-state gather —
+            # every process fetches and writes only its own shards. The
+            # local device→host fetch happens HERE, synchronously (the
+            # next donated step would reuse the buffers); multi-host
+            # saves run fully synchronous (the pre-manifest barrier is a
+            # collective and cannot live on the writer thread).
+            from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
+            os.makedirs(self.ckpt_dir, exist_ok=True)
+            path = _ckpt_path(self.ckpt_dir, step, "sharded")
+            payload = sharded_lib.collect_local_shards(state)
+            if self.async_save and jax.process_count() == 1:
+                self.flush()
+                self._pending = self._pool.submit(
+                    self._finish_sharded, path, payload, state, step,
+                    data_state)
+            else:
+                self._finish_sharded(path, payload, state, step,
+                                     data_state)
+            self._last_time = time.monotonic()
+            return self.is_chief
         # Collective fetch BEFORE the chief check: with tensor-parallel
         # state on a multi-host mesh the gather is a collective, so every
         # process participates; only the chief touches the filesystem.
@@ -313,6 +378,15 @@ class CheckpointManager:
             self._write_with_sidecar(host_state, step, data_state)
         self._last_time = time.monotonic()
         return True
+
+    def _finish_sharded(self, path: str, payload, state: Any, step: int,
+                        data_state: Optional[dict]) -> None:
+        from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
+        sharded_lib.finish_sharded_save(path, payload, state)
+        if self.is_chief:
+            _finalize_checkpoint(self.ckpt_dir, path, self.keep)
+            if data_state is not None:
+                save_data_state(self.ckpt_dir, step, data_state)
 
     def _write_with_sidecar(self, host_state: Any, step: int,
                             data_state: Optional[dict]) -> str:

@@ -195,9 +195,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serialize+write checkpoints on a background "
                         "thread (training overlaps the disk IO)")
     p.add_argument("--ckpt_format", type=str, default="msgpack",
-                   choices=["msgpack", "orbax"],
-                   help="checkpoint codec: single-file flax msgpack or the "
-                        "orbax directory format (restore auto-detects)")
+                   choices=["msgpack", "orbax", "sharded"],
+                   help="checkpoint codec: single-file flax msgpack, the "
+                        "orbax directory format, or per-process sharded "
+                        "files (pod-scale: no full-state gather, each "
+                        "process writes only its own shards; restore "
+                        "auto-detects and is elastic across meshes)")
     p.add_argument("--check_numerics", type="bool", default=False,
                    help="halt at the next metrics boundary on non-finite "
                         "loss without checkpointing the poisoned state "

@@ -254,11 +254,14 @@ class TrainConfig:
     # agree on it at the preemption-sync boundary (train/loop.py).
     checkpoint_every_secs: Optional[float] = None
     keep_checkpoints: int = 3
-    # Checkpoint codec: "msgpack" (single flax file) or "orbax" (the
+    # Checkpoint codec: "msgpack" (single flax file), "orbax" (the
     # JAX-ecosystem standard directory format — interoperable with
-    # external orbax tooling). Restore auto-detects per checkpoint.
-    # orbax is single-process only: its save is itself a collective,
-    # which the chief-only writer would deadlock (ckpt/checkpoint.py).
+    # external orbax tooling), or "sharded" (per-process shard files,
+    # the pod-scale path: no full-state gather, each process writes
+    # O(state/N) bytes — ckpt/sharded.py). Restore auto-detects per
+    # checkpoint. orbax is single-process only: its save is itself a
+    # collective, which the chief-only writer would deadlock
+    # (ckpt/checkpoint.py).
     ckpt_format: str = "msgpack"
     # Overlap checkpoint serialize+write with training on a background
     # writer thread (the device->host fetch stays synchronous — donated

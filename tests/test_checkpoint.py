@@ -241,3 +241,130 @@ def test_mismatched_config_restore_error(tmp_path, data_cfg):
     cfg2.model.name = "resnet18"
     with pytest.raises(ValueError, match="different config"):
         Trainer(cfg2).init_or_restore()
+
+
+def test_sharded_roundtrip_fsdp(tmp_path, rng):
+    """Sharded codec on the 8-device fsdp mesh: the single process owns
+    every shard, the file set is shard_0 + MANIFEST, and restore
+    reassembles bit-identical global arrays that keep training."""
+    from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig,
+                                            OptimConfig, ParallelConfig)
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+    data = DataConfig(normalize="scale")
+    cfg = ModelConfig(logit_relu=False)
+    optim = OptimConfig(momentum=0.9)
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    model_def = get_model("cnn")
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, data, optim,
+                                        fsdp=True)
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, cfg, data, optim, mesh,
+        state_sharding=sh)
+    train = step_lib.make_train_step(model_def, cfg, optim, mesh,
+                                     state_sharding=sh)
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    state, _ = train(state, im, lb)
+
+    path = ckpt_lib.save_checkpoint(str(tmp_path), state, step=1,
+                                fmt="sharded")
+    assert sorted(os.listdir(path)) == ["MANIFEST.json", "shard_0.msgpack"]
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)) == path
+
+    fresh = step_lib.init_train_state(
+        jax.random.key(7), model_def, cfg, data, optim, mesh,
+        state_sharding=sh)
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), fresh, sharding=sh)
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(a)),
+                                      np.asarray(jax.device_get(b)))
+    restored, metrics = train(restored, im, lb)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
+def test_sharded_elastic_restore_to_plain_mesh(tmp_path, rng):
+    """Sharded checkpoints are placement-free: written from an fsdp
+    layout, restored onto a REPLICATED mesh (different sharding) with
+    identical values — the elastic contract."""
+    from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig,
+                                            OptimConfig, ParallelConfig)
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+    data = DataConfig(normalize="scale")
+    cfg = ModelConfig(logit_relu=False)
+    optim = OptimConfig()
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    model_def = get_model("cnn")
+    fsdp_sh = step_lib.train_state_shardings(mesh, model_def, cfg, data,
+                                             optim, fsdp=True)
+    state = step_lib.init_train_state(
+        jax.random.key(3), model_def, cfg, data, optim, mesh,
+        state_sharding=fsdp_sh)
+    ckpt_lib.save_checkpoint(str(tmp_path), state, step=2, fmt="sharded")
+
+    repl = mesh_lib.replicated(mesh)
+    fresh = step_lib.init_train_state(
+        jax.random.key(9), model_def, cfg, data, optim, mesh)
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), fresh, sharding=repl)
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(a)),
+                                      np.asarray(jax.device_get(b)))
+
+
+def test_sharded_manager_cadence_and_retention(tmp_path, rng):
+    """CheckpointManager with fmt='sharded': due-cadence respected,
+    sidecar written after the manifest commit, retention prunes whole
+    .sharded directories."""
+    from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig,
+                                            OptimConfig, ParallelConfig)
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+    data = DataConfig(normalize="scale")
+    cfg = ModelConfig(logit_relu=False)
+    optim = OptimConfig()
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    model_def = get_model("cnn")
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, cfg, data, optim, mesh)
+
+    mgr = ckpt_lib.CheckpointManager(str(tmp_path), every_steps=2, keep=2,
+                                 fmt="sharded")
+    for step in (1, 2, 3, 4, 6):
+        saved = mgr.maybe_save(state, step,
+                               data_state={"train": step, "acc": 0,
+                                           "test": 0})
+        assert saved == (step % 2 == 0)
+    steps = sorted(ckpt_lib.all_checkpoint_steps(str(tmp_path)))
+    assert steps == [4, 6]  # keep=2 pruned the step-2 dir
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           "ckpt_2.sharded"))
+    assert ckpt_lib.load_data_state(str(tmp_path), 6) == {"train": 6,
+                                                      "acc": 0, "test": 0}
+
+
+def test_sharded_partial_save_is_invisible(tmp_path):
+    """Crash-consistency: a ckpt_<step>.sharded dir WITHOUT its
+    MANIFEST.json (SIGKILL mid-save) must be invisible to
+    latest_checkpoint/restore — the previous committed checkpoint wins."""
+    state = _state()
+    ckpt_lib.save_checkpoint(str(tmp_path), state, step=5)  # msgpack, committed
+    partial = os.path.join(str(tmp_path), "ckpt_9.sharded")
+    os.makedirs(partial)
+    with open(os.path.join(partial, "shard_0.msgpack"), "wb") as f:
+        f.write(b"not a complete save")
+    assert ckpt_lib.all_checkpoint_steps(str(tmp_path)) == [5]
+    assert ckpt_lib.latest_checkpoint(str(tmp_path)).endswith(
+        "ckpt_5.msgpack")
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), _state(seed=3))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(restored.params["conv1"]["kernel"])),
+        np.asarray(jax.device_get(state.params["conv1"]["kernel"])))
