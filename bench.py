@@ -162,8 +162,21 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60) -> dict:
 
 
 def main() -> None:
-    rows = {dt: measure(dt) for dt in ("float32", "bfloat16")}
-    headline = max(rows.values(),
+    rows = {
+        # Headline pair: K=100 — the largest dispatch that still lands
+        # on the reference's 200/500 observable-boundary cadence, i.e.
+        # what the Trainer actually runs with full parity.
+        "fp32": measure("float32", chunk_k=100),
+        "bf16": measure("bfloat16", chunk_k=100),
+        # Plateau: K=320 amortizes dispatch overhead past the cadence
+        # constraint (measured sweep plateau) — the ceiling when
+        # observable-boundary parity is relaxed.
+        "fp32_k320": measure("float32", chunk_k=320, chunks=20),
+    }
+    # Headline = best PARITY config (K=100): the plateau row is reported
+    # as data but may not claim the headline — it relaxes the
+    # observable-boundary cadence the Trainer actually honors.
+    headline = max((rows["fp32"], rows["bf16"]),
                    key=lambda r: r["images_per_sec_per_chip"])
     per_chip = headline["images_per_sec_per_chip"]
     print(json.dumps({
@@ -172,8 +185,7 @@ def main() -> None:
         "unit": "images/sec/chip",
         "vs_baseline": round(
             per_chip / NORTH_STAR_IMAGES_PER_SEC_PER_CHIP, 3),
-        "fp32": rows["float32"],
-        "bf16": rows["bfloat16"],
+        **rows,
     }))
 
 

@@ -197,6 +197,18 @@ def restore_sharded(ckpt_path: str, target: Any) -> Any:
                 f"covered by shard files in {ckpt_path}")
         return full
 
-    rebuilt = {path: build(path) for path, _ in _leaf_paths(target)}
+    target_paths = {path for path, _ in _leaf_paths(target)}
+    extra = sorted(set(meta["leaves"]) - target_paths)
+    if extra:
+        # Mirror the msgpack path's config-mismatch contract: a
+        # checkpoint carrying leaves the target lacks (written with
+        # --ema_decay/--momentum/... the resume run dropped) must fail
+        # loudly, not silently resume half-matched.
+        raise ValueError(
+            f"sharded checkpoint {ckpt_path} carries leaves the current "
+            f"config does not: {extra[:5]}{'...' if len(extra) > 5 else ''}"
+            f" — it was written with a different --model/--optimizer/"
+            f"--ema_decay/--async_staleness configuration")
+    rebuilt = {path: build(path) for path in sorted(target_paths)}
     return jax.tree_util.tree_map_with_path(
         lambda kp, leaf: rebuilt[_key_str(kp)], target)

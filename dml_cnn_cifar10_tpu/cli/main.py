@@ -118,8 +118,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--vit_depth", type=int, default=None,
                    help="ViT blocks (default 12)")
     p.add_argument("--remat", type="bool", default=False,
-                   help="recompute transformer-block activations in the "
-                        "backward pass (activation memory O(1) in depth)")
+                   help="recompute block activations in the backward pass "
+                        "(ViT transformer blocks / ResNet residual "
+                        "blocks; activation memory O(1) in depth)")
     p.add_argument("--pipe_axis", type=int, default=1,
                    help="pipeline-parallel mesh degree (GPipe stages)")
     p.add_argument("--pipe_microbatches", type=int, default=0,

@@ -118,11 +118,11 @@ class ModelConfig:
     # where the token count must divide the ``seq`` mesh axis and a lone
     # cls token would break the even sharding.
     pool: str = "cls"                     # cls | mean
-    # Rematerialization: recompute each transformer block's activations in
-    # the backward pass instead of storing them (jax.checkpoint around the
-    # scanned block). Trades ~1 extra forward of FLOPs for activation
-    # memory that stays O(1) in depth — the standard long-context /
-    # deep-stack memory lever on TPU.
+    # Rematerialization: recompute each block's activations in the
+    # backward pass instead of storing them (jax.checkpoint around the
+    # ViT transformer block / ResNet residual block). Trades ~1 extra
+    # forward of FLOPs for activation memory that stays O(1) in depth —
+    # the standard long-context / deep-stack memory lever on TPU.
     remat: bool = False
     # Sequence-parallel attention strategy when the mesh's ``seq`` axis >1:
     # "ring" walks K/V shards around the ring (no head-count constraint,

@@ -184,6 +184,18 @@ def apply(params: Params, state: State, images: jax.Array, cfg: ModelConfig,
     imagenet_stem = p["stem"]["conv"].shape[0] == 7
     block = (_bottleneck_block if "bn3" in p["stage1"][0]
              else _basic_block)
+    if cfg.remat:
+        # Recompute each residual block's activations in the backward
+        # pass — the same O(1)-in-depth activation-memory lever the ViT
+        # stack has (models/vit.py), decisive at ImageNet geometry.
+        # Statics ride in a closure: ModelConfig is unhashable, so
+        # jax.checkpoint static_argnums is not an option.
+        inner = block
+
+        def block(x, bp, s, stride, cfg, train, axis_name):
+            return jax.checkpoint(
+                lambda xx, pp, ss: inner(xx, pp, ss, stride, cfg, train,
+                                         axis_name))(x, bp, s)
 
     # Mirror init_state's structure exactly: a treedef change between step 1
     # and step 2 would silently retrigger compilation.

@@ -352,18 +352,42 @@ def _chunk_body(loss_fn, optim_cfg: OptimConfig,
         from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
 
     augmented = data_cfg is not None and data_cfg.augmented
+    # Whole-chunk decode materializes [K, B, crop, crop, C] float32. At
+    # CIFAR geometry that is ~90 MB and the single vectorized op wins; at
+    # ImageNet geometry (224², K=100, B=256) it is ~15 GB — past HBM. Past
+    # this threshold the decode moves INSIDE the scan: fp32 exists one
+    # step at a time, only the uint8 chunk stays whole.
+    DECODE_IN_SCAN_BYTES = 1 << 30
+
+    def decode(imgs, step):
+        # One source of truth for both size regimes: per-(seed, step) key
+        # so draws are distinct and deterministic wherever decode runs.
+        if augmented:
+            key = jax.random.fold_in(jax.random.key(data_cfg.seed), step)
+            return device_preprocess(imgs, data_cfg, key)
+        return device_preprocess(imgs, data_cfg)
 
     def run(state: TrainState, images, labels):
+        decode_in_scan = False
         if data_cfg is not None:
-            if augmented:
-                key = jax.random.fold_in(jax.random.key(data_cfg.seed),
-                                         state.step)
-                images = device_preprocess(images, data_cfg, key)
-            else:
-                images = device_preprocess(images, data_cfg)
+            # Peak decode allocation is the float32 view at the LARGER of
+            # the source and crop geometry: device_preprocess casts the
+            # full-size [K,B,H,W,C] to fp32 before cropping (and the
+            # random-crop einsum materializes that operand), while a
+            # crop-larger-than-source config pads up instead.
+            k, b, h, w = images.shape[:4]
+            ph = max(h, data_cfg.crop_height)
+            pw = max(w, data_cfg.crop_width)
+            decoded = k * b * ph * pw * data_cfg.num_channels * 4
+            decode_in_scan = decoded > DECODE_IN_SCAN_BYTES
+            if not decode_in_scan:
+                images = decode(images, state.step)
 
         def body(st, batch):
-            return one_step(st, batch[0], batch[1])
+            imgs, lbs = batch
+            if decode_in_scan:
+                imgs = decode(imgs, st.step)
+            return one_step(st, imgs, lbs)
 
         state, ms = lax.scan(body, state, (images, labels))
         return state, jax.tree.map(lambda x: x[-1], ms)

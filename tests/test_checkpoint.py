@@ -368,3 +368,16 @@ def test_sharded_partial_save_is_invisible(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(jax.device_get(restored.params["conv1"]["kernel"])),
         np.asarray(jax.device_get(state.params["conv1"]["kernel"])))
+
+
+def test_sharded_config_mismatch_raises(tmp_path):
+    """A sharded checkpoint carrying leaves the resume config lacks
+    (momentum buffers here) must fail loudly — the same contract the
+    msgpack path enforces via from_bytes."""
+    mom_state = step_lib.init_train_state(
+        jax.random.key(0), get_model("cnn"), ModelConfig(), DataConfig(),
+        OptimConfig(momentum=0.9))
+    ckpt_lib.save_checkpoint(str(tmp_path), mom_state, step=1,
+                             fmt="sharded")
+    with pytest.raises(ValueError, match="different"):
+        ckpt_lib.restore_checkpoint(str(tmp_path), _state())

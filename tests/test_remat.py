@@ -86,3 +86,29 @@ def test_remat_composes_with_pp(rng):
                                       state_sharding=sh)
     st0, m0 = train0(state0, *mesh_lib.shard_batch(mesh, images, labels))
     assert float(m0["loss"]) == float(m["loss"])
+
+
+@pytest.mark.slow
+def test_remat_resnet_same_training_math(rng):
+    """--remat on the ResNet family (per-residual-block jax.checkpoint):
+    bitwise-identical step to the plain path, BN state included."""
+    images = rng.normal(0.5, 0.25, (8, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 8).astype(np.int32)
+    mesh = mesh_lib.build_mesh(ParallelConfig())
+    model_def = get_model("resnet18")
+    optim = OptimConfig(learning_rate=0.01)
+    base = ModelConfig(name="resnet18", logit_relu=False)
+
+    def run(cfg):
+        state = step_lib.init_train_state(
+            jax.random.key(0), model_def, cfg, DATA, optim, mesh)
+        train = step_lib.make_train_step(model_def, cfg, optim, mesh)
+        im, lb = mesh_lib.shard_batch(mesh, images, labels)
+        st, m = train(state, im, lb)
+        return jax.device_get((st.params, st.model_state)), float(m["loss"])
+
+    s_plain, l_plain = run(base)
+    s_remat, l_remat = run(dataclasses.replace(base, remat=True))
+    assert l_plain == l_remat
+    for a, b in zip(jax.tree.leaves(s_plain), jax.tree.leaves(s_remat)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
