@@ -134,10 +134,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe_top_k", type=int, default=1,
                    help="experts per token: 1 = Switch, 2 = GShard")
     p.add_argument("--resident_data", type="bool", default=True,
-                   help="with --steps_per_dispatch >1 on one process, keep "
-                        "the uint8 dataset in HBM and gather on device "
-                        "(needs --use_native_loader false: the C++ pool's "
-                        "bounded-shuffle stream has no index view)")
+                   help="with --steps_per_dispatch >1, keep the uint8 "
+                        "dataset in HBM and gather on device; multi-host "
+                        "replicates the full split per process and ships "
+                        "only index slices. The trainer auto-switches to "
+                        "the NumPy pipeline for this path (the C++ "
+                        "pool's bounded-shuffle stream has no index view)")
     p.add_argument("--use_native_loader", type="bool", default=True,
                    help="stream batches from the C++ bounded shuffle pool "
                         "(reference RandomShuffleQueue parity); false uses "

@@ -274,12 +274,14 @@ class TrainConfig:
     # the MXU fed. output/eval/checkpoint cadences and total_steps must be
     # multiples of K so every observable boundary falls on a dispatch edge.
     steps_per_dispatch: int = 1
-    # With steps_per_dispatch > 1 on a single process, keep the whole
-    # uint8 dataset resident in HBM and ship only shuffled index arrays
-    # (~10 KB/chunk) — the device does the gather+decode (measured ~16x
-    # over the host-fed chunk path on the reference CNN). Falls back to
-    # host-fed raw chunks on multi-host runs (per-process data shards
-    # can't form a replicated global array), when the dataset exceeds
+    # With steps_per_dispatch > 1, keep the whole uint8 dataset resident
+    # in HBM and ship only shuffled index arrays (~10 KB/chunk) — the
+    # device does the gather+decode (measured ~16x over the host-fed
+    # chunk path on the reference CNN). Multi-host runs replicate the
+    # FULL split into every process's HBM and each process contributes
+    # its slice of the global index array (local shard rows translate to
+    # full-split rows; bit-identical to the host-fed path by test).
+    # Falls back to host-fed raw chunks when the full split exceeds
     # resident_data_max_bytes, or under the native loader (its
     # bounded-shuffle stream has no index view).
     resident_data: bool = True

@@ -75,6 +75,16 @@ def batch_sharding(mesh: Mesh, ndim: int = 4,
     return NamedSharding(mesh, P(*spec))
 
 
+def place_local(sharding: NamedSharding, arr):
+    """Place one host array under ``sharding``: plain ``device_put``
+    single-process; per-process local-data assembly multi-host (each
+    process passes its slice of the sharded dims — for a replicated
+    sharding, the identical full array)."""
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_process_local_data(sharding, arr)
+
+
 def shard_batch(mesh: Mesh, images, labels, leading_dims: int = 0,
                 spatial: bool = False):
     """Place a host batch on the mesh, batch dim sharded over ``data``.
@@ -87,9 +97,4 @@ def shard_batch(mesh: Mesh, images, labels, leading_dims: int = 0,
     """
     img_s = batch_sharding(mesh, images.ndim, leading_dims, spatial=spatial)
     lab_s = batch_sharding(mesh, labels.ndim, leading_dims)
-    if jax.process_count() == 1:
-        return (jax.device_put(images, img_s), jax.device_put(labels, lab_s))
-    return (
-        jax.make_array_from_process_local_data(img_s, images),
-        jax.make_array_from_process_local_data(lab_s, labels),
-    )
+    return place_local(img_s, images), place_local(lab_s, labels)

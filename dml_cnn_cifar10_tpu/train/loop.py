@@ -103,6 +103,7 @@ class Trainer:
         self._resident_test_eval = None
         self._resident_acc_eval = None
         self._idx1_sharding = None
+        self._resident_idx = None
 
     def init_or_restore(self) -> step_lib.TrainState:
         key = jax.random.key(self.cfg.seed)
@@ -148,8 +149,7 @@ class Trainer:
             return int(jax.device_get(correct)) / max(
                 test_it.total_records, 1)
         if self._resident_test_eval is not None:
-            idx = jax.device_put(test_it.next_index_chunk(1)[0],
-                                 self._idx1_sharding)
+            idx = self._resident_idx(test_it.next_index_chunk(1)[0])
             return float(jax.device_get(self._resident_test_eval(state,
                                                                  idx)))
         m = self.eval_step(state, *self._placed(next(test_it)))
@@ -181,9 +181,10 @@ class Trainer:
         self._resident_full_eval = None
         self._resident_test_eval = None
         self._resident_acc_eval = None
+        self._resident_idx = None
         train_data_cfg = cfg.data
         if (self.steps_per_dispatch > 1 and cfg.resident_data
-                and num_shards == 1 and cfg.data.use_native_loader):
+                and cfg.data.use_native_loader):
             # The HBM-resident path needs the index view only the
             # in-memory permutation iterator provides; the native C++
             # stream would silently force the ~90x-slower host-fed chunk
@@ -193,8 +194,18 @@ class Trainer:
         train_it = pipe.input_pipeline(
             train_data_cfg, per_process_batch, train=True,
             seed=cfg.seed + shard, shard=shard, num_shards=num_shards)
+        # Full-split byte size, computed PROCESS-UNIFORMLY: per-shard
+        # nbytes differ when records don't divide evenly, and any
+        # size-gated decision below must come out identical on every
+        # process or the SPMD programs diverge and the job deadlocks.
+        def full_split_bytes(it):
+            per_record = int(np.prod(it.images.shape[1:])) \
+                * it.images.dtype.itemsize
+            return it.total_records * per_record
+
         if (train_data_cfg is not cfg.data
-                and train_it.images.nbytes > cfg.resident_data_max_bytes):
+                and full_split_bytes(train_it)
+                > cfg.resident_data_max_bytes):
             # Dataset turned out to exceed the HBM-resident cap: losing
             # the native loader AND the resident path would be strictly
             # worse than doing nothing, so rebuild the native stream.
@@ -209,9 +220,13 @@ class Trainer:
         # stream over the same decoded arrays (no second decode).
         acc_it = train_it.clone(seed=cfg.seed + 7 + shard)
         k = self.steps_per_dispatch
-        resident = (k > 1 and cfg.resident_data and num_shards == 1
+        # The resident cap is judged on the FULL split — multi-host
+        # replicates the whole dataset into every process's HBM (the
+        # host ships only per-process index slices).
+        resident = (k > 1 and cfg.resident_data
                     and getattr(train_it, "supports_index_stream", False)
-                    and train_it.images.nbytes <= cfg.resident_data_max_bytes)
+                    and full_split_bytes(train_it)
+                    <= cfg.resident_data_max_bytes)
         # Exact-resume data order: fast-forward the fresh streams to the
         # cumulative consumption recorded at the checkpoint being
         # resumed, so interrupted+resumed training is bit-identical to
@@ -242,10 +257,26 @@ class Trainer:
             # HBM-resident data path: dataset lives on device, the host
             # ships only shuffled index arrays; gather+decode+K steps are
             # one dispatch (parallel/step.py:make_train_chunk_resident).
+            # Multi-host: the FULL split replicates into every process's
+            # HBM, each process keeps its disjoint strided index stream
+            # (pipeline.py shards records as [shard::num_shards], so
+            # local row i is full-split row shard + i*num_shards) and
+            # contributes its slice of the global [K, B] index array —
+            # the same ~16x win over host-fed chunks as single-host.
             repl = mesh_lib.replicated(self.mesh)
-            ds_images = jax.device_put(train_it.images, repl)
-            ds_labels = jax.device_put(train_it.labels.astype(np.int32),
-                                       repl)
+            host_imgs, host_lbls = _full_split_arrays(
+                train_it, lambda: pipe.input_pipeline(
+                    train_data_cfg, per_process_batch, train=True,
+                    seed=cfg.seed))
+            ds_images = mesh_lib.place_local(repl, host_imgs)
+            ds_labels = mesh_lib.place_local(repl,
+                                             host_lbls.astype(np.int32))
+
+            def to_global(idx):
+                if num_shards > 1:
+                    return (shard + idx * num_shards).astype(np.int32)
+                return idx
+
             chunk_fn = step_lib.make_train_chunk_resident(
                 self.model_def, cfg.model, cfg.optim, self.mesh,
                 ds_images, ds_labels,
@@ -258,26 +289,36 @@ class Trainer:
             # fetches (decisive when the device link is a ~100 ms-RTT
             # tunnel).
             self._idx1_sharding = mesh_lib.batch_sharding(self.mesh, 1)
+            self._resident_idx = lambda a: mesh_lib.place_local(
+                self._idx1_sharding, to_global(a))
             self._resident_acc_eval = step_lib.make_batch_eval_resident(
                 self.model_def, cfg.model, self.mesh, ds_images, ds_labels,
                 cfg.data, state_sharding=self.state_sharding)
             if cfg.eval_full_test_set:
-                self._resident_full_eval = step_lib.make_eval_resident(
-                    self.model_def, cfg.model, self.mesh, test_it.images,
-                    test_it.labels, cfg.data,
-                    state_sharding=self.state_sharding,
-                    batch_size=per_process_batch)
+                if num_shards == 1:
+                    self._resident_full_eval = step_lib.make_eval_resident(
+                        self.model_def, cfg.model, self.mesh,
+                        test_it.images, test_it.labels, cfg.data,
+                        state_sharding=self.state_sharding,
+                        batch_size=per_process_batch)
+                # Multi-host full sweeps stay host-fed: they are already
+                # O(1) fetches, and the padded per-shard geometry does
+                # not map onto one replicated split cleanly.
             else:
-                t_images = jax.device_put(test_it.images, repl)
-                t_labels = jax.device_put(test_it.labels.astype(np.int32),
-                                          repl)
+                t_imgs, t_lbls = _full_split_arrays(
+                    test_it, lambda: pipe.input_pipeline(
+                        train_data_cfg, per_process_batch, train=False,
+                        seed=cfg.seed))
+                t_images = mesh_lib.place_local(repl, t_imgs)
+                t_labels = mesh_lib.place_local(repl,
+                                                t_lbls.astype(np.int32))
                 self._resident_test_eval = step_lib.make_batch_eval_resident(
                     self.model_def, cfg.model, self.mesh, t_images,
                     t_labels, cfg.data, state_sharding=self.state_sharding)
 
             def produce():
-                return (jax.device_put(train_it.next_index_chunk(k),
-                                       idx_sh),)
+                local = train_it.next_index_chunk(k)
+                return (mesh_lib.place_local(idx_sh, to_global(local)),)
 
             prefetch = pipe.PrefetchIterator(
                 iter(produce, None), depth=cfg.data.prefetch, place=None)
@@ -415,8 +456,8 @@ class Trainer:
                         # Fresh-batch train accuracy (cifar10cnn.py:235), then
                         # ONE fused device->host fetch for loss+accuracy.
                         if self._resident_acc_eval is not None:
-                            aidx = jax.device_put(acc_it.next_index_chunk(1)[0],
-                                                  self._idx1_sharding)
+                            aidx = self._resident_idx(
+                                acc_it.next_index_chunk(1)[0])
                             acc_arr = self._resident_acc_eval(state, aidx)
                         else:
                             acc_arr = self.eval_step(
@@ -544,6 +585,26 @@ class Trainer:
         self._resident_acc_eval = None
         return TrainResult(global_step, train_loss, test_accuracy,
                            timer.images_per_sec, state, preempted=stop)
+
+
+def _full_split_arrays(it, reload_fn):
+    """``(images, labels)`` of the FULL split backing a possibly-sharded
+    iterator. A sharded iterator holds strided views
+    (``pipeline.py``: ``arr[shard::num_shards]``) whose ``.base`` IS the
+    full decoded split in original order — reuse it instead of decoding
+    the files a second time (and pinning a second full-split copy in
+    host RAM); fall back to a fresh unsharded load if the view structure
+    ever stops matching."""
+    if it.num_shards == 1:
+        return it.images, it.labels
+    base_i, base_l = it.images.base, it.labels.base
+    n = it.total_records
+    if (isinstance(base_i, np.ndarray) and isinstance(base_l, np.ndarray)
+            and base_i.shape == (n, *it.images.shape[1:])
+            and base_l.shape[:1] == (n,)):
+        return base_i, base_l
+    full = reload_fn()
+    return full.images, full.labels
 
 
 def _current_lr(cfg: TrainConfig, step: int) -> float:

@@ -34,6 +34,7 @@ from dml_cnn_cifar10_tpu.train.loop import Trainer
 
 total_steps = int(sys.argv[8]) if len(sys.argv) > 8 else 8
 ckpt_format = sys.argv[9] if len(sys.argv) > 9 else "msgpack"
+resident = bool(int(sys.argv[10])) if len(sys.argv) > 10 else True
 hosts = [f"localhost:{port}"] * n_procs  # coordinator = hosts[0]
 multihost.initialize_from_hosts(hosts, task_index)
 assert jax.process_count() == n_procs
@@ -50,6 +51,7 @@ cfg.model.logit_relu = False
 cfg.optim.learning_rate = 0.05
 cfg.parallel.fsdp = fsdp
 cfg.ckpt_format = ckpt_format
+cfg.resident_data = resident
 
 trainer = Trainer(cfg, task_index=task_index)
 res = trainer.fit()
@@ -127,7 +129,7 @@ def test_two_process_exact_resume(tmp_path, data_cfg):
 
 def _run_two_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False,
                      total_steps=8, final_step=8,
-                     ckpt_format="msgpack"):
+                     ckpt_format="msgpack", resident=True):
     n = 2
     port = _free_port()
     data_dir = str(tmp_path / "data")
@@ -149,7 +151,8 @@ def _run_two_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False,
         subprocess.Popen(
             [sys.executable, str(script), str(i), str(n), str(port),
              data_dir, log_dir, str(steps_per_dispatch),
-             str(int(fsdp)), str(total_steps), ckpt_format],
+             str(int(fsdp)), str(total_steps), ckpt_format,
+             str(int(resident))],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=REPO)
         for i in range(n)
@@ -206,3 +209,19 @@ def test_two_process_sharded_checkpoint_and_resume(tmp_path, data_cfg):
                                total_steps=16, final_step=16)
     import math
     assert math.isfinite(resumed[0]["loss"])
+
+
+@pytest.mark.slow
+def test_two_process_resident_matches_hostfed(tmp_path, data_cfg):
+    """Multi-host HBM-resident data: each process replicates the full
+    split into device memory and ships only its slice of the global
+    index array (local shard rows translated to full-split rows). The
+    run must produce EXACTLY the host-fed chunked path's losses — same
+    records, same device-side decode — while never gathering images on
+    the host."""
+    hostfed = _run_two_process(tmp_path / "h", data_cfg,
+                               steps_per_dispatch=4, resident=False)
+    res = _run_two_process(tmp_path / "r", data_cfg,
+                           steps_per_dispatch=4, resident=True)
+    assert res[0]["losses"] == hostfed[0]["losses"]
+    assert res[0]["test_accuracy"] == hostfed[0]["test_accuracy"]
