@@ -98,6 +98,14 @@ def _flash_call(q, k, v, scale, block_q, block_k, interpret,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, s, h, d = q.shape
+    # Auto block size (None): measured on a v5e (BASELINE.md round 2),
+    # 512x512 blocks are 1.6-4.3x faster than 128x128 from S=2048 up
+    # (5.0 vs 8.0 ms at S=2048; 65 vs 281 ms at S=16384) while 128 wins
+    # slightly below (4.2 vs 4.5 ms at S=512) — fewer grid steps amortize
+    # the per-block softmax/rescale overhead once the sequence is long.
+    auto_block = 512 if s >= 2048 else 128
+    block_q = auto_block if block_q is None else block_q
+    block_k = auto_block if block_k is None else block_k
     bq, bk = min(block_q, s), min(block_k, s)
 
     import math
@@ -155,8 +163,9 @@ def _flash_call(q, k, v, scale, block_q, block_k, interpret,
                    static_argnames=("scale", "block_q", "block_k",
                                     "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    scale: float | None = None, block_q: int = 128,
-                    block_k: int = 128,
+                    scale: float | None = None,
+                    block_q: int | None = None,
+                    block_k: int | None = None,
                     interpret: bool | None = None) -> jax.Array:
     """FlashAttention over [B, S, H, D] tensors → [B, S, H, D].
 
@@ -172,8 +181,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    static_argnames=("scale", "block_q", "block_k",
                                     "interpret"))
 def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
-                          scale: float | None = None, block_q: int = 128,
-                          block_k: int = 128,
+                          scale: float | None = None,
+                          block_q: int | None = None,
+                          block_k: int | None = None,
                           interpret: bool | None = None):
     """FlashAttention's raw partial-softmax state:
     ``(acc [B,S,H,D] f32 UNNORMALIZED accumulator, m [B,S,H] f32 row max,
