@@ -161,10 +161,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute_dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--optimizer", type=str, default="sgd",
-                   choices=["sgd", "adamw", "lars", "lamb"],
+                   choices=["sgd", "adamw", "lars", "lamb", "adafactor"],
                    help="sgd = reference; adamw for the transformer "
                         "ladder; lars/lamb add the per-layer trust ratio "
-                        "for large-global-batch scaling")
+                        "for large-global-batch scaling; adafactor keeps "
+                        "factored O(n+m) second moments (the memory "
+                        "choice for large models)")
     p.add_argument("--momentum", type=float, default=0.0,
                    help="SGD momentum (reference uses plain SGD)")
     p.add_argument("--weight_decay", type=float, default=0.0)

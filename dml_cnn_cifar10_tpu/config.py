@@ -173,8 +173,11 @@ class OptimConfig:
     # "adamw" (decoupled weight decay, bias-corrected moments) is the
     # transformer-ladder standard; "lars"/"lamb" add the per-layer trust
     # ratio that makes LARGE global batches trainable — the natural
-    # companion of wide ``data``-axis scaling (You et al. 2017/2019).
-    optimizer: str = "sgd"                # sgd | adamw | lars | lamb
+    # companion of wide ``data``-axis scaling (You et al. 2017/2019);
+    # "adafactor" (Shazeer & Stern 2018) factors the second moment into
+    # row/col statistics — O(n+m) optimizer state per matrix instead of
+    # Adam's O(n*m), the TPU-era memory choice for large models.
+    optimizer: str = "sgd"        # sgd | adamw | lars | lamb | adafactor
     # LARS trust coefficient (eta in the paper) and norm-guard epsilon.
     lars_trust_coef: float = 0.001
     lars_eps: float = 1e-9

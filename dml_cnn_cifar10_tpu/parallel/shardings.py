@@ -191,6 +191,11 @@ def state_pspecs(model_name: str, state: Any, pipe: bool = False,
     # "stale" (the async-staleness ring) carries a leading [S] axis; the
     # rules index from the trailing dims, so the same per-param specs
     # apply — the extra leading dim just stays unsharded.
+    # Adafactor's stats ("vr"/"vc"/"v") fall to the replicated default
+    # DELIBERATELY: vr/vc are O(n+m) per matrix (sub-linear — sharding
+    # them buys no meaningful memory and their reduced ranks don't fit
+    # the per-param trailing-dim rules), and "v" holds full accumulators
+    # only for 1-D leaves (biases/BN — already tiny).
     opt = {k: (param_pspecs(model_name, v, pipe=pipe, fsdp_data=fsdp_data)
                if k in ("momentum", "mu", "nu", "ema", "stale")
                else jax.tree.map(lambda _: P(), v))

@@ -77,6 +77,28 @@ def sgd_init(params: Any, cfg: OptimConfig) -> OptState:
         # LARS always carries momentum (paper default 0.9; our
         # cfg.momentum=0 means "use the conventional 0.9").
         state["momentum"] = jax.tree.map(jnp.zeros_like, params)
+    elif cfg.optimizer == "adafactor":
+        if cfg.momentum:
+            raise ValueError(
+                "adafactor's memory-saving mode carries no first moment "
+                "(Shazeer & Stern 2018 §9) — drop --momentum")
+        # Factored second moments: matrices (ndim>=2) keep only row/col
+        # statistics over the trailing two dims — O(n+m) state instead
+        # of Adam's O(n*m) — vectors keep the full accumulator. Three
+        # parallel full-structure trees (size-0-cost () placeholders on
+        # the branch a leaf doesn't use) so every optimizer family
+        # checkpoints through the same pytree machinery. Under --fsdp
+        # these stats stay replicated by design (shardings.state_pspecs:
+        # they are sub-linear in the first place).
+        state["vr"] = jax.tree.map(
+            lambda p: jnp.zeros(p.shape[:-1], jnp.float32)
+            if p.ndim >= 2 else jnp.zeros((), jnp.float32), params)
+        state["vc"] = jax.tree.map(
+            lambda p: jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32)
+            if p.ndim >= 2 else jnp.zeros((), jnp.float32), params)
+        state["v"] = jax.tree.map(
+            lambda p: jnp.zeros((), jnp.float32)
+            if p.ndim >= 2 else jnp.zeros(p.shape, jnp.float32), params)
     elif cfg.optimizer == "sgd":
         if cfg.momentum:
             state["momentum"] = jax.tree.map(jnp.zeros_like, params)
@@ -182,6 +204,55 @@ def _base_update(
         new_params = jax.tree.map(upd, params, mu, nu)
         return new_params, {"step": step + 1, "mu": mu, "nu": nu}
 
+    if cfg.optimizer == "adafactor":
+        # Shazeer & Stern 2018: scheduled decay b2_t = 1 - t^-0.8 (no
+        # bias correction needed), factored rsqrt preconditioner, update
+        # RMS-clipped at 1.0, relative (parameter-scale) step size,
+        # decoupled weight decay like AdamW. The factored estimate
+        # vr_i*vc_j/mean(vr) is EXACT whenever g^2 is rank-1
+        # (test-pinned) and an upper-biased approximation otherwise.
+        t = (step + 1).astype(jnp.float32)
+        b2 = 1.0 - t ** -0.8
+        eps1 = 1e-30
+
+        def one(p, g, vr, vc, v):
+            g = g.astype(jnp.float32)
+            g2 = jnp.square(g) + eps1
+            if p.ndim >= 2:
+                vr = b2 * vr + (1 - b2) * jnp.mean(g2, axis=-1)
+                vc = b2 * vc + (1 - b2) * jnp.mean(g2, axis=-2)
+                row = vr / jnp.mean(vr, axis=-1, keepdims=True)
+                # Two separate rsqrts, NOT rsqrt(row*vc): for a
+                # zero-gradient row the product underflows f32 to 0
+                # (~1e-28 * ~1e-30), rsqrt(0)=inf and 0*inf NaNs the
+                # update; the factors individually stay normal.
+                u = (g * jax.lax.rsqrt(row)[..., None]
+                     * jax.lax.rsqrt(vc)[..., None, :])
+            else:
+                v = b2 * v + (1 - b2) * g2
+                u = g * jax.lax.rsqrt(v)
+            rms = jnp.sqrt(jnp.mean(jnp.square(u)))
+            u = u / jnp.maximum(1.0, rms)
+            # Parameter-scale multiply (the paper's relative step /
+            # optax default): alpha = lr * max(RMS(p), eps2). Without it
+            # the early steps are near-sign-SGD with absolute magnitude
+            # lr — catastrophic for layers initialized at small scale.
+            alpha = lr * jnp.maximum(
+                jnp.sqrt(jnp.mean(jnp.square(p.astype(jnp.float32)))),
+                1e-3)
+            new_p = p - (alpha * (u + cfg.weight_decay * p)).astype(p.dtype)
+            return new_p, vr, vc, v
+
+        out = jax.tree.map(one, params, grads, state["vr"], state["vc"],
+                           state["v"])
+        # Structural transpose (treedef-driven): params-of-4-tuples →
+        # 4-tuple-of-params-trees. An isinstance(tuple) is_leaf unzip
+        # would misfire on param trees that use tuples as containers.
+        new_params, vr, vc, v = jax.tree_util.tree_transpose(
+            jax.tree.structure(params), jax.tree.structure((0, 0, 0, 0)),
+            out)
+        return new_params, {"step": step + 1, "vr": vr, "vc": vc, "v": v}
+
     if cfg.optimizer == "lars":
         beta = cfg.momentum or 0.9
 
@@ -256,6 +327,15 @@ def as_optax(cfg: OptimConfig):
         return optax.chain(*clip, optax.lamb(
             schedule, b1=cfg.adam_b1, b2=cfg.adam_b2, eps=cfg.adam_eps,
             weight_decay=cfg.weight_decay))
+    if cfg.optimizer == "adafactor":
+        # Closest optax composition, NOT bit-identical: optax's
+        # scale_by_factored_rms only factors dims >= its
+        # min_dim_size_to_factor and picks the two largest dims, where
+        # sgd_update always factors the trailing two of any matrix.
+        return optax.chain(*clip, optax.adafactor(
+            schedule, multiply_by_parameter_scale=True,
+            clipping_threshold=1.0, decay_rate=0.8,
+            weight_decay_rate=cfg.weight_decay or None))
     if cfg.optimizer == "lars":
         # Closest optax composition, NOT bit-identical to sgd_update's
         # LARS: optax scales by lr before the momentum trace (ours
