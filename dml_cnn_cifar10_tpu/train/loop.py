@@ -20,6 +20,7 @@ Faithful-mode details mirrored deliberately:
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional
 
 import jax
@@ -34,8 +35,7 @@ from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
 from dml_cnn_cifar10_tpu.utils.preemption import PreemptionGuard
-from dml_cnn_cifar10_tpu.utils.profiling import (DrainMeter, StepTimer,
-                                                 abstractify,
+from dml_cnn_cifar10_tpu.utils.profiling import (DrainMeter, abstractify,
                                                  compiled_flops,
                                                  profile_trace)
 
@@ -346,7 +346,6 @@ class Trainer:
             cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints,
             async_save=cfg.async_checkpoint,
             every_secs=cfg.checkpoint_every_secs, fmt=cfg.ckpt_format)
-        timer = StepTimer(cfg.batch_size * k)
         train_loss, test_accuracy = [], []
         last_metrics = None
 
@@ -391,6 +390,7 @@ class Trainer:
         step_abs = None
         flops_cell = {}
         probe_thread = None
+        run_t0 = None  # post-compile wall anchor for the run-average rate
         # Drain-anchored throughput for the metrics stream (see
         # DrainMeter: async dispatch makes host intervals meaningless).
         meter = DrainMeter(cfg.batch_size)
@@ -411,12 +411,14 @@ class Trainer:
                     if step_abs is None:
                         step_abs = abstractify((state, *batch))
                     state, metrics = step_fn(state, *batch)
+
                     if probe_thread is None:
                         # First dispatch returned ⇒ trace+compile are done
                         # and device execution is only now starting: anchor
                         # the drain meter here so the FIRST boundary
                         # reports a real post-compile rate instead of 0.0.
                         meter.mark(global_step)
+                        run_t0 = time.perf_counter()
                         import threading
 
                         def _probe(fn=step_fn, abs_args=step_abs):
@@ -450,7 +452,6 @@ class Trainer:
                         probe_thread.start()
                     last_metrics = metrics
                     global_step += k
-                    timer.tick()
 
                     if (i + k) % cfg.output_every == 0:
                         # Fresh-batch train accuracy (cifar10cnn.py:235), then
@@ -560,6 +561,18 @@ class Trainer:
                 # It runs INSIDE the guard so a second signal during the
                 # write (Ctrl-C twice, pool re-sending SIGTERM) can't kill the
                 # process before the atomic rename lands.
+                # Run-average throughput over the post-compile window,
+                # drain-anchored: fetch one scalar of the LAST dispatch
+                # (waits for everything before it) and read the clock
+                # BEFORE the final checkpoint save — a host-interval
+                # enqueue rate would be garbage on the chunked path, and
+                # including the final save would charge checkpoint IO
+                # against training throughput.
+                avg_rate = 0.0
+                if run_t0 is not None and global_step > start_step:
+                    jax.device_get(last_metrics["loss"])
+                    avg_rate = ((global_step - start_step) * cfg.batch_size
+                                / max(time.perf_counter() - run_t0, 1e-9))
                 guarded_save(state, global_step, force=True)
                 if stop:
                     print(f"[preempt] signal {preempt.signum}: checkpointed at "
@@ -567,7 +580,7 @@ class Trainer:
                     self.logger.log("preempt", step=global_step,
                                     signum=preempt.signum)
                 self.logger.log("done", step=global_step,
-                                images_per_sec=timer.images_per_sec)
+                                images_per_sec=avg_rate)
         finally:
             # Crash paths clean up too: the async checkpoint writer must
             # drain (surfacing any background write error alongside the
@@ -584,7 +597,7 @@ class Trainer:
         self._resident_test_eval = None
         self._resident_acc_eval = None
         return TrainResult(global_step, train_loss, test_accuracy,
-                           timer.images_per_sec, state, preempted=stop)
+                           avg_rate, state, preempted=stop)
 
 
 def _full_split_arrays(it, reload_fn):
