@@ -92,13 +92,83 @@ def staleness_table(spec: str) -> str:
          "|---|" + "---|" * (len(steps) + 1)] + rows)
 
 
+def plot_groups(groups, out_path: str) -> None:
+    """Small-multiple loss curves, one panel per group (never dual-axis).
+
+    Styling follows the dataviz method: categorical hues in fixed slot
+    order (the validated default palette), thin 2 px lines, recessive
+    grid, direct labels at line ends plus a legend, divergence marked
+    with a text annotation (never color-alone).
+    """
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    colors = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100"]  # slots 1-4
+    fig, axes = plt.subplots(1, len(groups), figsize=(6.2 * len(groups), 4.2),
+                             facecolor="#fcfcfb")
+    if len(groups) == 1:
+        axes = [axes]
+    import math as _math
+    for ax, spec in zip(axes, groups):
+        title, runs = spec.split(":", 1)
+        n_div = 0
+        labeled_ends = []  # log10 of already direct-labeled end values
+        for idx, item in enumerate(runs.split(",")):
+            label, path = item.rsplit("=", 1)
+            tr = _rows(path, "train")
+            xs = [r["step"] for r in tr if r["loss"] is not None]
+            ys = [r["loss"] for r in tr if r["loss"] is not None]
+            c = colors[idx % len(colors)]
+            ax.plot(xs, ys, color=c, linewidth=2, label=label)
+            if len(xs) < len(tr):  # run went non-finite
+                anchor = (xs[-1], ys[-1]) if xs else (tr[0]["step"], 20.0)
+                # Name the series in the note and stagger repeats so two
+                # diverging runs don't overprint each other.
+                ax.annotate(f"{label}: diverges (NaN)", xy=anchor,
+                            xytext=(8, -12 * n_div),
+                            textcoords="offset points",
+                            color="#52514e", fontsize=9, va="center")
+                n_div += 1
+            elif xs:
+                # Direct-label only when the end value is visually clear
+                # of already-labeled ends; the legend still carries
+                # identity for the rest.
+                end = _math.log10(max(ys[-1], 1e-12))
+                if all(abs(end - e) > 0.25 for e in labeled_ends):
+                    ax.annotate(label, xy=(xs[-1], ys[-1]),
+                                xytext=(6, 0), textcoords="offset points",
+                                color="#0b0b0b", fontsize=9, va="center")
+                    labeled_ends.append(end)
+        ax.set_yscale("log")
+        ax.set_title(title, color="#0b0b0b", fontsize=11)
+        ax.set_xlabel("step", color="#52514e")
+        ax.set_ylabel("train loss (log)", color="#52514e")
+        ax.grid(True, color="#e6e5e1", linewidth=0.6)
+        for spine in ax.spines.values():
+            spine.set_color("#c3c2b7")
+        ax.tick_params(colors="#52514e")
+        ax.set_facecolor("#fcfcfb")
+        ax.legend(frameon=False, fontsize=9, labelcolor="#0b0b0b")
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=130)
+    print(f"wrote {out_path}", file=sys.stderr)  # stdout is the markdown
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--faithful", required=True)
     p.add_argument("--faithful-early", required=True)
     p.add_argument("--group", action="append", default=[],
                    help="'TITLE:LABEL=PATH,LABEL=PATH,...'")
+    p.add_argument("--plot", default=None,
+                   help="also write loss-curve small multiples (PNG), one "
+                        "panel per --group")
     args = p.parse_args()
+    if args.plot:
+        if not args.group:
+            p.error("--plot needs at least one --group to draw")
+        plot_groups(args.group, args.plot)
     print("<!-- generated by tools/convergence_report.py -->")
     print("\n### Faithful trajectory (table)\n")
     print(faithful_tables(args.faithful, args.faithful_early))
