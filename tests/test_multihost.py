@@ -82,7 +82,7 @@ def _free_port() -> int:
 def test_two_process_distributed_training(tmp_path, data_cfg):
     """Two OS processes, one SPMD program: both finish all steps, agree on
     the (replicated) loss, and the chief writes the only checkpoint."""
-    _run_two_process(tmp_path, data_cfg, steps_per_dispatch=1)
+    _run_n_process(tmp_path, data_cfg, steps_per_dispatch=1)
 
 
 @pytest.mark.slow
@@ -90,7 +90,7 @@ def test_two_process_chunked_dispatch(tmp_path, data_cfg):
     """Same, on the chunked path: each process feeds raw uint8 chunk
     shards via make_array_from_process_local_data with a leading K dim,
     decode runs on device."""
-    _run_two_process(tmp_path, data_cfg, steps_per_dispatch=4)
+    _run_n_process(tmp_path, data_cfg, steps_per_dispatch=4)
 
 
 @pytest.mark.slow
@@ -99,7 +99,7 @@ def test_two_process_fsdp(tmp_path, data_cfg):
     2-process data axis (leaves are not fully addressable from either
     process), the collective fetch_to_host reassembles them for the
     chief's checkpoint, and both processes stay in lockstep."""
-    results = _run_two_process(tmp_path, data_cfg, steps_per_dispatch=1,
+    results = _run_n_process(tmp_path, data_cfg, steps_per_dispatch=1,
                                fsdp=True)
     assert all(r["fsdp_nonaddressable"] for r in results)
 
@@ -110,12 +110,12 @@ def test_two_process_exact_resume(tmp_path, data_cfg):
     2-process run stopped at 8 and resumed to 16 logs the same losses
     at the same steps as a straight 16-step 2-process run (chief-written
     sidecar, per-process shard streams fast-forwarded)."""
-    straight = _run_two_process(tmp_path / "a", data_cfg,
+    straight = _run_n_process(tmp_path / "a", data_cfg,
                                 steps_per_dispatch=1, total_steps=16,
                                 final_step=16)
-    _run_two_process(tmp_path / "b", data_cfg, steps_per_dispatch=1,
+    _run_n_process(tmp_path / "b", data_cfg, steps_per_dispatch=1,
                      total_steps=8, final_step=8)
-    resumed = _run_two_process(tmp_path / "b", data_cfg,
+    resumed = _run_n_process(tmp_path / "b", data_cfg,
                                steps_per_dispatch=1, total_steps=16,
                                final_step=16)
     # A true resume logs ONLY the post-restore boundaries (train_loss is
@@ -127,10 +127,9 @@ def test_two_process_exact_resume(tmp_path, data_cfg):
     assert straight[0]["losses"][-2:] == resumed[0]["losses"]
 
 
-def _run_two_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False,
+def _run_n_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False,
                      total_steps=8, final_step=8,
-                     ckpt_format="msgpack", resident=True):
-    n = 2
+                     ckpt_format="msgpack", resident=True, n=2):
     port = _free_port()
     data_dir = str(tmp_path / "data")
     log_dir = str(tmp_path / "logs")
@@ -145,7 +144,7 @@ def _run_two_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False,
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
     env = dict(os.environ, JAX_PLATFORMS="cpu",
-               XLA_FLAGS="")  # 1 CPU device per process, 2 globally
+               XLA_FLAGS="")  # 1 CPU device per process, n globally
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
@@ -175,13 +174,14 @@ def _run_two_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False,
     assert all(r["final_step"] == final_step for r in results)
     # Loss/accuracy come out of the same replicated SPMD computation, so
     # every process must report identical values.
-    assert results[0]["loss"] == results[1]["loss"]
-    assert results[0]["test_accuracy"] == results[1]["test_accuracy"]
+    assert all(r["loss"] == results[0]["loss"] for r in results)
+    assert all(r["test_accuracy"] == results[0]["test_accuracy"]
+               for r in results)
     import math
     assert math.isfinite(results[0]["loss"])
     # Chief-only checkpointing: exactly one process holds the chief role
     # (the single writer), and the shared dir has the final-step checkpoint.
-    assert sorted(r["is_chief"] for r in results) == [False, True]
+    assert sorted(r["is_chief"] for r in results) == [False] * (n - 1) + [True]
     from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt
     # Chief-only single writer, cadence-only steps: [8] for the 8-step
     # runs, [8, 16] after the resumed leg.
@@ -196,7 +196,7 @@ def test_two_process_sharded_checkpoint_and_resume(tmp_path, data_cfg):
     with fsdp state each process writes ONLY its own shard file (no
     full-state allgather), the chief commits the manifest, and a second
     2-process run restores from the assembled shards and resumes."""
-    results = _run_two_process(tmp_path, data_cfg, steps_per_dispatch=1,
+    results = _run_n_process(tmp_path, data_cfg, steps_per_dispatch=1,
                                fsdp=True, ckpt_format="sharded")
     assert all(r["fsdp_nonaddressable"] for r in results)
     ckpt = os.path.join(str(tmp_path / "logs"), "ckpt_8.sharded")
@@ -204,7 +204,7 @@ def test_two_process_sharded_checkpoint_and_resume(tmp_path, data_cfg):
     assert names == ["MANIFEST.json", "shard_0.msgpack", "shard_1.msgpack"]
     # Resume to 16 from the sharded checkpoint (restore assembles the
     # global arrays from both shard files, re-shards onto the mesh).
-    resumed = _run_two_process(tmp_path, data_cfg, steps_per_dispatch=1,
+    resumed = _run_n_process(tmp_path, data_cfg, steps_per_dispatch=1,
                                fsdp=True, ckpt_format="sharded",
                                total_steps=16, final_step=16)
     import math
@@ -219,9 +219,23 @@ def test_two_process_resident_matches_hostfed(tmp_path, data_cfg):
     run must produce EXACTLY the host-fed chunked path's losses — same
     records, same device-side decode — while never gathering images on
     the host."""
-    hostfed = _run_two_process(tmp_path / "h", data_cfg,
+    hostfed = _run_n_process(tmp_path / "h", data_cfg,
                                steps_per_dispatch=4, resident=False)
-    res = _run_two_process(tmp_path / "r", data_cfg,
+    res = _run_n_process(tmp_path / "r", data_cfg,
                            steps_per_dispatch=4, resident=True)
     assert res[0]["losses"] == hostfed[0]["losses"]
     assert res[0]["test_accuracy"] == hostfed[0]["test_accuracy"]
+
+
+@pytest.mark.slow
+def test_four_process_fsdp_sharded(tmp_path, data_cfg):
+    """Beyond the pairwise case: FOUR processes form one mesh, shard
+    fsdp state four ways, train in lockstep on the resident path, and
+    write a four-file sharded checkpoint the chief commits."""
+    results = _run_n_process(tmp_path, data_cfg, steps_per_dispatch=4,
+                               fsdp=True, ckpt_format="sharded", n=4)
+    assert all(r["fsdp_nonaddressable"] for r in results)
+    ckpt = os.path.join(str(tmp_path / "logs"), "ckpt_8.sharded")
+    names = sorted(os.listdir(ckpt))
+    assert names == ["MANIFEST.json"] + [f"shard_{i}.msgpack"
+                                         for i in range(4)]

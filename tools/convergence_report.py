@@ -117,11 +117,17 @@ def plot_groups(groups, out_path: str) -> None:
         for idx, item in enumerate(runs.split(",")):
             label, path = item.rsplit("=", 1)
             tr = _rows(path, "train")
-            xs = [r["step"] for r in tr if r["loss"] is not None]
-            ys = [r["loss"] for r in tr if r["loss"] is not None]
+            # NaN rows become gaps (matplotlib breaks the line), never
+            # bridged; "diverges" is claimed only when the run ENDS
+            # non-finite — a transient NaN that recovers is just a gap.
+            steps = [r["step"] for r in tr]
+            vals = [float("nan") if r["loss"] is None else r["loss"]
+                    for r in tr]
+            xs = [s for s, v in zip(steps, vals) if v == v]
+            ys = [v for v in vals if v == v]
             c = colors[idx % len(colors)]
-            ax.plot(xs, ys, color=c, linewidth=2, label=label)
-            if len(xs) < len(tr):  # run went non-finite
+            ax.plot(steps, vals, color=c, linewidth=2, label=label)
+            if tr and tr[-1]["loss"] is None:  # ends non-finite
                 anchor = (xs[-1], ys[-1]) if xs else (tr[0]["step"], 20.0)
                 # Name the series in the note and stagger repeats so two
                 # diverging runs don't overprint each other.
