@@ -243,6 +243,7 @@ def test_mismatched_config_restore_error(tmp_path, data_cfg):
         Trainer(cfg2).init_or_restore()
 
 
+@pytest.mark.slow
 def test_sharded_roundtrip_fsdp(tmp_path, rng):
     """Sharded codec on the 8-device fsdp mesh: the single process owns
     every shard, the file set is shard_0 + MANIFEST, and restore
@@ -286,6 +287,7 @@ def test_sharded_roundtrip_fsdp(tmp_path, rng):
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
 
 
+@pytest.mark.slow
 def test_sharded_elastic_restore_to_plain_mesh(tmp_path, rng):
     """Sharded checkpoints are placement-free: written from an fsdp
     layout, restored onto a REPLICATED mesh (different sharding) with

@@ -1,5 +1,6 @@
 """Resident (one-dispatch) eval == the host-fed padded sweep, exactly."""
 
+import pytest
 import jax
 import numpy as np
 
@@ -10,6 +11,7 @@ from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
 
 
+@pytest.mark.slow
 def test_resident_full_eval_matches_host_sweep(rng):
     model_def = get_model("cnn")
     model_cfg = ModelConfig(logit_relu=False)

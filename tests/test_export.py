@@ -53,6 +53,7 @@ def test_export_symbolic_batch(cnn_setup, rng):
         assert out.shape == (b, 10)
 
 
+@pytest.mark.slow
 def test_export_resnet_with_bn_state(rng):
     """Stateful models (BatchNorm running stats) export too."""
     model_def = get_model("resnet18")

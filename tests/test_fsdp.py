@@ -71,6 +71,7 @@ def test_fsdp_spec_picks_largest_free_dim():
     assert shardings._add_fsdp(P(), (64,), 1) == P()
 
 
+@pytest.mark.slow
 def test_fsdp_state_actually_sharded():
     mesh = _mesh()
     model_def = get_model("cnn")

@@ -53,6 +53,7 @@ def test_moe_routes_to_argmax_expert():
     assert float(aux) > 0
 
 
+@pytest.mark.slow
 def test_moe_capacity_drops_overflow():
     """Capacity 1 with all tokens routed to one expert: only the first
     token gets expert output, the rest emit exactly zero."""

@@ -134,6 +134,7 @@ def test_pp_train_step_matches_dp(rng):
     assert shardings.assert_some_leaf_sharded(st_pp.params, axis="pipe")
 
 
+@pytest.mark.slow
 def test_pp_and_sp_both_raise(rng):
     images = rng.normal(0.5, 0.25, (8, 24, 24, 3)).astype(np.float32)
     labels = rng.integers(0, 10, 8).astype(np.int32)
@@ -153,6 +154,7 @@ def test_pp_more_microbatches_matches_dp(rng):
     np.testing.assert_allclose(loss_dp, loss_pp, rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_pp_microbatch_divisibility_error():
     """Global batch must divide data_axis * M."""
     cfg = dataclasses.replace(VIT_PP, pipe_microbatches=8)

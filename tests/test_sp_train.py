@@ -78,6 +78,7 @@ def test_sp_eval_step(rng):
     assert 0.0 <= float(m["accuracy"]) <= 1.0
 
 
+@pytest.mark.slow
 def test_sp_requires_mean_pool(rng):
     cfg = dataclasses.replace(VIT, pool="cls")
     images, labels = _batch(rng)
@@ -85,6 +86,7 @@ def test_sp_requires_mean_pool(rng):
         _run(cfg, _mesh(2, 1, 4), images, labels, nsteps=1)
 
 
+@pytest.mark.slow
 def test_sp_rejects_indivisible_tokens(rng):
     # 24x24 / patch 4 -> 36 tokens; seq axis 8 does not divide 36.
     data = dataclasses.replace(DATA, crop_height=24, crop_width=24)
@@ -102,6 +104,7 @@ def test_sp_rejects_indivisible_tokens(rng):
         train(state, im, lb)
 
 
+@pytest.mark.slow
 def test_mean_pool_vit_no_cls_param():
     params = get_model("vit_tiny").init(jax.random.key(0), VIT, DATA)
     assert "cls" not in params
