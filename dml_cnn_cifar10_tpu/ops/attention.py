@@ -26,17 +26,24 @@ import jax.numpy as jnp
 
 
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                  scale: float | None = None) -> jax.Array:
+                  scale: float | None = None,
+                  causal: bool = False) -> jax.Array:
     """softmax(q kᵀ · scale) v over [B, S, H, D] tensors.
 
     Computed in float32 regardless of input dtype (softmax in bf16 loses
-    mass at S large); output is cast back to q.dtype.
+    mass at S large); output is cast back to q.dtype. ``causal=True``
+    masks scores above the diagonal (the flash kernel's contract-identical
+    reference for parity tests).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        row = jnp.arange(q.shape[1])[:, None]
+        col = jnp.arange(k.shape[1])[None, :]
+        scores = jnp.where(col <= row, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
@@ -44,11 +51,14 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def dispatch_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                        use_pallas: bool = False,
-                       scale: float | None = None) -> jax.Array:
+                       scale: float | None = None,
+                       causal: bool = False) -> jax.Array:
     """Pick the attention impl: Pallas flash kernel when asked for and the
-    sequence is long enough to benefit; XLA fused attention otherwise."""
+    sequence is long enough to benefit; XLA fused attention otherwise.
+    Both paths differentiate (the flash path via its custom_vjp backward
+    kernels) and both honor ``causal``."""
     seq = q.shape[1]
     if use_pallas and seq >= 128:
         from dml_cnn_cifar10_tpu.ops import flash_attention as fa
-        return fa.flash_attention(q, k, v, scale=scale)
-    return xla_attention(q, k, v, scale=scale)
+        return fa.flash_attention(q, k, v, scale=scale, causal=causal)
+    return xla_attention(q, k, v, scale=scale, causal=causal)

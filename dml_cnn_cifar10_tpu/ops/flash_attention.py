@@ -1,19 +1,45 @@
-"""Blocked online-softmax attention — the Pallas TPU kernel.
+"""Blocked online-softmax attention — the Pallas TPU kernels, forward AND
+backward.
 
 The long-sequence attention path (SURVEY §5 "long-context"; BASELINE.json
 ViT config "attention via Pallas"). The S×S score matrix never
-materializes in HBM: the kernel walks K/V blocks for each Q block keeping
-the FlashAttention running statistics (row max ``m``, normalizer ``l``,
-unnormalized accumulator ``acc``) in VMEM scratch.
+materializes in HBM in either direction:
 
-Grid = (batch·heads, q_blocks, k_blocks), k fastest-varying. On TPU the
-grid is executed sequentially per core, so VMEM scratch carries ``m/l/acc``
-across the k iterations of one q block; ``@pl.when(kb == 0)`` resets them
-and the last k iteration writes the normalized output tile. Scores and the
-accumulator are f32 (VPU/MXU accumulate dtype) regardless of input dtype.
+- forward: walk K/V blocks per Q block keeping the FlashAttention running
+  statistics (row max ``m``, normalizer ``l``, unnormalized accumulator
+  ``acc``) in VMEM scratch; emit the output and, for autodiff, the row
+  logsumexp ``lse = m + log l``.
+- backward (the FlashAttention-2 recompute form): two kernels that rebuild
+  each score block from Q/K and the saved ``lse`` (so ``p = exp(s − lse)``
+  is the exact softmax probability without storing it), using the
+  ``D = rowsum(dO ∘ O)`` identity for the softmax Jacobian:
+  * dQ kernel — grid (b·h, q_blocks, k_blocks): accumulates
+    ``dQ_i = Σ_j dS_ij K_j · scale`` in VMEM scratch;
+  * dK/dV kernel — grid (b·h, k_blocks, q_blocks): accumulates
+    ``dV_j = Σ_i P_ijᵀ dO_i`` and ``dK_j = Σ_i dS_ijᵀ Q_i · scale``.
 
-On non-TPU backends the same kernel runs under the Pallas interpreter
-(tests exercise it on CPU); ``ops.attention.dispatch_attention`` routes
+``flash_attention`` carries a ``jax.custom_vjp`` wiring the three kernels
+together, so the whole long-context stack (ViT blocks, Ulysses all-to-all
+attention, ring attention's per-block engine) differentiates. The
+reference trains every op it exposes (``minimize`` builds the backward for
+the whole graph, ``cifar10cnn.py:163``); this gives the flash path the
+same property.
+
+``causal=True`` applies a lower-triangular mask inside the kernels and
+*skips* score blocks strictly above the diagonal (``@pl.when`` on the
+block indices — on TPU the grid runs sequentially per core, so a skipped
+block really is ~free), recovering the ~2× FLOP saving causal attention
+allows in both directions.
+
+Grid = (batch·heads, outer_blocks, inner_blocks), inner fastest-varying.
+On TPU the grid is executed sequentially per core, so VMEM scratch carries
+running state across the inner iterations of one outer block;
+``@pl.when(inner == 0)`` resets it and the last inner iteration writes the
+finished tile. Scores and all accumulators are f32 (VPU/MXU accumulate
+dtype) regardless of input dtype.
+
+On non-TPU backends the same kernels run under the Pallas interpreter
+(tests exercise them on CPU); ``ops.attention.dispatch_attention`` routes
 short sequences to the fused XLA path where materializing S×S is faster.
 """
 
@@ -28,76 +54,22 @@ from jax.experimental import pallas as pl
 
 NEG_INF = -1e30  # not -inf: exp(-inf - -inf) would NaN the first block
 
-
-def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
-                  scale: float, kv_len: int, block_k: int):
-    """One K/V-block update of the running (m, l, acc) — shared by the
-    plain and stats-emitting kernels."""
-    kb = pl.program_id(2)
-
-    @pl.when(kb == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    q = q_ref[0]                      # [bq, d]
-    k = k_ref[0]                      # [bk, d]
-    v = v_ref[0]                      # [bk, d]
-
-    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32) * scale
-    col = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(col < kv_len, s, NEG_INF)   # mask padded K/V rows
-
-    m_prev = m_scr[:, :1]                                   # [bq, 1]
-    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_cur)
-    p = jnp.exp(s - m_cur)                                  # [bq, bk]
-    l_scr[:, :1] = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * alpha + lax.dot_general(
-        p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_scr[:, :1] = m_cur
+# ---------------------------------------------------------------------------
+# Layout helpers. Per-row statistics (m, l, lse, delta) live in [rows, 128]
+# f32 tiles with only lane column 0 meaningful: (8, 128) is the minimum f32
+# TPU tile, and keeping stats sublane-oriented means the kernels read
+# ``ref[:, :1]`` — a [rows, 1] slice that broadcasts against [rows, cols]
+# score blocks with no lane→sublane transpose.
+# ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale: float, kv_len: int, block_k: int):
-    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, scale=scale,
-                  kv_len=kv_len, block_k=block_k)
-
-    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
-    def _finalize():
-        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
-
-
-def _flash_stats_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
-                        m_scr, l_scr, acc_scr, *,
-                        scale: float, kv_len: int, block_k: int):
-    """Like ``_flash_kernel`` but emits the raw running state — f32
-    UNNORMALIZED accumulator plus row max ``m`` and normalizer ``l`` —
-    the partial-softmax interface the ring-attention merge rule needs
-    (parallel/ring_attention.py). Emitting ``acc_scr`` directly keeps the
-    partial in f32 regardless of input dtype (normalizing to the input
-    dtype and re-multiplying by ``l`` would quantize every ring step's
-    partial)."""
-    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, scale=scale,
-                  kv_len=kv_len, block_k=block_k)
-
-    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
-    def _finalize():
-        acc_ref[0] = acc_scr[:]
-        m_ref[0] = m_scr[:]
-        l_ref[0] = l_scr[:]
-
-
-def _flash_call(q, k, v, scale, block_q, block_k, interpret,
-                with_stats: bool):
+def _resolve(q, scale, block_q, block_k, interpret):
+    """Fill in the static kernel parameters from the input shapes."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    b, s, h, d = q.shape
+    s = q.shape[1]
     # Auto block size (None): measured on a v5e (BASELINE.md round 2),
     # 512x512 blocks are 1.6-4.3x faster than 128x128 from S=2048 up
     # (5.0 vs 8.0 ms at S=2048; 65 vs 281 ms at S=16384) while 128 wins
@@ -106,39 +78,178 @@ def _flash_call(q, k, v, scale, block_q, block_k, interpret,
     auto_block = 512 if s >= 2048 else 128
     block_q = auto_block if block_q is None else block_q
     block_k = auto_block if block_k is None else block_k
-    bq, bk = min(block_q, s), min(block_k, s)
+    return float(scale), block_q, block_k, interpret
 
-    import math
-    pad_to = math.lcm(bq, bk)  # q and k grids must both cover the padded S
 
-    def to_bh(x):  # [B,S,H,D] → [B*H, S_padded, D]
-        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
-        pad = (-s) % pad_to
-        if pad:
-            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
-        return x
+def _to_bh(x, block):
+    """[B, S, H, D] → [B·H, S_padded, D], S padded to a ``block`` multiple."""
+    b, s, h, d = x.shape
+    x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+    pad = (-s) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
 
-    qb, kb_, vb = to_bh(q), to_bh(k), to_bh(v)
-    sp = qb.shape[1]
-    nq, nk = sp // bq, sp // bk
 
+def _from_bh(x, b, s, h):
+    """[B·H, S_padded, ...] → [B, S, H, ...]."""
+    x = x[:, :s]
+    x = x.reshape(b, h, s, *x.shape[2:])
+    return jnp.swapaxes(x, 1, 2)
+
+
+def _stat_to_tile(x, block):
+    """[B, S, H] f32 stat → [B·H, S_padded, 128] tile (lane col 0)."""
+    b, s, h = x.shape
+    t = jnp.transpose(x, (0, 2, 1)).reshape(b * h, s)
+    pad = (-s) % block
+    if pad:
+        t = jnp.pad(t, ((0, 0), (0, pad)))
+    return jnp.pad(t[:, :, None], ((0, 0), (0, 0), (0, 127)))
+
+
+# ---------------------------------------------------------------------------
+# Forward kernels.
+# ---------------------------------------------------------------------------
+
+
+def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, kv_len: int, block_q: int, block_k: int,
+                  causal: bool):
+    """One K/V-block update of the running (m, l, acc) — shared by the
+    plain, lse-emitting, and stats-emitting kernels."""
+    ib = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _update():
+        q = q_ref[0]                      # [bq, d]
+        k = k_ref[0]                      # [bk, d]
+        v = v_ref[0]                      # [bk, d]
+
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        col = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = col < kv_len               # mask padded K/V rows
+        if causal:
+            row = ib * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                                   # [bq, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                                  # [bq, bk]
+        l_scr[:, :1] = (l_scr[:, :1] * alpha
+                        + jnp.sum(p, axis=-1, keepdims=True))
+        acc_scr[:] = acc_scr[:] * alpha + lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, :1] = m_cur
+
+    if causal:
+        # Skip K/V blocks strictly above the diagonal: their whole score
+        # block would be masked. First col of block kb vs last row of
+        # block ib.
+        @pl.when(kb * block_k <= ib * block_q + block_q - 1)
+        def _live():
+            _update()
+    else:
+        _update()
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
+    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, **kw)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      m_scr, l_scr, acc_scr, **kw):
+    """Forward that additionally emits the row logsumexp — the single
+    statistic the FlashAttention-2 backward needs."""
+    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, **kw)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+        # Lane cols 1..127 hold -inf-ish garbage (NEG_INF + log 0); only
+        # col 0 is ever read back.
+        lse_ref[0] = m_scr[:] + jnp.log(l_scr[:])
+
+
+def _flash_stats_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+                        m_scr, l_scr, acc_scr, **kw):
+    """Like ``_flash_kernel`` but emits the raw running state — f32
+    UNNORMALIZED accumulator plus row max ``m`` and normalizer ``l`` —
+    the partial-softmax interface the ring-attention merge rule needs
+    (parallel/ring_attention.py). Emitting ``acc_scr`` directly keeps the
+    partial in f32 regardless of input dtype (normalizing to the input
+    dtype and re-multiplying by ``l`` would quantize every ring step's
+    partial)."""
+    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, **kw)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _finalize():
+        acc_ref[0] = acc_scr[:]
+        m_ref[0] = m_scr[:]
+        l_ref[0] = l_scr[:]
+
+
+def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
+              mode: str):
+    """Shared forward pallas_call builder.
+
+    mode: "out" → out; "lse" → (out, lse [B,S,H]);
+    "stats" → (acc, m, l) — the ring merge interface.
+    """
     from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    kv_len = k.shape[1]
+    bq, bk = min(block_q, s), min(block_k, kv_len)
+
+    qb = _to_bh(q, bq)
+    kb_ = _to_bh(k, bk)
+    vb = _to_bh(v, bk)
+    spq, spk = qb.shape[1], kb_.shape[1]
+    nq, nk = spq // bq, spk // bk
+
+    kw = dict(scale=scale, kv_len=kv_len, block_q=bq, block_k=bk,
+              causal=causal)
     o_spec = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
     stat_spec = pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0))
-    stat_shape = jax.ShapeDtypeStruct((b * h, sp, 128), jnp.float32)
-    kernel = _flash_stats_kernel if with_stats else _flash_kernel
+    stat_shape = jax.ShapeDtypeStruct((b * h, spq, 128), jnp.float32)
+    if mode == "out":
+        kernel, out_shape, out_specs = (
+            _flash_kernel, jax.ShapeDtypeStruct(qb.shape, q.dtype), o_spec)
+    elif mode == "lse":
+        kernel = _flash_fwd_kernel
+        out_shape = [jax.ShapeDtypeStruct(qb.shape, q.dtype), stat_shape]
+        out_specs = [o_spec, stat_spec]
+    else:
+        kernel = _flash_stats_kernel
+        out_shape = [jax.ShapeDtypeStruct(qb.shape, jnp.float32),
+                     stat_shape, stat_shape]
+        out_specs = [o_spec, stat_spec, stat_spec]
+
     res = pl.pallas_call(
-        functools.partial(kernel, scale=scale, kv_len=s, block_k=bk),
-        out_shape=([jax.ShapeDtypeStruct(qb.shape, jnp.float32), stat_shape,
-                    stat_shape] if with_stats
-                   else jax.ShapeDtypeStruct(qb.shape, q.dtype)),
+        functools.partial(kernel, **kw),
+        out_shape=out_shape,
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
             pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
             pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
         ],
-        out_specs=([o_spec, stat_spec, stat_spec] if with_stats else o_spec),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),   # m (col 0 used)
             pltpu.VMEM((bq, 128), jnp.float32),   # l (col 0 used)
@@ -147,44 +258,278 @@ def _flash_call(q, k, v, scale, block_q, block_k, interpret,
         interpret=interpret,
     )(qb, kb_, vb)
 
-    def from_bh(x):  # [B*H, Sp, ...] → [B, S, H, ...]
-        x = x[:, :s]
-        x = x.reshape(b, h, s, *x.shape[2:])
-        return jnp.swapaxes(x, 1, 2)
-
-    if not with_stats:
-        return from_bh(res)
+    if mode == "out":
+        return _from_bh(res, b, s, h)
+    if mode == "lse":
+        o, lse = res
+        return _from_bh(o, b, s, h), _from_bh(lse[:, :, 0], b, s, h)
     acc, m, l = res
     # Stats live in lane column 0 of their [bq, 128] tiles.
-    return from_bh(acc), from_bh(m[:, :, 0]), from_bh(l[:, :, 0])
+    return (_from_bh(acc, b, s, h), _from_bh(m[:, :, 0], b, s, h),
+            _from_bh(l[:, :, 0], b, s, h))
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (FlashAttention-2 recompute form).
+# ---------------------------------------------------------------------------
+
+
+def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
+               scale, kv_len, row0, col0, causal):
+    """Rebuild one score block and its softmax-Jacobian products:
+    returns ``(p, ds, do_f32)`` with ``p = exp(s − lse)`` the exact
+    softmax probabilities and ``ds = p ∘ (dp − delta) · scale``."""
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]               # [bq, 1]
+    delta = delta_ref[0][:, :1]           # [bq, 1]
+
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    col = col0 + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = col < kv_len
+    if causal:
+        row = row0 + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        mask = mask & (col <= row)
+    s = jnp.where(mask, s, NEG_INF)
+
+    p = jnp.exp(s - lse)                  # [bq, bk], true probabilities
+    dp = lax.dot_general(do, v.astype(jnp.float32),
+                         (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return p, ds, do
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, scale, kv_len, block_q, block_k,
+                         causal):
+    """Grid (b·h, q_blocks, k_blocks): dQ_i = Σ_j dS_ij K_j (scale folded
+    into dS)."""
+    ib, jb = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(jb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        _, ds, _ = _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                              delta_ref, scale=scale, kv_len=kv_len,
+                              row0=ib * block_q, col0=jb * block_k,
+                              causal=causal)
+        dq_scr[:] += lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(jb * block_k <= ib * block_q + block_q - 1)
+        def _live():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(jb == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, scale, kv_len,
+                          block_q, block_k, causal):
+    """Grid (b·h, k_blocks, q_blocks): dV_j = Σ_i P_ijᵀ dO_i and
+    dK_j = Σ_i dS_ijᵀ Q_i (scale folded into dS). Padded Q rows contribute
+    exactly zero because their dO rows are zero-padded."""
+    jb, ib = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ib == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        p, ds, do = _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                               delta_ref, scale=scale, kv_len=kv_len,
+                               row0=ib * block_q, col0=jb * block_k,
+                               causal=causal)
+        dv_scr[:] += lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        dk_scr[:] += lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # Live iff the block intersects the lower triangle: last row of
+        # Q block ib reaches col jb·bk.
+        @pl.when(ib * block_q + block_q - 1 >= jb * block_k)
+        def _live():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ib == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
+                        block_q=None, block_k=None, interpret=None,
+                        causal: bool = False, out_dtype=None):
+    """The flash backward as a standalone op: ``(dq, dk, dv)`` from saved
+    forward state. ``lse``/``delta`` are [B, S, H] f32 — the row logsumexp
+    from the forward and ``rowsum(dO ∘ O)``. Exposed (not just wired into
+    the custom_vjp) because ring attention's backward reuses it per ring
+    step with the *global* lse/delta (parallel/ring_attention.py).
+
+    ``out_dtype`` overrides the gradient dtype (default: match each
+    input's). The ring backward passes f32 so its per-step partials are
+    never quantized before the cross-step accumulation — matching its jnp
+    twin engine."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    scale, block_q, block_k, interpret = _resolve(
+        q, scale, block_q, block_k, interpret)
+    b, s, h, d = q.shape
+    kv_len = k.shape[1]
+    bq, bk = min(block_q, s), min(block_k, kv_len)
+    dq_dt = q.dtype if out_dtype is None else out_dtype
+    dk_dt = k.dtype if out_dtype is None else out_dtype
+    dv_dt = v.dtype if out_dtype is None else out_dtype
+
+    qb, dob = _to_bh(q, bq), _to_bh(do, bq)
+    kb_, vb = _to_bh(k, bk), _to_bh(v, bk)
+    lse_t = _stat_to_tile(lse.astype(jnp.float32), bq)
+    delta_t = _stat_to_tile(delta.astype(jnp.float32), bq)
+    spq, spk = qb.shape[1], kb_.shape[1]
+    nq, nk = spq // bq, spk // bk
+
+    kw = dict(scale=scale, kv_len=kv_len, block_q=bq, block_k=bk,
+              causal=causal)
+    q_spec_i = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
+    kv_spec_j = pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0))
+    stat_spec_i = pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **kw),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, dq_dt),
+        grid=(b * h, nq, nk),
+        in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, stat_spec_i,
+                  stat_spec_i],
+        out_specs=q_spec_i,
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb_, vb, dob, lse_t, delta_t)
+
+    # dK/dV grid: k blocks outer, q blocks inner (fastest).
+    q_spec = pl.BlockSpec((1, bq, d), lambda g, j, i: (g, i, 0))
+    kv_spec = pl.BlockSpec((1, bk, d), lambda g, j, i: (g, j, 0))
+    stat_spec = pl.BlockSpec((1, bq, 128), lambda g, j, i: (g, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **kw),
+        out_shape=[jax.ShapeDtypeStruct(kb_.shape, dk_dt),
+                   jax.ShapeDtypeStruct(vb.shape, dv_dt)],
+        grid=(b * h, nk, nq),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec],
+        out_specs=[kv_spec, kv_spec],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb_, vb, dob, lse_t, delta_t)
+
+    return (_from_bh(dq, b, s, h), _from_bh(dk, b, kv_len, h),
+            _from_bh(dv, b, kv_len, h))
+
+
+def attention_delta(o, do):
+    """``D = rowsum(dO ∘ O)`` [B, S, H] f32 — the softmax-Jacobian row
+    term. Plain XLA: an elementwise multiply-reduce fuses fine."""
+    return jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wiring + public API.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, block_q, block_k, interpret, causal):
+    return _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
+                     mode="out")
+
+
+def _flash_fwd_rule(q, k, v, scale, block_q, block_k, interpret, causal):
+    out, lse = _fwd_call(q, k, v, scale, block_q, block_k, interpret,
+                         causal, mode="lse")
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, block_q, block_k, interpret, causal, res, do):
+    q, k, v, out, lse = res
+    delta = attention_delta(out, do)
+    return flash_attention_bwd(q, k, v, do, lse, delta, scale=scale,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret, causal=causal)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "block_q", "block_k",
-                                    "interpret"))
+                                    "interpret", "causal"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     scale: float | None = None,
                     block_q: int | None = None,
                     block_k: int | None = None,
-                    interpret: bool | None = None) -> jax.Array:
+                    interpret: bool | None = None,
+                    causal: bool = False) -> jax.Array:
     """FlashAttention over [B, S, H, D] tensors → [B, S, H, D].
 
-    Contract-identical to :func:`ops.attention.xla_attention`; tests assert
-    numerical agreement. Sequence lengths that aren't multiples of the
-    block sizes are zero-padded and masked inside the kernel.
+    Contract-identical to :func:`ops.attention.xla_attention` (including
+    under ``jax.grad`` — the custom_vjp runs the Pallas backward kernels);
+    tests assert numerical agreement of both values and gradients.
+    Sequence lengths that aren't multiples of the block sizes are
+    zero-padded and masked inside the kernels. ``causal=True`` masks above
+    the diagonal and skips fully-masked blocks.
     """
-    return _flash_call(q, k, v, scale, block_q, block_k, interpret,
-                       with_stats=False)
+    scale, block_q, block_k, interpret = _resolve(
+        q, scale, block_q, block_k, interpret)
+    return _flash(q, k, v, scale, block_q, block_k, interpret, causal)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "block_q", "block_k",
-                                    "interpret"))
+                                    "interpret", "causal"))
+def flash_attention_fwd_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                            scale: float | None = None,
+                            block_q: int | None = None,
+                            block_k: int | None = None,
+                            interpret: bool | None = None,
+                            causal: bool = False):
+    """Forward with residual: ``(out [B,S,H,D], lse [B,S,H] f32)``.
+
+    The save-for-backward interface: ``lse`` is the row logsumexp, the one
+    statistic :func:`flash_attention_bwd` needs alongside O and dO. Ring
+    attention's custom_vjp uses this pair instead of the opaque
+    :func:`flash_attention` so it can run the backward ring itself.
+    """
+    scale, block_q, block_k, interpret = _resolve(
+        q, scale, block_q, block_k, interpret)
+    return _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
+                     mode="lse")
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "block_q", "block_k",
+                                    "interpret", "causal"))
 def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
                           scale: float | None = None,
                           block_q: int | None = None,
                           block_k: int | None = None,
-                          interpret: bool | None = None):
+                          interpret: bool | None = None,
+                          causal: bool = False):
     """FlashAttention's raw partial-softmax state:
     ``(acc [B,S,H,D] f32 UNNORMALIZED accumulator, m [B,S,H] f32 row max,
     l [B,S,H] f32 normalizer)``; the normalized output is ``acc / l``.
@@ -194,5 +539,7 @@ def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
     the ring-attention body needs to run its local block on the MXU via
     Pallas (:func:`parallel.ring_attention.ring_attention`).
     """
-    return _flash_call(q, k, v, scale, block_q, block_k, interpret,
-                       with_stats=True)
+    scale, block_q, block_k, interpret = _resolve(
+        q, scale, block_q, block_k, interpret)
+    return _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
+                     mode="stats")

@@ -16,14 +16,28 @@ chip stays O(S·D/seq + block²), and the K/V transfers ride ICI neighbor
 links, overlappable with the block compute by XLA's latency-hiding
 scheduler.
 
-The per-block math is the flash merge rule (running m/l/acc, same as
-:mod:`~dml_cnn_cifar10_tpu.ops.flash_attention`) with two local-block
-engines: plain jnp (each ring step materializes only the local
-S/seq × S/seq score block, which XLA fuses on-chip — right for short
-shards) or, with ``use_pallas=True`` and shards ≥128, the Pallas flash
-kernel's stats interface (``flash_attention_stats``) so even the local
-block never materializes its score matrix — the long-context
-configuration.
+**Backward is a second ring**, not autodiff through the forward scan
+(which would checkpoint every ring step's K/V — O(S) per device, exactly
+what the ring exists to avoid). ``ring_attention_local`` carries a
+``jax.custom_vjp``: the forward saves only ``(q, k, v, out, lse)`` — all
+local, O(S/seq) — and the backward rotates ``(k, v, dk, dv)`` around the
+ring. Because the saved ``lse`` is the *global* row logsumexp, each ring
+step can rebuild its block's exact softmax probabilities and apply the
+standard FlashAttention-2 block backward (``ops.flash_attention.
+flash_attention_bwd`` — the Pallas kernels — or a jnp twin for short
+shards); per-block dK/dV contributions travel with the visiting shard and
+arrive home after the full loop.
+
+Causality: shards are equal-sized and aligned, so a (Q shard i, K/V shard
+j) pair is entirely below the diagonal (full attention), entirely above
+(skipped — a ``lax.switch`` branch that does no FLOPs, the ~2× causal
+saving), or exactly on it (j == i — local causal mask, no offsets needed).
+
+The per-block math has two local engines: plain jnp (each ring step
+materializes only the local S/seq × S/seq score block, which XLA fuses
+on-chip — right for short shards) or, with ``use_pallas=True`` and shards
+≥128, the Pallas flash kernels so even the local block never materializes
+its score matrix — the long-context configuration.
 """
 
 from __future__ import annotations
@@ -39,15 +53,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_stats(q, k, v, scale):
+def _block_stats(q, k, v, scale, causal=False):
     """One blockwise attention piece → (m, l, unnormalized acc).
 
     q: [B,Sq,H,D]; k,v: [B,Sk,H,D]. Returns per-row stats for the online
     softmax merge: m=[B,H,Sq,1] row max, l=[B,H,Sq,1] sum exp, acc
-    [B,Sq,H,D] = exp(s-m)·V.
+    [B,Sq,H,D] = exp(s-m)·V. ``causal`` masks above the local diagonal
+    (used only for the on-diagonal ring block, where local row/col indices
+    align with the global ones).
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if causal:
+        row = jnp.arange(q.shape[1])[:, None]
+        col = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(col <= row, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)            # [B,H,Sq,1]
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)            # [B,H,Sq,1]
@@ -67,58 +87,193 @@ def _merge(m1, l1, a1, m2, l2, a2):
     return m, l, a1 * wa1 + a2 * wa2
 
 
-def _block_stats_pallas(q, k, v, scale):
+def _block_stats_pallas(q, k, v, scale, causal=False):
     """The same ``(m, l, acc)`` partials as :func:`_block_stats`, computed
     by the Pallas flash kernel (``flash_attention_stats``): the local
     S/seq × S/seq block runs blocked on the MXU with the score matrix
     never leaving VMEM — the long-context ring configuration."""
     from dml_cnn_cifar10_tpu.ops import flash_attention as fa
 
-    acc, m, l = fa.flash_attention_stats(q, k, v, scale=scale)
+    acc, m, l = fa.flash_attention_stats(q, k, v, scale=scale,
+                                         causal=causal)
     m_ = jnp.transpose(m, (0, 2, 1))[..., None]       # [B,H,Sq,1]
     l_ = jnp.transpose(l, (0, 2, 1))[..., None]
     return m_, l_, acc                                # acc already f32
 
 
-def _ring_body(carry, _, axis_name: str, scale: float, nsteps: int,
-               use_pallas: bool = False):
-    q, k, v, m, l, acc = carry
+def _block_bwd_jnp(q, k, v, do, lse, delta, scale, causal=False):
+    """FlashAttention-2 block backward in plain jnp (the short-shard twin
+    of ``ops.flash_attention.flash_attention_bwd``): rebuild the block's
+    scores, recover exact probabilities from the global ``lse``
+    ([B,Sq,H]), and apply the ``D = rowsum(dO ∘ O)`` softmax Jacobian
+    (``delta`` [B,Sq,H])."""
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        row = jnp.arange(q.shape[1])[:, None]
+        col = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(col <= row, s, NEG_INF)
+    lse_t = jnp.transpose(lse, (0, 2, 1))[..., None]      # [B,H,Sq,1]
+    delta_t = jnp.transpose(delta, (0, 2, 1))[..., None]  # [B,H,Sq,1]
+    p = jnp.exp(s - lse_t)                                # exact probs
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
+    ds = p * (dp - delta_t) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    return dq, dk, dv
+
+
+def _zero_partials(b, h, sq, d):
+    return (jnp.full((b, h, sq, 1), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, sq, 1), jnp.float32),
+            jnp.zeros((b, sq, h, d), jnp.float32))
+
+
+def _ring_perm(nsteps):
+    return [(i, (i + 1) % nsteps) for i in range(nsteps)]
+
+
+def _causal_switch(src, my, full, diag, skip):
+    """The shared causal ring-step dispatch: a held shard whose home index
+    ``src`` is < ``my`` lies fully below the diagonal (full attention),
+    == ``my`` is the diagonal block (local causal mask), > ``my`` is fully
+    above (skipped — no FLOPs spent). Shards are equal-sized and aligned,
+    so these three cases are exhaustive."""
+    branch = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+    return lax.switch(branch, [full, diag, skip], None)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp core. Forward: ring of flash partials, saving (q,k,v,out,lse).
+# Backward: second ring rotating (k, v, dk, dv).
+# ---------------------------------------------------------------------------
+
+
+def _ring_fwd_scan(q, k, v, axis_name, scale, use_pallas, causal):
+    nsteps = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
     stats = _block_stats_pallas if use_pallas else _block_stats
-    bm, bl, bacc = stats(q, k, v, scale)
-    m, l, acc = _merge(m, l, acc, bm, bl, bacc)
-    # Rotate K/V one ring hop (neighbor ppermute over ICI). The final
-    # rotation returns the shards to their home device, so the carry stays
-    # consistent for any caller that reuses K/V.
-    perm = [(i, (i + 1) % nsteps) for i in range(nsteps)]
-    k = lax.ppermute(k, axis_name, perm)
-    v = lax.ppermute(v, axis_name, perm)
-    return (q, k, v, m, l, acc), None
+    perm = _ring_perm(nsteps)
+
+    def body(carry, t):
+        k, v, m, l, acc = carry
+        src = (my - t) % nsteps          # home index of the held shard
+
+        if causal:
+            bm, bl, bacc = _causal_switch(
+                src, my,
+                lambda _: stats(q, k, v, scale, causal=False),
+                lambda _: stats(q, k, v, scale, causal=True),
+                lambda _: _zero_partials(b, h, sq, d))
+        else:
+            bm, bl, bacc = stats(q, k, v, scale)
+        m, l, acc = _merge(m, l, acc, bm, bl, bacc)
+        # Rotate K/V one ring hop (neighbor ppermute over ICI). The final
+        # rotation returns the shards to their home device, so the carry
+        # stays consistent for any caller that reuses K/V.
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return (k, v, m, l, acc), None
+
+    m0, l0, a0 = _zero_partials(b, h, sq, d)
+    (k, v, m, l, acc), _ = lax.scan(
+        body, (k, v, m0, l0, a0), jnp.arange(nsteps))
+    out = (acc / jnp.transpose(l, (0, 2, 1, 3))).astype(q.dtype)
+    lse = jnp.transpose((m + jnp.log(l))[..., 0], (0, 2, 1))  # [B,Sq,H]
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_core(q, k, v, axis_name, scale, use_pallas, causal):
+    out, _ = _ring_fwd_scan(q, k, v, axis_name, scale, use_pallas, causal)
+    return out
+
+
+def _ring_core_fwd(q, k, v, axis_name, scale, use_pallas, causal):
+    out, lse = _ring_fwd_scan(q, k, v, axis_name, scale, use_pallas, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_core_bwd(axis_name, scale, use_pallas, causal, res, do):
+    from dml_cnn_cifar10_tpu.ops import flash_attention as fa
+
+    q, k, v, out, lse = res
+    nsteps = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    delta = fa.attention_delta(out, do)               # [B,Sq,H] f32
+    perm = _ring_perm(nsteps)
+
+    # Per-step partials are f32 from either engine (out_dtype=f32 keeps
+    # the Pallas kernels from quantizing each step to the input dtype
+    # before the cross-step accumulation, matching the jnp twin); the
+    # carry accumulates in f32 and casts once at the end.
+    if use_pallas:
+        def block_bwd(k_, v_, causal_local):
+            return fa.flash_attention_bwd(q, k_, v_, do, lse, delta,
+                                          scale=scale, causal=causal_local,
+                                          out_dtype=jnp.float32)
+    else:
+        def block_bwd(k_, v_, causal_local):
+            return _block_bwd_jnp(q, k_, v_, do, lse, delta, scale,
+                                  causal=causal_local)
+
+    def body(carry, t):
+        k, v, dk, dv, dq = carry
+        src = (my - t) % nsteps
+
+        if causal:
+            dq_c, dk_c, dv_c = _causal_switch(
+                src, my,
+                lambda _: block_bwd(k, v, False),
+                lambda _: block_bwd(k, v, True),
+                lambda _: (jnp.zeros_like(dq), jnp.zeros_like(dk),
+                           jnp.zeros_like(dv)))
+        else:
+            dq_c, dk_c, dv_c = block_bwd(k, v, False)
+        dq = dq + dq_c
+        # dK/dV partials travel WITH the visiting shard: after n hops they
+        # have collected a contribution on every device and are home.
+        dk = dk + dk_c
+        dv = dv + dv_c
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        return (k, v, dk, dv, dq), None
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    (k, v, dk, dv, dq), _ = lax.scan(
+        body, (k, v, dk0, dv0, dq0), jnp.arange(nsteps))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 
 def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                          axis_name: str, scale: Optional[float] = None,
-                         use_pallas: bool = False) -> jax.Array:
+                         use_pallas: bool = False,
+                         causal: bool = False) -> jax.Array:
     """Per-device body: runs under ``shard_map`` with Q/K/V sequence-sharded
     on ``axis_name``. Shapes [B, S_local, H, D] → [B, S_local, H, D].
 
-    ``use_pallas`` routes each local block through the flash kernel's
-    stats interface when the local shard is long enough to benefit
-    (same ≥128 threshold as ``dispatch_attention``)."""
+    Differentiable (custom_vjp: the backward is a second ring pass with
+    O(S/seq) memory — see module docstring). ``use_pallas`` routes each
+    local block through the flash kernels when the local shard is long
+    enough to benefit (same ≥128 threshold as ``dispatch_attention``);
+    ``causal`` masks the global lower triangle and skips above-diagonal
+    ring steps entirely."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    nsteps = lax.axis_size(axis_name)
-    b, sq, h, d = q.shape
-    m0 = jnp.full((b, h, sq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
-    a0 = jnp.zeros((b, sq, h, d), jnp.float32)
-
-    body = functools.partial(_ring_body, axis_name=axis_name, scale=scale,
-                             nsteps=nsteps,
-                             use_pallas=use_pallas and sq >= 128)
-    (q, k, v, m, l, acc), _ = lax.scan(
-        body, (q, k, v, m0, l0, a0), None, length=nsteps)
-    out = acc / jnp.transpose(l, (0, 2, 1, 3))
-    return out.astype(q.dtype)
+    return _ring_core(q, k, v, axis_name, float(scale),
+                      bool(use_pallas and q.shape[1] >= 128), bool(causal))
 
 
 def sp_partition_spec(mesh: Mesh, axis_name: str, seq_len: int,
@@ -162,17 +317,19 @@ def sp_shard_map(local_fn, mesh: Mesh, axis_name: str, seq_len: int,
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    scale: Optional[float] = None,
                    axis_name: str = "seq",
-                   use_pallas: bool = False) -> jax.Array:
+                   use_pallas: bool = False,
+                   causal: bool = False) -> jax.Array:
     """Sequence-parallel attention over the mesh's ``seq`` axis.
 
     Global-view entrypoint: [B, S, H, D] arrays (sharded or not); S must be
     divisible by the ``seq`` axis size. Batch stays sharded on ``data`` so
     dp × sp compose. ``use_pallas`` runs each local block on the Pallas
-    flash kernel (long-shard configs).
+    flash kernels (long-shard configs); ``causal`` applies the global
+    lower-triangular mask with above-diagonal ring steps skipped.
     """
     fn = sp_shard_map(
         functools.partial(ring_attention_local, axis_name=axis_name,
-                          scale=scale, use_pallas=use_pallas),
+                          scale=scale, use_pallas=use_pallas, causal=causal),
         mesh, axis_name, q.shape[1], q.shape[2])
     return fn(q, k, v)
 

@@ -43,23 +43,28 @@ __all__ = ["ulysses_attention", "ulysses_attention_local",
 def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                             axis_name: str,
                             scale: Optional[float] = None,
-                            use_pallas: bool = False) -> jax.Array:
+                            use_pallas: bool = False,
+                            causal: bool = False) -> jax.Array:
     """Per-device body under ``shard_map``: Q/K/V sequence-sharded
     ``[B, S_local, H, D]`` → out ``[B, S_local, H, D]``.
 
     ``all_to_all`` (seq→head re-partition) → full-seq local attention →
-    ``all_to_all`` back. Heads must divide the axis size.
+    ``all_to_all`` back. Heads must divide the axis size. Causality is
+    position-exact here: the local kernel sees the full sequence, so the
+    flag passes straight through. Differentiable end to end (all_to_all
+    has a transpose rule; the flash path brings its custom_vjp).
     """
     n = lax.axis_size(axis_name)
     if n == 1:
         return attn.dispatch_attention(q, k, v, use_pallas=use_pallas,
-                                       scale=scale)
+                                       scale=scale, causal=causal)
     # [B, S/n, H, D] -> [B, S, H/n, D]: split the head dim over the axis,
     # concatenate the sequence dim. tiled=True keeps the dims in place.
     q, k, v = (
         lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
         for t in (q, k, v))
-    o = attn.dispatch_attention(q, k, v, use_pallas=use_pallas, scale=scale)
+    o = attn.dispatch_attention(q, k, v, use_pallas=use_pallas, scale=scale,
+                                causal=causal)
     # [B, S, H/n, D] -> [B, S/n, H, D]
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
@@ -68,7 +73,8 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                       scale: Optional[float] = None,
                       axis_name: str = "seq",
-                      use_pallas: bool = False) -> jax.Array:
+                      use_pallas: bool = False,
+                      causal: bool = False) -> jax.Array:
     """Sequence-parallel attention via head/sequence all-to-all.
 
     Global-view entrypoint, same contract as
@@ -90,6 +96,6 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
             f"split")
     fn = sp_shard_map(
         functools.partial(ulysses_attention_local, axis_name=axis_name,
-                          scale=scale, use_pallas=use_pallas),
+                          scale=scale, use_pallas=use_pallas, causal=causal),
         mesh, axis_name, q.shape[1], q.shape[2])
     return fn(q, k, v)
