@@ -25,9 +25,32 @@ import jax
 import jax.numpy as jnp
 
 
+NEG_INF = -1e30  # finite: exp(-inf - -inf) would NaN a fully-masked row
+
+
+def mask_scores(scores: jax.Array, q_len: int, kv_len: int,
+                causal: bool = False,
+                segment_ids: jax.Array | None = None) -> jax.Array:
+    """Apply the shared attention-validity mask to dense ``[..., Sq, Sk]``
+    scores (jnp counterpart of the flash kernels' ``_score_mask``): causal
+    keeps col ≤ row; segment_ids [B, S] keep same-segment pairs only
+    (``scores`` must then be [B, H, Sq, Sk]). One definition, used by the
+    XLA reference path and the ring's jnp block engines, so the masking
+    semantics can't drift between the parity-tested implementations."""
+    if causal:
+        row = jnp.arange(q_len)[:, None]
+        col = jnp.arange(kv_len)[None, :]
+        scores = jnp.where(col <= row, scores, NEG_INF)
+    if segment_ids is not None:
+        same = (segment_ids[:, :, None] == segment_ids[:, None, :])
+        scores = jnp.where(same[:, None, :, :], scores, NEG_INF)
+    return scores
+
+
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                   scale: float | None = None,
-                  causal: bool = False) -> jax.Array:
+                  causal: bool = False,
+                  segment_ids: jax.Array | None = None) -> jax.Array:
     """softmax(q kᵀ · scale) v over [B, S, H, D] tensors.
 
     Computed in float32 regardless of input dtype (softmax in bf16 loses
@@ -40,10 +63,8 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
-    if causal:
-        row = jnp.arange(q.shape[1])[:, None]
-        col = jnp.arange(k.shape[1])[None, :]
-        scores = jnp.where(col <= row, scores, -1e30)
+    scores = mask_scores(scores, q.shape[1], k.shape[1], causal=causal,
+                         segment_ids=segment_ids)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
@@ -52,7 +73,8 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def dispatch_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                        use_pallas: bool = False,
                        scale: float | None = None,
-                       causal: bool = False) -> jax.Array:
+                       causal: bool = False,
+                       segment_ids: jax.Array | None = None) -> jax.Array:
     """Pick the attention impl: Pallas flash kernel when asked for and the
     sequence is long enough to benefit; XLA fused attention otherwise.
     Both paths differentiate (the flash path via its custom_vjp backward
@@ -60,5 +82,7 @@ def dispatch_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     seq = q.shape[1]
     if use_pallas and seq >= 128:
         from dml_cnn_cifar10_tpu.ops import flash_attention as fa
-        return fa.flash_attention(q, k, v, scale=scale, causal=causal)
-    return xla_attention(q, k, v, scale=scale, causal=causal)
+        return fa.flash_attention(q, k, v, scale=scale, causal=causal,
+                                  segment_ids=segment_ids)
+    return xla_attention(q, k, v, scale=scale, causal=causal,
+                         segment_ids=segment_ids)

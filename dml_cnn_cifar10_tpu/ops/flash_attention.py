@@ -113,9 +113,28 @@ def _stat_to_tile(x, block):
 # ---------------------------------------------------------------------------
 
 
+def _score_mask(shape, *, kv_len, q_len, row0, col0, causal,
+                qseg=None, kseg=None):
+    """The shared validity mask for one [bq, bk] score block: padded K/V
+    columns off; optionally causal (col ≤ row in global coordinates);
+    optionally same-segment only (packed sequences). Padded Q rows
+    (row ≥ q_len) are *exempt* from the segment mask so every padded row
+    keeps l > 0 — their lse stays finite, and their gradient contributions
+    vanish anyway because dO is zero-padded."""
+    col = col0 + lax.broadcasted_iota(jnp.int32, shape, 1)
+    mask = col < kv_len
+    row = row0 + lax.broadcasted_iota(jnp.int32, shape, 0)
+    if causal:
+        mask = mask & (col <= row)
+    if qseg is not None:
+        mask = mask & ((qseg == kseg) | (row >= q_len))
+    return mask
+
+
 def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
-                  scale: float, kv_len: int, block_q: int, block_k: int,
-                  causal: bool):
+                  scale: float, kv_len: int, q_len: int, block_q: int,
+                  block_k: int, causal: bool,
+                  qseg_ref=None, kseg_ref=None):
     """One K/V-block update of the running (m, l, acc) — shared by the
     plain, lse-emitting, and stats-emitting kernels."""
     ib = pl.program_id(1)
@@ -134,11 +153,11 @@ def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
 
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        col = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = col < kv_len               # mask padded K/V rows
-        if causal:
-            row = ib * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            mask = mask & (col <= row)
+        mask = _score_mask(
+            s.shape, kv_len=kv_len, q_len=q_len, row0=ib * block_q,
+            col0=kb * block_k, causal=causal,
+            qseg=None if qseg_ref is None else qseg_ref[0][:, :1],
+            kseg=None if kseg_ref is None else kseg_ref[0][None, :])
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, :1]                                   # [bq, 1]
@@ -163,19 +182,38 @@ def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
         _update()
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
-    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, **kw)
+def _unpack(refs, n_out, has_segments, n_base=3):
+    """Split a kernel's positional refs into (base inputs…, qseg, kseg),
+    outs, scratch. ``n_base`` is the count of always-present inputs (3 for
+    the forward kernels: q/k/v; 6 for the backward: +do/lse/delta); the
+    two segment-id refs are only present when asked for, so the
+    non-segmented path pays zero extra bandwidth."""
+    n_in = n_base + (2 if has_segments else 0)
+    ins, outs, scratch = refs[:n_in], refs[n_in:n_in + n_out], \
+        refs[n_in + n_out:]
+    if not has_segments:
+        ins = ins + (None, None)
+    return ins, outs, scratch
+
+
+def _flash_kernel(*refs, has_segments: bool = False, **kw):
+    (q_ref, k_ref, v_ref, qseg_ref, kseg_ref), (o_ref,), \
+        (m_scr, l_scr, acc_scr) = _unpack(refs, 1, has_segments)
+    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                  qseg_ref=qseg_ref, kseg_ref=kseg_ref, **kw)
 
     @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _finalize():
         o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                      m_scr, l_scr, acc_scr, **kw):
+def _flash_fwd_kernel(*refs, has_segments: bool = False, **kw):
     """Forward that additionally emits the row logsumexp — the single
     statistic the FlashAttention-2 backward needs."""
-    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, **kw)
+    (q_ref, k_ref, v_ref, qseg_ref, kseg_ref), (o_ref, lse_ref), \
+        (m_scr, l_scr, acc_scr) = _unpack(refs, 2, has_segments)
+    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                  qseg_ref=qseg_ref, kseg_ref=kseg_ref, **kw)
 
     @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _finalize():
@@ -185,8 +223,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = m_scr[:] + jnp.log(l_scr[:])
 
 
-def _flash_stats_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
-                        m_scr, l_scr, acc_scr, **kw):
+def _flash_stats_kernel(*refs, has_segments: bool = False, **kw):
     """Like ``_flash_kernel`` but emits the raw running state — f32
     UNNORMALIZED accumulator plus row max ``m`` and normalizer ``l`` —
     the partial-softmax interface the ring-attention merge rule needs
@@ -194,7 +231,10 @@ def _flash_stats_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
     partial in f32 regardless of input dtype (normalizing to the input
     dtype and re-multiplying by ``l`` would quantize every ring step's
     partial)."""
-    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, **kw)
+    (q_ref, k_ref, v_ref, qseg_ref, kseg_ref), (acc_ref, m_ref, l_ref), \
+        (m_scr, l_scr, acc_scr) = _unpack(refs, 3, has_segments)
+    _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                  qseg_ref=qseg_ref, kseg_ref=kseg_ref, **kw)
 
     @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _finalize():
@@ -203,12 +243,33 @@ def _flash_stats_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
         l_ref[0] = l_scr[:]
 
 
+def _seg_tile(seg, block):
+    """[B, S] int32 → [B, S_padded, 128] Q-side tile (lane col 0; pad
+    value irrelevant — padded rows are mask-exempt)."""
+    b, s = seg.shape
+    pad = (-s) % block
+    if pad:
+        seg = jnp.pad(seg, ((0, 0), (0, pad)), constant_values=-1)
+    return jnp.pad(seg[:, :, None], ((0, 0), (0, 0), (0, 127)))
+
+
+def _seg_lane(seg, block):
+    """[B, S] int32 → [B, S_padded] K-side lane vector (padded cols are
+    already killed by the kv_len mask)."""
+    pad = (-seg.shape[1]) % block
+    if pad:
+        seg = jnp.pad(seg, ((0, 0), (0, pad)), constant_values=-1)
+    return seg
+
+
 def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
-              mode: str):
+              mode: str, segment_ids=None):
     """Shared forward pallas_call builder.
 
     mode: "out" → out; "lse" → (out, lse [B,S,H]);
     "stats" → (acc, m, l) — the ring merge interface.
+    ``segment_ids`` [B, S] int32 restricts attention to equal-id pairs
+    (packed sequences).
     """
     from jax.experimental.pallas import tpu as pltpu
 
@@ -221,9 +282,26 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
     vb = _to_bh(v, bk)
     spq, spk = qb.shape[1], kb_.shape[1]
     nq, nk = spq // bq, spk // bk
+    has_seg = segment_ids is not None
 
-    kw = dict(scale=scale, kv_len=kv_len, block_q=bq, block_k=bk,
-              causal=causal)
+    kw = dict(scale=scale, kv_len=kv_len, q_len=s, block_q=bq, block_k=bk,
+              causal=causal, has_segments=has_seg)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+    ]
+    inputs = [qb, kb_, vb]
+    if has_seg:
+        seg = segment_ids.astype(jnp.int32)
+        # Segment ids are per (batch, position) — the index maps fold the
+        # head out of the grid's batch·head axis.
+        in_specs += [
+            pl.BlockSpec((1, bq, 128), lambda g, i, j: (g // h, i, 0)),
+            pl.BlockSpec((1, bk), lambda g, i, j: (g // h, j)),
+        ]
+        inputs += [_seg_tile(seg, bq), _seg_lane(seg, bk)]
+
     o_spec = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
     stat_spec = pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0))
     stat_shape = jax.ShapeDtypeStruct((b * h, spq, 128), jnp.float32)
@@ -244,11 +322,7 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
         functools.partial(kernel, **kw),
         out_shape=out_shape,
         grid=(b * h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),   # m (col 0 used)
@@ -256,7 +330,7 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
             pltpu.VMEM((bq, d), jnp.float32),     # acc
         ],
         interpret=interpret,
-    )(qb, kb_, vb)
+    )(*inputs)
 
     if mode == "out":
         return _from_bh(res, b, s, h)
@@ -274,8 +348,9 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
 # ---------------------------------------------------------------------------
 
 
-def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
-               scale, kv_len, row0, col0, causal):
+def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               qseg_ref, kseg_ref, *, scale, kv_len, q_len, row0, col0,
+               causal):
     """Rebuild one score block and its softmax-Jacobian products:
     returns ``(p, ds, do_f32)`` with ``p = exp(s − lse)`` the exact
     softmax probabilities and ``ds = p ∘ (dp − delta) · scale``."""
@@ -288,11 +363,11 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
 
     s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32) * scale
-    col = col0 + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    mask = col < kv_len
-    if causal:
-        row = row0 + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        mask = mask & (col <= row)
+    mask = _score_mask(
+        s.shape, kv_len=kv_len, q_len=q_len, row0=row0, col0=col0,
+        causal=causal,
+        qseg=None if qseg_ref is None else qseg_ref[0][:, :1],
+        kseg=None if kseg_ref is None else kseg_ref[0][None, :])
     s = jnp.where(mask, s, NEG_INF)
 
     p = jnp.exp(s - lse)                  # [bq, bk], true probabilities
@@ -303,11 +378,13 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
     return p, ds, do
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, *, scale, kv_len, block_q, block_k,
-                         causal):
+def _flash_bwd_dq_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
+                         causal, has_segments=False):
     """Grid (b·h, q_blocks, k_blocks): dQ_i = Σ_j dS_ij K_j (scale folded
     into dS)."""
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
+     kseg_ref), (dq_ref,), (dq_scr,) = _unpack(refs, 1, has_segments,
+                                               n_base=6)
     ib, jb = pl.program_id(1), pl.program_id(2)
 
     @pl.when(jb == 0)
@@ -316,7 +393,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def _compute():
         _, ds, _ = _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref,
-                              delta_ref, scale=scale, kv_len=kv_len,
+                              delta_ref, qseg_ref, kseg_ref, scale=scale,
+                              kv_len=kv_len, q_len=q_len,
                               row0=ib * block_q, col0=jb * block_k,
                               causal=causal)
         dq_scr[:] += lax.dot_general(
@@ -335,12 +413,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_scr, dv_scr, *, scale, kv_len,
-                          block_q, block_k, causal):
+def _flash_bwd_dkv_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
+                          causal, has_segments=False):
     """Grid (b·h, k_blocks, q_blocks): dV_j = Σ_i P_ijᵀ dO_i and
     dK_j = Σ_i dS_ijᵀ Q_i (scale folded into dS). Padded Q rows contribute
     exactly zero because their dO rows are zero-padded."""
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
+     kseg_ref), (dk_ref, dv_ref), (dk_scr, dv_scr) = _unpack(
+        refs, 2, has_segments, n_base=6)
     jb, ib = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ib == 0)
@@ -350,7 +430,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def _compute():
         p, ds, do = _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref,
-                               delta_ref, scale=scale, kv_len=kv_len,
+                               delta_ref, qseg_ref, kseg_ref, scale=scale,
+                               kv_len=kv_len, q_len=q_len,
                                row0=ib * block_q, col0=jb * block_k,
                                causal=causal)
         dv_scr[:] += lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -376,7 +457,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
                         block_q=None, block_k=None, interpret=None,
-                        causal: bool = False, out_dtype=None):
+                        causal: bool = False, out_dtype=None,
+                        segment_ids=None):
     """The flash backward as a standalone op: ``(dq, dk, dv)`` from saved
     forward state. ``lse``/``delta`` are [B, S, H] f32 — the row logsumexp
     from the forward and ``rowsum(dO ∘ O)``. Exposed (not just wired into
@@ -405,38 +487,55 @@ def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
     spq, spk = qb.shape[1], kb_.shape[1]
     nq, nk = spq // bq, spk // bk
 
-    kw = dict(scale=scale, kv_len=kv_len, block_q=bq, block_k=bk,
-              causal=causal)
+    has_seg = segment_ids is not None
+    kw = dict(scale=scale, kv_len=kv_len, q_len=s, block_q=bq, block_k=bk,
+              causal=causal, has_segments=has_seg)
     q_spec_i = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
     kv_spec_j = pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0))
     stat_spec_i = pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0))
+
+    in_specs = [q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, stat_spec_i,
+                stat_spec_i]
+    inputs = [qb, kb_, vb, dob, lse_t, delta_t]
+    if has_seg:
+        seg = segment_ids.astype(jnp.int32)
+        in_specs += [
+            pl.BlockSpec((1, bq, 128), lambda g, i, j: (g // h, i, 0)),
+            pl.BlockSpec((1, bk), lambda g, i, j: (g // h, j)),
+        ]
+        inputs += [_seg_tile(seg, bq), _seg_lane(seg, bk)]
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **kw),
         out_shape=jax.ShapeDtypeStruct(qb.shape, dq_dt),
         grid=(b * h, nq, nk),
-        in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, stat_spec_i,
-                  stat_spec_i],
+        in_specs=in_specs,
         out_specs=q_spec_i,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qb, kb_, vb, dob, lse_t, delta_t)
+    )(*inputs)
 
     # dK/dV grid: k blocks outer, q blocks inner (fastest).
     q_spec = pl.BlockSpec((1, bq, d), lambda g, j, i: (g, i, 0))
     kv_spec = pl.BlockSpec((1, bk, d), lambda g, j, i: (g, j, 0))
     stat_spec = pl.BlockSpec((1, bq, 128), lambda g, j, i: (g, i, 0))
+    in_specs2 = [q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec]
+    if has_seg:
+        in_specs2 += [
+            pl.BlockSpec((1, bq, 128), lambda g, j, i: (g // h, i, 0)),
+            pl.BlockSpec((1, bk), lambda g, j, i: (g // h, j)),
+        ]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **kw),
         out_shape=[jax.ShapeDtypeStruct(kb_.shape, dk_dt),
                    jax.ShapeDtypeStruct(vb.shape, dv_dt)],
         grid=(b * h, nk, nq),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec],
+        in_specs=in_specs2,
         out_specs=[kv_spec, kv_spec],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(qb, kb_, vb, dob, lse_t, delta_t)
+    )(*inputs)
 
     return (_from_bh(dq, b, s, h), _from_bh(dk, b, kv_len, h),
             _from_bh(dv, b, kv_len, h))
@@ -453,24 +552,34 @@ def attention_delta(o, do):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, block_q, block_k, interpret, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, segment_ids, scale, block_q, block_k, interpret,
+           causal):
     return _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
-                     mode="out")
+                     mode="out", segment_ids=segment_ids)
 
 
-def _flash_fwd_rule(q, k, v, scale, block_q, block_k, interpret, causal):
+def _flash_fwd_rule(q, k, v, segment_ids, scale, block_q, block_k,
+                    interpret, causal):
     out, lse = _fwd_call(q, k, v, scale, block_q, block_k, interpret,
-                         causal, mode="lse")
-    return out, (q, k, v, out, lse)
+                         causal, mode="lse", segment_ids=segment_ids)
+    return out, (q, k, v, segment_ids, out, lse)
 
 
 def _flash_bwd_rule(scale, block_q, block_k, interpret, causal, res, do):
-    q, k, v, out, lse = res
+    import numpy as np
+
+    q, k, v, segment_ids, out, lse = res
     delta = attention_delta(out, do)
-    return flash_attention_bwd(q, k, v, do, lse, delta, scale=scale,
-                               block_q=block_q, block_k=block_k,
-                               interpret=interpret, causal=causal)
+    dq, dk, dv = flash_attention_bwd(q, k, v, do, lse, delta, scale=scale,
+                                     block_q=block_q, block_k=block_k,
+                                     interpret=interpret, causal=causal,
+                                     segment_ids=segment_ids)
+    # Integer segment ids carry no gradient: float0 cotangent (None stays
+    # None — it's an empty pytree).
+    dseg = None if segment_ids is None else np.zeros(
+        segment_ids.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -484,7 +593,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_q: int | None = None,
                     block_k: int | None = None,
                     interpret: bool | None = None,
-                    causal: bool = False) -> jax.Array:
+                    causal: bool = False,
+                    segment_ids: jax.Array | None = None) -> jax.Array:
     """FlashAttention over [B, S, H, D] tensors → [B, S, H, D].
 
     Contract-identical to :func:`ops.attention.xla_attention` (including
@@ -492,11 +602,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     tests assert numerical agreement of both values and gradients.
     Sequence lengths that aren't multiples of the block sizes are
     zero-padded and masked inside the kernels. ``causal=True`` masks above
-    the diagonal and skips fully-masked blocks.
+    the diagonal and skips fully-masked blocks. ``segment_ids`` [B, S]
+    int32 restricts attention to same-segment pairs (packed sequences) in
+    both directions; combine with ``causal`` for packed causal LM
+    batches.
     """
     scale, block_q, block_k, interpret = _resolve(
         q, scale, block_q, block_k, interpret)
-    return _flash(q, k, v, scale, block_q, block_k, interpret, causal)
+    return _flash(q, k, v, segment_ids, scale, block_q, block_k, interpret,
+                  causal)
 
 
 @functools.partial(jax.jit,
@@ -507,18 +621,22 @@ def flash_attention_fwd_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                             block_q: int | None = None,
                             block_k: int | None = None,
                             interpret: bool | None = None,
-                            causal: bool = False):
+                            causal: bool = False,
+                            segment_ids: jax.Array | None = None):
     """Forward with residual: ``(out [B,S,H,D], lse [B,S,H] f32)``.
 
-    The save-for-backward interface: ``lse`` is the row logsumexp, the one
-    statistic :func:`flash_attention_bwd` needs alongside O and dO. Ring
-    attention's custom_vjp uses this pair instead of the opaque
-    :func:`flash_attention` so it can run the backward ring itself.
+    The save-for-backward interface: ``lse`` is the row logsumexp, the
+    one statistic :func:`flash_attention_bwd` needs alongside O and dO —
+    for any caller that manages its own residuals instead of going
+    through :func:`flash_attention`'s custom_vjp. (Ring attention derives
+    its residual lse from the merged stats inside its own forward scan —
+    parallel/ring_attention.py — and pairs it with
+    :func:`flash_attention_bwd` in its backward ring.)
     """
     scale, block_q, block_k, interpret = _resolve(
         q, scale, block_q, block_k, interpret)
     return _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
-                     mode="lse")
+                     mode="lse", segment_ids=segment_ids)
 
 
 @functools.partial(jax.jit,
@@ -529,7 +647,8 @@ def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
                           block_q: int | None = None,
                           block_k: int | None = None,
                           interpret: bool | None = None,
-                          causal: bool = False):
+                          causal: bool = False,
+                          segment_ids: jax.Array | None = None):
     """FlashAttention's raw partial-softmax state:
     ``(acc [B,S,H,D] f32 UNNORMALIZED accumulator, m [B,S,H] f32 row max,
     l [B,S,H] f32 normalizer)``; the normalized output is ``acc / l``.
@@ -542,4 +661,4 @@ def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
     scale, block_q, block_k, interpret = _resolve(
         q, scale, block_q, block_k, interpret)
     return _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
-                     mode="stats")
+                     mode="stats", segment_ids=segment_ids)

@@ -62,12 +62,11 @@ def _block_stats(q, k, v, scale, causal=False):
     (used only for the on-diagonal ring block, where local row/col indices
     align with the global ones).
     """
+    from dml_cnn_cifar10_tpu.ops.attention import mask_scores
+
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    if causal:
-        row = jnp.arange(q.shape[1])[:, None]
-        col = jnp.arange(k.shape[1])[None, :]
-        s = jnp.where(col <= row, s, NEG_INF)
+    s = mask_scores(s, q.shape[1], k.shape[1], causal=causal)
     m = jnp.max(s, axis=-1, keepdims=True)            # [B,H,Sq,1]
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)            # [B,H,Sq,1]
@@ -107,15 +106,14 @@ def _block_bwd_jnp(q, k, v, do, lse, delta, scale, causal=False):
     scores, recover exact probabilities from the global ``lse``
     ([B,Sq,H]), and apply the ``D = rowsum(dO ∘ O)`` softmax Jacobian
     (``delta`` [B,Sq,H])."""
+    from dml_cnn_cifar10_tpu.ops.attention import mask_scores
+
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
     dof = do.astype(jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
-    if causal:
-        row = jnp.arange(q.shape[1])[:, None]
-        col = jnp.arange(k.shape[1])[None, :]
-        s = jnp.where(col <= row, s, NEG_INF)
+    s = mask_scores(s, q.shape[1], k.shape[1], causal=causal)
     lse_t = jnp.transpose(lse, (0, 2, 1))[..., None]      # [B,H,Sq,1]
     delta_t = jnp.transpose(delta, (0, 2, 1))[..., None]  # [B,H,Sq,1]
     p = jnp.exp(s - lse_t)                                # exact probs

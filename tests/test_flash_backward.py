@@ -138,6 +138,41 @@ def test_sp_attention_pallas_grads(sp_mode, causal):
     _assert_close(g, g_ref, atol=3e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_ids_fwd_and_grads(causal):
+    """Packed sequences: segment_ids restrict attention to same-segment
+    pairs in both directions (packed-causal = the LM batching layout).
+    Ragged S=300 on purpose — padded Q rows are segment-mask-exempt so
+    their lse stays finite; their grads must still be exactly absent."""
+    q, k, v = _qkv((2, 300, 2, 16), seed=10)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 100), jnp.int32), jnp.ones((2, 120), jnp.int32),
+         jnp.full((2, 80), 2, jnp.int32)], axis=1)
+    out = fa.flash_attention(q, k, v, causal=causal, segment_ids=seg)
+    ref = attn.xla_attention(q, k, v, causal=causal, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-6)
+    g = _grads(lambda q, k, v: fa.flash_attention(
+        q, k, v, causal=causal, segment_ids=seg), q, k, v)
+    g_ref = _grads(lambda q, k, v: attn.xla_attention(
+        q, k, v, causal=causal, segment_ids=seg), q, k, v)
+    _assert_close(g, g_ref, atol=2e-5)
+
+
+def test_segment_isolation_is_exact():
+    """Tokens in one segment must see zero influence from another: compare
+    a packed two-segment batch against the two segments attended alone."""
+    q, k, v = _qkv((1, 256, 2, 16), seed=11)
+    seg = jnp.concatenate([jnp.zeros((1, 128), jnp.int32),
+                           jnp.ones((1, 128), jnp.int32)], axis=1)
+    packed = fa.flash_attention(q, k, v, segment_ids=seg)
+    alone_a = fa.flash_attention(q[:, :128], k[:, :128], v[:, :128])
+    alone_b = fa.flash_attention(q[:, 128:], k[:, 128:], v[:, 128:])
+    np.testing.assert_allclose(np.asarray(packed[:, :128]),
+                               np.asarray(alone_a), atol=5e-6)
+    np.testing.assert_allclose(np.asarray(packed[:, 128:]),
+                               np.asarray(alone_b), atol=5e-6)
+
+
 @pytest.mark.slow
 def test_ring_pallas_causal_bf16_grads():
     """bf16 is the realistic long-context training dtype: the causal ring
