@@ -330,7 +330,10 @@ class CheckpointManager:
 
     def maybe_save(self, state: Any, step: int, force: bool = False,
                    data_state: Optional[dict] = None) -> bool:
-        """Save if :meth:`due`. ``data_state`` (the exact-resume stream
+        """Save if :meth:`due`; returns True on every process that spent
+        time on the save (collective fetch, shard write, barrier) — the
+        caller re-anchors its throughput meter on it, so it must fire on
+        chief and non-chief alike. ``data_state`` (the exact-resume stream
         counts) is committed by the same writer AFTER the checkpoint
         bytes land, so a crash mid-write can never leave a sidecar whose
         checkpoint never existed — the pair commits atomically in
@@ -358,7 +361,12 @@ class CheckpointManager:
                 self._finish_sharded(path, payload, state, step,
                                      data_state)
             self._last_time = time.monotonic()
-            return self.is_chief
+            # True on EVERY process: all of them did real work here (the
+            # shard fetch + file write + pre-manifest barrier), so the
+            # loop's DrainMeter must be re-marked everywhere or non-chief
+            # processes fold checkpoint time into their images/sec
+            # windows.
+            return True
         # Collective fetch BEFORE the chief check: with tensor-parallel
         # state on a multi-host mesh the gather is a collective, so every
         # process participates; only the chief touches the filesystem.
@@ -369,7 +377,11 @@ class CheckpointManager:
             # duration against the next interval, turning any
             # every_secs shorter than one save into a checkpoint storm.
             self._last_time = time.monotonic()
-            return False
+            # True like the sharded path: the collective fetch was real
+            # time spent on this process too, so the caller's DrainMeter
+            # must be re-marked here as well (the return value means
+            # "this process did save work", not "this process wrote").
+            return True
         if self.async_save:
             self.flush()  # ordered writes + surface prior errors
             self._pending = self._pool.submit(

@@ -115,9 +115,18 @@ def write_shard_file(ckpt_path: str, payload: Dict[str, list]) -> str:
 
 
 def write_manifest(ckpt_path: str, state: Any) -> None:
-    """Chief-only commit marker: global shapes/dtypes + shard-file set."""
+    """Chief-only commit marker: global shapes/dtypes + shard-file set.
+
+    ``shard_files`` is the EXACT file list restore may read: a crashed
+    (uncommitted) save can leave stale ``shard_*.msgpack`` from a larger
+    process count in the same dir, and an elastic restart that re-reaches
+    the step would otherwise commit a manifest whose restore sees too many
+    files. Enumerating the files in the commit record makes stale
+    leftovers inert."""
     meta = {
         "process_count": jax.process_count(),
+        "shard_files": [f"shard_{p}.msgpack"
+                        for p in range(jax.process_count())],
         "leaves": {
             # .shape/.dtype are metadata — safe even on non-addressable
             # multi-host arrays (np.asarray would NOT be). Plain host
@@ -161,14 +170,28 @@ def restore_sharded(ckpt_path: str, target: Any) -> Any:
     with open(os.path.join(ckpt_path, MANIFEST)) as f:
         meta = json.load(f)
     shards: Dict[str, list] = {}
-    files = sorted(f for f in os.listdir(ckpt_path)
-                   if f.startswith("shard_") and f.endswith(".msgpack"))
-    expect = meta["process_count"]
-    if len(files) != expect:
+    # Read ONLY the files the manifest committed (older manifests without
+    # the list fall back to the glob + count check): stale shard files
+    # from a crashed save at a different process count must not poison a
+    # validly committed checkpoint.
+    files = meta.get("shard_files")
+    if files is None:
+        files = sorted(f for f in os.listdir(ckpt_path)
+                       if f.startswith("shard_") and f.endswith(".msgpack"))
+        expect = meta["process_count"]
+        if len(files) != expect:
+            raise ValueError(
+                f"sharded checkpoint {ckpt_path} has {len(files)} shard "
+                f"files but was written by {expect} processes — incomplete "
+                f"save or unreachable filesystem (every process must see "
+                f"--log_dir)")
+    missing = [f for f in files
+               if not os.path.exists(os.path.join(ckpt_path, f))]
+    if missing:
         raise ValueError(
-            f"sharded checkpoint {ckpt_path} has {len(files)} shard files "
-            f"but was written by {expect} processes — incomplete save or "
-            f"unreachable filesystem (every process must see --log_dir)")
+            f"sharded checkpoint {ckpt_path} is missing manifest-listed "
+            f"shard files {missing} — incomplete save or unreachable "
+            f"filesystem (every process must see --log_dir)")
     for fname in files:
         with open(os.path.join(ckpt_path, fname), "rb") as f:
             part = serialization.msgpack_restore(f.read())
@@ -184,17 +207,24 @@ def restore_sharded(ckpt_path: str, target: Any) -> Any:
                 f"{ckpt_path} (config mismatch with the run that wrote "
                 f"it?)")
         full = np.empty(tuple(info["shape"]), dtype=np.dtype(info["dtype"]))
-        filled = 0
+        # Boolean coverage mask: catches holes AND overlaps. Summing
+        # element counts would let a duplicated entry mask a hole —
+        # filled == size while some elements hold np.empty garbage.
+        seen = np.zeros(full.shape, dtype=bool)
         for e in shards[path]:
             idx = tuple(slice(int(s), int(t)) for s, t in
                         np.asarray(e["index"], dtype=np.int64))
+            if seen[idx].any():
+                raise ValueError(
+                    f"leaf {path!r} has overlapping shard entries at "
+                    f"{e['index']} in {ckpt_path} — corrupt or hand-merged "
+                    f"shard files")
             full[idx] = e["data"]
-            filled += int(np.prod([t - s for s, t in e["index"]])) \
-                if len(e["index"]) else 1
-        if filled < full.size:
+            seen[idx] = True
+        if not seen.all():
             raise ValueError(
-                f"leaf {path!r} only {filled}/{full.size} elements "
-                f"covered by shard files in {ckpt_path}")
+                f"leaf {path!r} only {int(seen.sum())}/{full.size} "
+                f"elements covered by shard files in {ckpt_path}")
         return full
 
     target_paths = {path for path, _ in _leaf_paths(target)}

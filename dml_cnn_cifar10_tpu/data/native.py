@@ -76,6 +76,24 @@ def load_library() -> ctypes.CDLL:
         if _needs_build():
             _build_library()
         lib = ctypes.CDLL(_LIB_PATH)
+        # ABI handshake before any argtypes are trusted: the mtime-based
+        # rebuild heuristic can miss (prebuilt .so shipped without
+        # sources, or mtimes not newer), and a stale library would
+        # silently mis-bind recordio_create's arguments — e.g. dropping
+        # label_wide decodes imagenet_synth labels as their low byte
+        # only: silently wrong training data.
+        expected_abi = 2
+        try:
+            lib.recordio_abi_version.restype = ctypes.c_int64
+            got = int(lib.recordio_abi_version())
+        except AttributeError:
+            got = 1  # pre-versioning builds had no such symbol
+        if got != expected_abi:
+            raise RuntimeError(
+                f"librecordio.so ABI v{got} != expected v{expected_abi} "
+                f"at {_LIB_PATH}: stale prebuilt library — rebuild with "
+                f"`make -C runtime` (or delete the .so to rebuild on "
+                f"demand)")
         lib.recordio_create.restype = ctypes.c_void_p
         lib.recordio_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,

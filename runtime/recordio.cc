@@ -141,6 +141,15 @@ void decode_into(const Loader* L, const uint8_t* rec, uint8_t* images,
 
 extern "C" {
 
+// Bump on ANY C-ABI change (argument added/removed/reordered, struct
+// layout, semantics of a flag). native.py verifies this at load time:
+// a prebuilt .so that survived a source change (mtime heuristics can
+// miss, e.g. sources absent on a deploy host) must fail loudly instead
+// of silently mis-binding arguments.
+//   v2: recordio_create grew the label_wide argument (imagenet_synth
+//       2-byte big-endian labels).
+int64_t recordio_abi_version(void) { return 2; }
+
 // paths: NUL-separated concatenation of n_files file paths.
 // label_wide != 0: the 2 leading bytes are one big-endian uint16 label
 // (imagenet_synth framing, class counts past 255).

@@ -383,3 +383,57 @@ def test_sharded_config_mismatch_raises(tmp_path):
                              fmt="sharded")
     with pytest.raises(ValueError, match="different"):
         ckpt_lib.restore_checkpoint(str(tmp_path), _state())
+
+
+def test_sharded_stale_shard_files_are_inert(tmp_path):
+    """ADVICE r2 (medium): a crashed save at a larger process count can
+    leave extra shard_*.msgpack next to a later, validly committed save.
+    The manifest records the exact shard-file list, so restore must
+    ignore the stale file instead of failing the count check."""
+    state = _state()
+    ckpt_lib.save_checkpoint(str(tmp_path), state, step=3, fmt="sharded")
+    ckpt_dir = os.path.join(str(tmp_path), "ckpt_3.sharded")
+    # A leftover from a hypothetical crashed 2-process attempt.
+    with open(os.path.join(ckpt_dir, "shard_1.msgpack"), "wb") as f:
+        f.write(b"stale garbage from a crashed larger-cluster save")
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), _state(seed=9))
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_overlapping_entries_raise(tmp_path):
+    """ADVICE r2: duplicated shard entries must not mask holes — coverage
+    is a boolean mask, and overlap is as fatal as shortfall."""
+    from flax import serialization
+
+    from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
+
+    state = _state()
+    ckpt_lib.save_checkpoint(str(tmp_path), state, step=1, fmt="sharded")
+    ckpt_dir = os.path.join(str(tmp_path), "ckpt_1.sharded")
+    shard_file = os.path.join(ckpt_dir, "shard_0.msgpack")
+    with open(shard_file, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    # Duplicate the first leaf's first entry: same index range twice.
+    path0 = sorted(payload)[0]
+    entries = payload[path0]
+    entries = (list(entries.values()) if isinstance(entries, dict)
+               else list(entries))
+    payload[path0] = entries + [entries[0]]
+    with open(shard_file, "wb") as f:
+        f.write(serialization.msgpack_serialize(payload))
+    with pytest.raises(ValueError, match="overlap"):
+        sharded_lib.restore_sharded(ckpt_dir, _state(seed=4))
+
+
+def test_sharded_manifest_missing_listed_file_raises(tmp_path):
+    """The inverse of stale-file tolerance: a manifest-listed shard file
+    that vanished (partial copy between filesystems) must fail loudly."""
+    from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
+
+    state = _state()
+    ckpt_lib.save_checkpoint(str(tmp_path), state, step=2, fmt="sharded")
+    ckpt_dir = os.path.join(str(tmp_path), "ckpt_2.sharded")
+    os.remove(os.path.join(ckpt_dir, "shard_0.msgpack"))
+    with pytest.raises(ValueError, match="missing manifest-listed"):
+        sharded_lib.restore_sharded(ckpt_dir, _state(seed=4))

@@ -167,3 +167,25 @@ def test_wide_label_decode_parity(tmp_path):
     it.close()
     assert seen <= want
     assert max(seen) > 255
+
+
+def test_stale_abi_fails_loudly(tmp_path, monkeypatch):
+    """ADVICE r2: a prebuilt .so that predates an ABI change must be
+    rejected at load (the mtime rebuild heuristic can miss, e.g. sources
+    absent on a deploy host) — silently mis-bound arguments would decode
+    wrong training data."""
+    import subprocess
+
+    from dml_cnn_cifar10_tpu.data import native
+
+    src = tmp_path / "stub.cc"
+    # A v1-era library: has entry points but no recordio_abi_version.
+    src.write_text('extern "C" { void* recordio_create() { return 0; } }\n')
+    so = tmp_path / "librecordio.so"
+    subprocess.run(["g++", "-shared", "-fPIC", "-o", str(so), str(src)],
+                   check=True, capture_output=True)
+    monkeypatch.setattr(native, "_LIB_PATH", str(so))
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_needs_build", lambda: False)
+    with pytest.raises(RuntimeError, match="ABI v1 != expected"):
+        native.load_library()
