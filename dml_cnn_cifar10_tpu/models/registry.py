@@ -22,6 +22,12 @@ class ModelDef(NamedTuple):
     # shards over the ``seq`` mesh axis (GSPMD inserts conv/pool halo
     # exchanges). ViTs use ``seq`` for token/sequence parallelism instead.
     spatial: bool = False
+    # Models that lax.scan their layer stack report ~1/depth of their
+    # FLOPs to XLA cost analysis (the scan body is counted once). This
+    # optional hook — (model_cfg, data_cfg, microbatch) -> (depth,
+    # bf_counted, bf_true) — gives the loop the per-block numbers to
+    # correct the TFLOP/s metric (vit.block_flops_probe).
+    stack_probe: Callable | None = None
 
 
 def _cnn() -> ModelDef:
@@ -53,7 +59,8 @@ def _vit() -> ModelDef:
                 "name 'vit_moe' (its aux loss and expert sharding rules)")
         return vit.init_params(key, model_cfg, data_cfg)
 
-    return ModelDef(init, vit.apply, lambda p: {}, False, wants_mesh=True)
+    return ModelDef(init, vit.apply, lambda p: {}, False, wants_mesh=True,
+                    stack_probe=vit.block_flops_probe)
 
 
 def _vit_moe() -> ModelDef:
@@ -67,7 +74,8 @@ def _vit_moe() -> ModelDef:
         return vit.init_params(key, model_cfg, data_cfg)
 
     return ModelDef(init, vit.apply_with_aux, lambda p: {}, False,
-                    wants_mesh=True, has_aux=True)
+                    wants_mesh=True, has_aux=True,
+                    stack_probe=vit.block_flops_probe)
 
 
 MODELS = {

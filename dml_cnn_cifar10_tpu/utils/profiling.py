@@ -98,6 +98,30 @@ def compiled_flops(jitted_fn, abstract_args) -> Optional[float]:
         return None
 
 
+def correct_stack_flops(f: float, depth: int, bf_counted: Optional[float],
+                        bf_true: Optional[float]):
+    """Fix a step's cost-analysis FLOPs for a lax.scan-ned layer stack →
+    ``(corrected_flops, label)``.
+
+    XLA counts a scan body once, so a depth-D stacked model reports
+    ~1/D of its stack FLOPs; Pallas kernels are opaque custom calls
+    counted as 0. Given one block's standalone measurements —
+    ``bf_counted`` (as the step runs it) and ``bf_true``
+    (dense-equivalent, fully counted) — swap the counted contribution
+    for the true cost at full depth. ``f < 2·bf_counted`` discriminates
+    scan-once (the body appears ~once in ``f``) from an unrolled /
+    per-iteration count (it appears ~depth times). Returns the input
+    unchanged with label ``probe_failed`` when the block numbers are
+    unusable — the caller must then NOT publish the (known ~1/depth
+    wrong) figure as honest.
+    """
+    if not (depth and depth > 1 and bf_counted and bf_true):
+        return f, "probe_failed"
+    if f < 2 * bf_counted:
+        return f - bf_counted + depth * bf_true, f"scan_once_x{depth}"
+    return f + depth * (bf_true - bf_counted), "per_iteration"
+
+
 @contextlib.contextmanager
 def profile_trace(log_dir: Optional[str]):
     """Capture an XLA profiler trace into ``log_dir`` when set."""
