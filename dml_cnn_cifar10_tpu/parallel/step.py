@@ -552,10 +552,13 @@ def make_eval_resident(
     data_cfg: DataConfig,
     state_sharding: Optional[TrainState] = None,
     batch_size: int = 128,
+    num_shards: int = 1,
+    total_records: Optional[int] = None,
+    expected_batches: Optional[int] = None,
 ):
     """Full-split eval in ONE dispatch against an HBM-resident split:
-    returns ``(fn, total)`` with ``fn(state) -> correct count`` (device
-    scalar) over all ``total`` real records.
+    returns ``(fn, total)`` with ``fn(state) -> GLOBAL correct count``
+    (device scalar, replicated) over all ``total`` real records.
 
     The split is padded to a whole number of batches (pad labels -1 ⇒ 0
     correct, mirroring ``full_sweep_padded``), reshaped ``[M, B, ...]``,
@@ -563,13 +566,44 @@ def make_eval_resident(
     the M batches. Replaces M host-fed eval dispatches + M device→host
     fetches per eval with one dispatch + one fetch — decisive when
     host↔device round trips are ~100 ms (remote-tunnel TPU).
+
+    Multi-host (``num_shards`` > 1): ``images_u8``/``labels`` are THIS
+    process's strided shard and ``batch_size`` its per-process share of
+    the global eval batch. Every process pads to the same batch count
+    ``M = ceil(ceil(total/num_shards)/batch_size)`` (strided shards
+    differ by ≤1 record — same rule as ``full_sweep_padded``) and
+    contributes its slice of the global ``[M, B_global, ...]`` arrays
+    (``place_local``); the replicated output scalar IS the global
+    correct count (GSPMD inserts the cross-data-axis reduction), so one
+    dispatch + one ``device_get`` per process covers the whole split —
+    round 2's multi-host host-fed fallback (M H2D uploads per eval) is
+    gone.
     """
     import numpy as np
 
     from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
 
-    n = images_u8.shape[0]
-    m = -(-n // batch_size)
+    n = images_u8.shape[0]                       # local shard size
+    if num_shards > 1 and total_records is None:
+        # m derived from the LOCAL shard would differ across processes
+        # (strided shards differ by 1 record) → mismatched global arrays
+        # and a hang instead of an error. Fail at build time.
+        raise ValueError(
+            "make_eval_resident with num_shards > 1 needs total_records "
+            "(the pre-shard split size) so every process pads to the "
+            "same batch count")
+    total = int(total_records) if total_records is not None else n
+    largest_shard = -(-total // max(num_shards, 1))
+    m = -(-largest_shard // batch_size)
+    if expected_batches is not None and m != expected_batches:
+        # The iterator's padded-sweep rule
+        # (pipeline.num_padded_sweep_batches) and this one must agree —
+        # the host-fed and resident paths count correctness over the
+        # same geometry, and multi-host correctness needs every process
+        # on the same M.
+        raise ValueError(
+            f"resident eval computed {m} padded batches but the "
+            f"iterator's sweep rule says {expected_batches}")
     pad = m * batch_size - n
     if pad:
         images_u8 = np.concatenate(
@@ -599,9 +633,9 @@ def make_eval_resident(
     lab_sh = mesh_lib.batch_sharding(mesh, 2, leading_dims=1)
     jitted = jax.jit(ev, in_shardings=(data_sh, lab_sh, state_sh),
                      out_shardings=repl)
-    ims_d = jax.device_put(ims, data_sh)
-    lbs_d = jax.device_put(lbs, lab_sh)
-    return functools.partial(jitted, ims_d, lbs_d), n
+    ims_d = mesh_lib.place_local(data_sh, ims)
+    lbs_d = mesh_lib.place_local(lab_sh, lbs)
+    return functools.partial(jitted, ims_d, lbs_d), total
 
 
 def make_batch_eval_resident(

@@ -296,15 +296,20 @@ class Trainer:
                 self.model_def, cfg.model, self.mesh, ds_images, ds_labels,
                 cfg.data, state_sharding=self.state_sharding)
             if cfg.eval_full_test_set:
-                if num_shards == 1:
-                    self._resident_full_eval = step_lib.make_eval_resident(
-                        self.model_def, cfg.model, self.mesh,
-                        test_it.images, test_it.labels, cfg.data,
-                        state_sharding=self.state_sharding,
-                        batch_size=per_process_batch)
-                # Multi-host full sweeps stay host-fed: they are already
-                # O(1) fetches, and the padded per-shard geometry does
-                # not map onto one replicated split cleanly.
+                # Multi-host included (round 3): each process contributes
+                # its padded strided shard as its slice of the global
+                # [M, B, ...] arrays; the scan's replicated output is the
+                # GLOBAL correct count — one dispatch + one fetch per
+                # eval on every process (the host-fed fallback cost M
+                # per-batch H2D uploads per eval).
+                self._resident_full_eval = step_lib.make_eval_resident(
+                    self.model_def, cfg.model, self.mesh,
+                    test_it.images, test_it.labels, cfg.data,
+                    state_sharding=self.state_sharding,
+                    batch_size=per_process_batch,
+                    num_shards=num_shards,
+                    total_records=test_it.total_records,
+                    expected_batches=test_it.num_padded_sweep_batches())
             else:
                 t_imgs, t_lbls = _full_split_arrays(
                     test_it, lambda: pipe.input_pipeline(

