@@ -122,10 +122,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(ViT transformer blocks / ResNet residual "
                         "blocks; activation memory O(1) in depth)")
     p.add_argument("--pipe_axis", type=int, default=1,
-                   help="pipeline-parallel mesh degree (GPipe stages)")
+                   help="pipeline-parallel mesh degree (stages; schedule "
+                        "per --pipe_schedule)")
+    p.add_argument("--pipe_schedule", type=str, default="1f1b",
+                   choices=["1f1b", "gpipe"],
+                   help="pipeline schedule: 1f1b (no bubble compute, "
+                        "O(P) backward memory) or gpipe (round-2 "
+                        "baseline)")
     p.add_argument("--pipe_microbatches", type=int, default=0,
-                   help="GPipe microbatches per step (0 = one per stage); "
-                        "more microbatches shrink the bubble fraction "
+                   help="pipeline microbatches per step (0 = one per "
+                        "stage). More microbatches shrink 1f1b's live "
+                        "activation footprint AND gpipe's bubble fraction "
                         "(M+P-1)/M at the cost of smaller per-microbatch "
                         "compute")
     p.add_argument("--moe_experts", type=int, default=0,
@@ -306,7 +313,16 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
             f"--pipe_microbatches={args.pipe_microbatches} requires "
             f"--pipe_axis > 1 (got {args.pipe_axis}); without a pipe "
             f"axis there is no schedule to microbatch")
+    if args.pipe_schedule != "1f1b" and args.pipe_axis <= 1:
+        # Mirror the --pipe_microbatches guard: without a pipe axis the
+        # sequential fast path runs and a requested gpipe schedule would
+        # be silently ignored — reject instead of mislabeling a bench.
+        raise SystemExit(
+            f"--pipe_schedule={args.pipe_schedule} requires --pipe_axis "
+            f"> 1 (got {args.pipe_axis}); without a pipe axis there is "
+            f"no schedule to select")
     cfg.model.pipe_microbatches = args.pipe_microbatches
+    cfg.model.pipe_schedule = args.pipe_schedule
     if args.moe_experts and args.model != "vit_moe":
         raise SystemExit(
             f"--moe_experts requires --model vit_moe (got {args.model})")

@@ -136,6 +136,11 @@ class ModelConfig:
     # exchange for microbatches 1/4 the size. The global batch must be
     # divisible by data_axis * M.
     pipe_microbatches: int = 0
+    # Pipeline schedule: "1f1b" (default — bubbles skipped, backward
+    # memory O(P) via the interleaved recompute schedule) or "gpipe"
+    # (the round-2 baseline: always-on stage compute, autodiff through
+    # the scan; kept for comparison benches — parallel/pipeline.py).
+    pipe_schedule: str = "1f1b"
     # Mixture-of-Experts (model name "vit_moe"): every block's MLP becomes
     # a routed expert bank (ops/moe.py) — moe_top_k=1 Switch routing,
     # 2 GShard — with experts sharded over the ``model`` mesh axis

@@ -229,7 +229,8 @@ def apply_with_aux(params: Params, images: jax.Array, cfg: ModelConfig,
             stage_fn = jax.checkpoint(stage_fn)
         x = pipeline.pipeline_blocks(
             x, p["blocks"], stage_fn, mesh,
-            num_microbatches=cfg.pipe_microbatches or None)
+            num_microbatches=cfg.pipe_microbatches or None,
+            schedule=cfg.pipe_schedule)
     else:
         def block_fn(h, bp):
             return _block(h, bp, cfg.vit_heads,

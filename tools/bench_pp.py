@@ -1,12 +1,18 @@
 #!/usr/bin/env python
-"""Pipeline-parallel vs data-parallel benchmark (VERDICT round-1 #7).
+"""Pipeline-parallel vs data-parallel benchmark (round-1 #7; round-3 1F1B).
 
 Times the full ViT training step at a fixed global batch over several
 mesh layouts on the 8-virtual-device CPU mesh (the only multi-device
-substrate on this box — one real TPU chip cannot host a pipe axis).
+substrate on this box — one real TPU chip cannot host a pipe axis), and
+reads the compiled step's TEMP-ALLOCATION bytes from XLA's memory
+analysis — the live-activation footprint the 1F1B schedule exists to cap.
+
 CPU timings are a schedule-overhead proxy, not TPU absolute numbers:
-they expose the GPipe bubble ((M+P-1)/M extra stage-compute) and the
-ppermute/psum traffic, which is what the layout decision rides on.
+they expose the bubble compute (skipped by 1F1B, burned by GPipe) and
+the ppermute/psum traffic, which is what the layout decision rides on.
+The memory column is geometry, not timing, so it transfers to TPU
+directly: GPipe-autodiff's saved scan carries grow O(M); 1F1B's ring
+buffer is O(P), flat in M.
 
 Usage: python tools/bench_pp.py [--steps 8] [--batch 32] [--depth 8]
 Prints one markdown table.
@@ -47,15 +53,26 @@ def time_layout(name, pcfg, model_cfg, batch, steps):
     im = rng.normal(0.5, 0.25, (batch, 16, 16, 3)).astype(np.float32)
     lb = rng.integers(0, 10, batch).astype(np.int32)
     im, lb = mesh_lib.shard_batch(mesh, im, lb)
-    state, m = train(state, im, lb)         # compile + warm
+    # Temp bytes of the compiled step: the transient (activation/workspace)
+    # footprint — where the GPipe-vs-1F1B memory story shows up.
+    # One AOT compile serves both the memory probe and the timed loop
+    # (calling the jitted fn would compile the same program a second
+    # time — the AOT path has its own executable cache).
+    compiled = train.lower(state, im, lb).compile()
+    temp_mb = None
+    try:
+        temp_mb = compiled.memory_analysis().temp_size_in_bytes / 2**20
+    except Exception:
+        pass
+    state, m = compiled(state, im, lb)      # warm
     jax.block_until_ready(m["loss"])
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, m = train(state, im, lb)
+        state, m = compiled(state, im, lb)
     jax.block_until_ready(m["loss"])
     dt = (time.perf_counter() - t0) / steps
     loss = float(jax.device_get(m["loss"]))
-    return name, dt * 1e3, batch / dt, loss
+    return name, dt * 1e3, batch / dt, temp_mb, loss
 
 
 def main():
@@ -68,15 +85,17 @@ def main():
     base = dict(name="vit_tiny", pool="mean", logit_relu=False,
                 vit_depth=args.depth, vit_dim=64, vit_heads=2, patch_size=4,
                 use_pallas_attention=False)
+    dp2pp4 = ParallelConfig(data_axis=2, pipe_axis=4)
     layouts = [
         ("dp=8", ParallelConfig(data_axis=8), ModelConfig(**base)),
-        ("dp=4 x pp=2 (M=P)", ParallelConfig(data_axis=4, pipe_axis=2),
-         ModelConfig(**base)),
-        ("dp=4 x pp=2 (M=4P)", ParallelConfig(data_axis=4, pipe_axis=2),
-         ModelConfig(**base, pipe_microbatches=8)),
-        ("dp=2 x pp=4 (M=P)", ParallelConfig(data_axis=2, pipe_axis=4),
-         ModelConfig(**base)),
-        ("dp=2 x pp=4 (M=4P)", ParallelConfig(data_axis=2, pipe_axis=4),
+        ("dp=4 x pp=2 1f1b (M=P)",
+         ParallelConfig(data_axis=4, pipe_axis=2), ModelConfig(**base)),
+        ("dp=2 x pp=4 gpipe (M=P)", dp2pp4,
+         ModelConfig(**base, pipe_schedule="gpipe")),
+        ("dp=2 x pp=4 1f1b (M=P)", dp2pp4, ModelConfig(**base)),
+        ("dp=2 x pp=4 gpipe (M=4P)", dp2pp4,
+         ModelConfig(**base, pipe_schedule="gpipe", pipe_microbatches=16)),
+        ("dp=2 x pp=4 1f1b (M=4P)", dp2pp4,
          ModelConfig(**base, pipe_microbatches=16)),
     ]
     rows = [time_layout(n, pc, mc, args.batch, args.steps)
@@ -84,10 +103,12 @@ def main():
     ref = rows[0][1]
     print(f"\nViT depth={args.depth} dim=64 global batch={args.batch}, "
           f"{args.steps} timed steps, 8 virtual CPU devices\n")
-    print("| layout | step ms | images/sec | vs dp=8 | final loss |")
-    print("|---|---|---|---|---|")
-    for name, ms, ips, loss in rows:
-        print(f"| {name} | {ms:.1f} | {ips:.0f} | {ref / ms:.2f}x | "
+    print("| layout | step ms | images/sec | temp MiB | vs dp=8 | "
+          "final loss |")
+    print("|---|---|---|---|---|---|")
+    for name, ms, ips, temp, loss in rows:
+        t = f"{temp:.0f}" if temp is not None else "n/a"
+        print(f"| {name} | {ms:.1f} | {ips:.0f} | {t} | {ref / ms:.2f}x | "
               f"{loss:.4f} |")
 
 
