@@ -46,10 +46,20 @@ def conv2d(x: jax.Array, kernel: jax.Array, stride: int = 1,
 
 def max_pool(x: jax.Array, window: int = 3, stride: int = 2,
              padding: str = "SAME") -> jax.Array:
-    """Max pool over NHWC spatial dims via ``lax.reduce_window``."""
+    """Max pool over NHWC spatial dims via ``lax.reduce_window``.
+
+    Backward is XLA's select-and-scatter. Round-3 note (BASELINE.md
+    ResNet-50 profile): that op is ~5% of the bf16 224² train step, and a
+    hand-written 9-shift compare-mask-pad VJP was implemented and
+    MEASURED WORSE (-27% step time — the f32 grad accumulator makes 9
+    full passes over the 112² activation grid, far more HBM traffic than
+    the generic scatter). The default stays; the experiment is recorded
+    so it isn't retried blind.
+    """
     return lax.reduce_window(
         x,
-        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min,
         lax.max,
         window_dimensions=(1, window, window, 1),
         window_strides=(1, stride, stride, 1),
@@ -92,13 +102,16 @@ def batch_norm(
     collective.
 
     Returns ``(y, new_state)``; ``new_state`` equals ``state`` in eval.
-    Stats and normalization run in f32 regardless of compute dtype (bf16
-    batch stats lose too much precision); output is cast back to
-    ``x.dtype`` so train and eval emit the same dtype downstream.
+    The STATISTICS (mean/var, running stats) are computed in f32
+    regardless of compute dtype — bf16 batch stats lose too much
+    precision — but the per-element normalize runs in ``x.dtype``
+    (round 3: BN's epilogue is memory-bound and the f32 upcast doubled
+    its HBM traffic; see BASELINE.md's ResNet-50 profile). Output dtype
+    == input dtype in train and eval.
     """
     axes = tuple(range(x.ndim - 1))
-    xf = x.astype(jnp.float32)
     if train:
+        xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axes)
         mean_sq = jnp.mean(jnp.square(xf), axes)
         if axis_name is not None:
@@ -116,8 +129,15 @@ def batch_norm(
         mean, var = state["mean"], state["var"]
         new_state = state
     inv = lax.rsqrt(var + eps) * params["scale"].astype(jnp.float32)
-    y = (xf - mean) * inv + params["offset"].astype(jnp.float32)
-    return y.astype(x.dtype), new_state
+    # Normalize in the COMPUTE dtype: the statistics stay f32 (above —
+    # bf16 batch stats lose too much precision) but the per-element
+    # normalize chain runs at the activation width. BN's epilogue is
+    # memory-bound, so in bf16 this halves its HBM traffic; for f32
+    # activations the casts are no-ops and the math is unchanged.
+    cdt = x.dtype
+    y = (x - mean.astype(cdt)) * inv.astype(cdt) \
+        + params["offset"].astype(cdt)
+    return y, new_state
 
 
 def bn_init(width: int, dtype=jnp.float32):
