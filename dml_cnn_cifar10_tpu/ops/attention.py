@@ -42,7 +42,11 @@ def mask_scores(scores: jax.Array, q_len: int, kv_len: int,
         col = jnp.arange(kv_len)[None, :]
         scores = jnp.where(col <= row, scores, NEG_INF)
     if segment_ids is not None:
-        same = (segment_ids[:, :, None] == segment_ids[:, None, :])
+        if isinstance(segment_ids, (tuple, list)):
+            q_seg, kv_seg = segment_ids
+        else:
+            q_seg = kv_seg = segment_ids
+        same = (q_seg[:, :, None] == kv_seg[:, None, :])
         scores = jnp.where(same[:, None, :, :], scores, NEG_INF)
     return scores
 

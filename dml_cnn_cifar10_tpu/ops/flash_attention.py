@@ -262,6 +262,19 @@ def _seg_lane(seg, block):
     return seg
 
 
+def _norm_segments(segment_ids):
+    """``None`` | ``[B, S]`` (self-attention) | ``(q_seg, kv_seg)``
+    (cross/sharded attention — ring blocks see different shards) →
+    ``(q_seg, kv_seg)`` int32 or ``(None, None)``."""
+    if segment_ids is None:
+        return None, None
+    if isinstance(segment_ids, (tuple, list)):
+        q_seg, kv_seg = segment_ids
+        return q_seg.astype(jnp.int32), kv_seg.astype(jnp.int32)
+    seg = segment_ids.astype(jnp.int32)
+    return seg, seg
+
+
 def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
               mode: str, segment_ids=None):
     """Shared forward pallas_call builder.
@@ -293,14 +306,14 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
     ]
     inputs = [qb, kb_, vb]
     if has_seg:
-        seg = segment_ids.astype(jnp.int32)
+        q_seg, kv_seg = _norm_segments(segment_ids)
         # Segment ids are per (batch, position) — the index maps fold the
         # head out of the grid's batch·head axis.
         in_specs += [
             pl.BlockSpec((1, bq, 128), lambda g, i, j: (g // h, i, 0)),
             pl.BlockSpec((1, bk), lambda g, i, j: (g // h, j)),
         ]
-        inputs += [_seg_tile(seg, bq), _seg_lane(seg, bk)]
+        inputs += [_seg_tile(q_seg, bq), _seg_lane(kv_seg, bk)]
 
     o_spec = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
     stat_spec = pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0))
@@ -498,12 +511,12 @@ def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
                 stat_spec_i]
     inputs = [qb, kb_, vb, dob, lse_t, delta_t]
     if has_seg:
-        seg = segment_ids.astype(jnp.int32)
+        q_seg, kv_seg = _norm_segments(segment_ids)
         in_specs += [
             pl.BlockSpec((1, bq, 128), lambda g, i, j: (g // h, i, 0)),
             pl.BlockSpec((1, bk), lambda g, i, j: (g // h, j)),
         ]
-        inputs += [_seg_tile(seg, bq), _seg_lane(seg, bk)]
+        inputs += [_seg_tile(q_seg, bq), _seg_lane(kv_seg, bk)]
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **kw),
@@ -576,9 +589,9 @@ def _flash_bwd_rule(scale, block_q, block_k, interpret, causal, res, do):
                                      interpret=interpret, causal=causal,
                                      segment_ids=segment_ids)
     # Integer segment ids carry no gradient: float0 cotangent (None stays
-    # None — it's an empty pytree).
-    dseg = None if segment_ids is None else np.zeros(
-        segment_ids.shape, jax.dtypes.float0)
+    # None — it's an empty pytree; tuples map per-leaf).
+    dseg = jax.tree.map(
+        lambda s: np.zeros(s.shape, jax.dtypes.float0), segment_ids)
     return dq, dk, dv, dseg
 
 
@@ -605,7 +618,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     the diagonal and skips fully-masked blocks. ``segment_ids`` [B, S]
     int32 restricts attention to same-segment pairs (packed sequences) in
     both directions; combine with ``causal`` for packed causal LM
-    batches.
+    batches. A ``(q_seg [B, Sq], kv_seg [B, Skv])`` pair serves
+    cross-shard callers (the ring walks K/V shards whose ids differ from
+    the local Q shard's).
     """
     scale, block_q, block_k, interpret = _resolve(
         q, scale, block_q, block_k, interpret)

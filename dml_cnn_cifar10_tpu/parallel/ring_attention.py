@@ -47,13 +47,14 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NEG_INF = -1e30
 
 
-def _block_stats(q, k, v, scale, causal=False):
+def _block_stats(q, k, v, scale, causal=False, segment_ids=None):
     """One blockwise attention piece → (m, l, unnormalized acc).
 
     q: [B,Sq,H,D]; k,v: [B,Sk,H,D]. Returns per-row stats for the online
@@ -66,7 +67,8 @@ def _block_stats(q, k, v, scale, causal=False):
 
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    s = mask_scores(s, q.shape[1], k.shape[1], causal=causal)
+    s = mask_scores(s, q.shape[1], k.shape[1], causal=causal,
+                    segment_ids=segment_ids)
     m = jnp.max(s, axis=-1, keepdims=True)            # [B,H,Sq,1]
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)            # [B,H,Sq,1]
@@ -86,7 +88,7 @@ def _merge(m1, l1, a1, m2, l2, a2):
     return m, l, a1 * wa1 + a2 * wa2
 
 
-def _block_stats_pallas(q, k, v, scale, causal=False):
+def _block_stats_pallas(q, k, v, scale, causal=False, segment_ids=None):
     """The same ``(m, l, acc)`` partials as :func:`_block_stats`, computed
     by the Pallas flash kernel (``flash_attention_stats``): the local
     S/seq × S/seq block runs blocked on the MXU with the score matrix
@@ -94,13 +96,15 @@ def _block_stats_pallas(q, k, v, scale, causal=False):
     from dml_cnn_cifar10_tpu.ops import flash_attention as fa
 
     acc, m, l = fa.flash_attention_stats(q, k, v, scale=scale,
-                                         causal=causal)
+                                         causal=causal,
+                                         segment_ids=segment_ids)
     m_ = jnp.transpose(m, (0, 2, 1))[..., None]       # [B,H,Sq,1]
     l_ = jnp.transpose(l, (0, 2, 1))[..., None]
     return m_, l_, acc                                # acc already f32
 
 
-def _block_bwd_jnp(q, k, v, do, lse, delta, scale, causal=False):
+def _block_bwd_jnp(q, k, v, do, lse, delta, scale, causal=False,
+                   segment_ids=None):
     """FlashAttention-2 block backward in plain jnp (the short-shard twin
     of ``ops.flash_attention.flash_attention_bwd``): rebuild the block's
     scores, recover exact probabilities from the global ``lse``
@@ -113,7 +117,8 @@ def _block_bwd_jnp(q, k, v, do, lse, delta, scale, causal=False):
     vf = v.astype(jnp.float32)
     dof = do.astype(jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
-    s = mask_scores(s, q.shape[1], k.shape[1], causal=causal)
+    s = mask_scores(s, q.shape[1], k.shape[1], causal=causal,
+                    segment_ids=segment_ids)
     lse_t = jnp.transpose(lse, (0, 2, 1))[..., None]      # [B,H,Sq,1]
     delta_t = jnp.transpose(delta, (0, 2, 1))[..., None]  # [B,H,Sq,1]
     p = jnp.exp(s - lse_t)                                # exact probs
@@ -151,56 +156,67 @@ def _causal_switch(src, my, full, diag, skip):
 # ---------------------------------------------------------------------------
 
 
-def _ring_fwd_scan(q, k, v, axis_name, scale, use_pallas, causal):
+def _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas, causal):
     nsteps = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     stats = _block_stats_pallas if use_pallas else _block_stats
     perm = _ring_perm(nsteps)
+    # Segment ids are sequence-sharded like Q; the K/V shard's ids must
+    # travel the ring WITH it (a visiting shard's positions keep their
+    # home segments). ~2 bytes/token of extra ppermute traffic.
+    kv_seg0 = seg
 
     def body(carry, t):
-        k, v, m, l, acc = carry
+        k, v, kv_seg, m, l, acc = carry
         src = (my - t) % nsteps          # home index of the held shard
+        pair = None if seg is None else (seg, kv_seg)
 
         if causal:
             bm, bl, bacc = _causal_switch(
                 src, my,
-                lambda _: stats(q, k, v, scale, causal=False),
-                lambda _: stats(q, k, v, scale, causal=True),
+                lambda _: stats(q, k, v, scale, causal=False,
+                                segment_ids=pair),
+                lambda _: stats(q, k, v, scale, causal=True,
+                                segment_ids=pair),
                 lambda _: _zero_partials(b, h, sq, d))
         else:
-            bm, bl, bacc = stats(q, k, v, scale)
+            bm, bl, bacc = stats(q, k, v, scale, segment_ids=pair)
         m, l, acc = _merge(m, l, acc, bm, bl, bacc)
         # Rotate K/V one ring hop (neighbor ppermute over ICI). The final
         # rotation returns the shards to their home device, so the carry
         # stays consistent for any caller that reuses K/V.
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
-        return (k, v, m, l, acc), None
+        if kv_seg is not None:
+            kv_seg = lax.ppermute(kv_seg, axis_name, perm)
+        return (k, v, kv_seg, m, l, acc), None
 
     m0, l0, a0 = _zero_partials(b, h, sq, d)
-    (k, v, m, l, acc), _ = lax.scan(
-        body, (k, v, m0, l0, a0), jnp.arange(nsteps))
+    (k, v, _, m, l, acc), _ = lax.scan(
+        body, (k, v, kv_seg0, m0, l0, a0), jnp.arange(nsteps))
     out = (acc / jnp.transpose(l, (0, 2, 1, 3))).astype(q.dtype)
     lse = jnp.transpose((m + jnp.log(l))[..., 0], (0, 2, 1))  # [B,Sq,H]
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_core(q, k, v, axis_name, scale, use_pallas, causal):
-    out, _ = _ring_fwd_scan(q, k, v, axis_name, scale, use_pallas, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_core(q, k, v, seg, axis_name, scale, use_pallas, causal):
+    out, _ = _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas,
+                            causal)
     return out
 
 
-def _ring_core_fwd(q, k, v, axis_name, scale, use_pallas, causal):
-    out, lse = _ring_fwd_scan(q, k, v, axis_name, scale, use_pallas, causal)
-    return out, (q, k, v, out, lse)
+def _ring_core_fwd(q, k, v, seg, axis_name, scale, use_pallas, causal):
+    out, lse = _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas,
+                              causal)
+    return out, (q, k, v, seg, out, lse)
 
 
 def _ring_core_bwd(axis_name, scale, use_pallas, causal, res, do):
     from dml_cnn_cifar10_tpu.ops import flash_attention as fa
 
-    q, k, v, out, lse = res
+    q, k, v, seg, out, lse = res
     nsteps = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     delta = fa.attention_delta(out, do)               # [B,Sq,H] f32
@@ -211,28 +227,30 @@ def _ring_core_bwd(axis_name, scale, use_pallas, causal, res, do):
     # before the cross-step accumulation, matching the jnp twin); the
     # carry accumulates in f32 and casts once at the end.
     if use_pallas:
-        def block_bwd(k_, v_, causal_local):
+        def block_bwd(k_, v_, causal_local, pair):
             return fa.flash_attention_bwd(q, k_, v_, do, lse, delta,
                                           scale=scale, causal=causal_local,
-                                          out_dtype=jnp.float32)
+                                          out_dtype=jnp.float32,
+                                          segment_ids=pair)
     else:
-        def block_bwd(k_, v_, causal_local):
+        def block_bwd(k_, v_, causal_local, pair):
             return _block_bwd_jnp(q, k_, v_, do, lse, delta, scale,
-                                  causal=causal_local)
+                                  causal=causal_local, segment_ids=pair)
 
     def body(carry, t):
-        k, v, dk, dv, dq = carry
+        k, v, kv_seg, dk, dv, dq = carry
         src = (my - t) % nsteps
+        pair = None if seg is None else (seg, kv_seg)
 
         if causal:
             dq_c, dk_c, dv_c = _causal_switch(
                 src, my,
-                lambda _: block_bwd(k, v, False),
-                lambda _: block_bwd(k, v, True),
+                lambda _: block_bwd(k, v, False, pair),
+                lambda _: block_bwd(k, v, True, pair),
                 lambda _: (jnp.zeros_like(dq), jnp.zeros_like(dk),
                            jnp.zeros_like(dv)))
         else:
-            dq_c, dk_c, dv_c = block_bwd(k, v, False)
+            dq_c, dk_c, dv_c = block_bwd(k, v, False, pair)
         dq = dq + dq_c
         # dK/dV partials travel WITH the visiting shard: after n hops they
         # have collected a contribution on every device and are home.
@@ -240,16 +258,21 @@ def _ring_core_bwd(axis_name, scale, use_pallas, causal, res, do):
         dv = dv + dv_c
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
+        if kv_seg is not None:
+            kv_seg = lax.ppermute(kv_seg, axis_name, perm)
         dk = lax.ppermute(dk, axis_name, perm)
         dv = lax.ppermute(dv, axis_name, perm)
-        return (k, v, dk, dv, dq), None
+        return (k, v, kv_seg, dk, dv, dq), None
 
     dq0 = jnp.zeros(q.shape, jnp.float32)
     dk0 = jnp.zeros(k.shape, jnp.float32)
     dv0 = jnp.zeros(v.shape, jnp.float32)
-    (k, v, dk, dv, dq), _ = lax.scan(
-        body, (k, v, dk0, dv0, dq0), jnp.arange(nsteps))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    (k, v, _, dk, dv, dq), _ = lax.scan(
+        body, (k, v, seg, dk0, dv0, dq0), jnp.arange(nsteps))
+    dseg = jax.tree.map(
+        lambda s: np.zeros(s.shape, jax.dtypes.float0), seg)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dseg)
 
 
 _ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
@@ -258,7 +281,9 @@ _ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                          axis_name: str, scale: Optional[float] = None,
                          use_pallas: bool = False,
-                         causal: bool = False) -> jax.Array:
+                         causal: bool = False,
+                         segment_ids: Optional[jax.Array] = None
+                         ) -> jax.Array:
     """Per-device body: runs under ``shard_map`` with Q/K/V sequence-sharded
     on ``axis_name``. Shapes [B, S_local, H, D] → [B, S_local, H, D].
 
@@ -267,10 +292,12 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     local block through the flash kernels when the local shard is long
     enough to benefit (same ≥128 threshold as ``dispatch_attention``);
     ``causal`` masks the global lower triangle and skips above-diagonal
-    ring steps entirely."""
+    ring steps entirely. ``segment_ids`` is THIS shard's [B, S_local]
+    slice of the packed-sequence ids; visiting K/V shards bring their
+    own ids around the ring."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _ring_core(q, k, v, axis_name, float(scale),
+    return _ring_core(q, k, v, segment_ids, axis_name, float(scale),
                       bool(use_pallas and q.shape[1] >= 128), bool(causal))
 
 
@@ -298,15 +325,19 @@ def sp_partition_spec(mesh: Mesh, axis_name: str, seq_len: int,
 
 
 def sp_shard_map(local_fn, mesh: Mesh, axis_name: str, seq_len: int,
-                 num_heads: int):
+                 num_heads: int, with_segments: bool = False):
     """Wrap an SP-local attention body in the standard shard_map: one
-    ``(q, k, v) -> out`` callable with all tensors laid out per
-    :func:`sp_partition_spec`."""
+    ``(q, k, v[, segment_ids]) -> out`` callable with all tensors laid
+    out per :func:`sp_partition_spec` (segment ids, when present, shard
+    ``[B, S]`` as ``(data, axis_name)`` — the same sequence split)."""
     spec, _ = sp_partition_spec(mesh, axis_name, seq_len, num_heads)
+    in_specs = (spec, spec, spec)
+    if with_segments:
+        in_specs += (P("data", axis_name),)
     return jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=in_specs,
         out_specs=spec,
         check_vma=False,
     )
@@ -316,20 +347,31 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    scale: Optional[float] = None,
                    axis_name: str = "seq",
                    use_pallas: bool = False,
-                   causal: bool = False) -> jax.Array:
+                   causal: bool = False,
+                   segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Sequence-parallel attention over the mesh's ``seq`` axis.
 
     Global-view entrypoint: [B, S, H, D] arrays (sharded or not); S must be
     divisible by the ``seq`` axis size. Batch stays sharded on ``data`` so
     dp × sp compose. ``use_pallas`` runs each local block on the Pallas
     flash kernels (long-shard configs); ``causal`` applies the global
-    lower-triangular mask with above-diagonal ring steps skipped.
+    lower-triangular mask with above-diagonal ring steps skipped;
+    ``segment_ids`` [B, S] int32 (global view, sharded like the sequence)
+    restricts attention to same-segment pairs — packed sequences through
+    the ring.
     """
-    fn = sp_shard_map(
-        functools.partial(ring_attention_local, axis_name=axis_name,
-                          scale=scale, use_pallas=use_pallas, causal=causal),
-        mesh, axis_name, q.shape[1], q.shape[2])
-    return fn(q, k, v)
+    kw = dict(axis_name=axis_name, scale=scale, use_pallas=use_pallas,
+              causal=causal)
+    if segment_ids is None:
+        local = functools.partial(ring_attention_local, **kw)
+        args = (q, k, v)
+    else:
+        def local(q, k, v, seg):
+            return ring_attention_local(q, k, v, segment_ids=seg, **kw)
+        args = (q, k, v, segment_ids.astype(jnp.int32))
+    fn = sp_shard_map(local, mesh, axis_name, q.shape[1], q.shape[2],
+                      with_segments=segment_ids is not None)
+    return fn(*args)
 
 
 def sequence_sharding(mesh: Mesh) -> NamedSharding:

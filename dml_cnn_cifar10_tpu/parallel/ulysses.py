@@ -29,6 +29,7 @@ import functools
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
 
@@ -44,7 +45,9 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                             axis_name: str,
                             scale: Optional[float] = None,
                             use_pallas: bool = False,
-                            causal: bool = False) -> jax.Array:
+                            causal: bool = False,
+                            segment_ids: Optional[jax.Array] = None
+                            ) -> jax.Array:
     """Per-device body under ``shard_map``: Q/K/V sequence-sharded
     ``[B, S_local, H, D]`` → out ``[B, S_local, H, D]``.
 
@@ -57,14 +60,21 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     n = lax.axis_size(axis_name)
     if n == 1:
         return attn.dispatch_attention(q, k, v, use_pallas=use_pallas,
-                                       scale=scale, causal=causal)
+                                       scale=scale, causal=causal,
+                                       segment_ids=segment_ids)
+    if segment_ids is not None:
+        # Per-position ids are tiny (~2 B/token): all-gather the
+        # sequence-sharded ids so the post-all-to-all full-sequence
+        # kernel masks exactly.
+        segment_ids = lax.all_gather(segment_ids, axis_name, axis=1,
+                                     tiled=True)
     # [B, S/n, H, D] -> [B, S, H/n, D]: split the head dim over the axis,
     # concatenate the sequence dim. tiled=True keeps the dims in place.
     q, k, v = (
         lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
         for t in (q, k, v))
     o = attn.dispatch_attention(q, k, v, use_pallas=use_pallas, scale=scale,
-                                causal=causal)
+                                causal=causal, segment_ids=segment_ids)
     # [B, S, H/n, D] -> [B, S/n, H, D]
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
@@ -74,7 +84,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                       scale: Optional[float] = None,
                       axis_name: str = "seq",
                       use_pallas: bool = False,
-                      causal: bool = False) -> jax.Array:
+                      causal: bool = False,
+                      segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Sequence-parallel attention via head/sequence all-to-all.
 
     Global-view entrypoint, same contract as
@@ -94,8 +105,15 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
             f"{local_heads} per-device heads not divisible by seq axis "
             f"{nseq}; use ring attention for head counts the axis can't "
             f"split")
-    fn = sp_shard_map(
-        functools.partial(ulysses_attention_local, axis_name=axis_name,
-                          scale=scale, use_pallas=use_pallas, causal=causal),
-        mesh, axis_name, q.shape[1], q.shape[2])
-    return fn(q, k, v)
+    kw = dict(axis_name=axis_name, scale=scale, use_pallas=use_pallas,
+              causal=causal)
+    if segment_ids is None:
+        local = functools.partial(ulysses_attention_local, **kw)
+        args = (q, k, v)
+    else:
+        def local(q, k, v, seg):
+            return ulysses_attention_local(q, k, v, segment_ids=seg, **kw)
+        args = (q, k, v, segment_ids.astype(jnp.int32))
+    fn = sp_shard_map(local, mesh, axis_name, q.shape[1], q.shape[2],
+                      with_segments=segment_ids is not None)
+    return fn(*args)
