@@ -157,7 +157,7 @@ def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
             s.shape, kv_len=kv_len, q_len=q_len, row0=ib * block_q,
             col0=kb * block_k, causal=causal,
             qseg=None if qseg_ref is None else qseg_ref[0][:, :1],
-            kseg=None if kseg_ref is None else kseg_ref[0][None, :])
+            kseg=None if kseg_ref is None else kseg_ref[0, :1])
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, :1]                                   # [bq, 1]
@@ -254,12 +254,33 @@ def _seg_tile(seg, block):
 
 
 def _seg_lane(seg, block):
-    """[B, S] int32 → [B, S_padded] K-side lane vector (padded cols are
-    already killed by the kv_len mask)."""
+    """[B, S] int32 → [B, 8, S_padded] K-side lane layout (padded cols
+    are already killed by the kv_len mask). The middle dim exists purely
+    for TPU tiling: a (1, bk) block of a [B, S] array has a sublane dim
+    of 1, which Mosaic rejects for B > 1 (must be divisible by 8 or the
+    full dim); an 8-row broadcast makes the block (1, 8, bk) — legal,
+    and only row 0 is ever read."""
     pad = (-seg.shape[1]) % block
     if pad:
         seg = jnp.pad(seg, ((0, 0), (0, pad)), constant_values=-1)
-    return seg
+    return jnp.broadcast_to(seg[:, None, :],
+                            (seg.shape[0], 8, seg.shape[1]))
+
+
+def _kv_clamp(causal, bq, bk):
+    """K/V block-index map component for (…, q_block i, k_block j) grids.
+
+    Causal grids never read blocks strictly above the diagonal (the
+    kernels guard compute with ``pl.when``), but Pallas still issues the
+    operand DMA for every grid step — UNLESS the block index repeats, in
+    which case the pipeline skips the re-fetch. Clamping the index into
+    the live triangle makes every dead iteration a repeat of the last
+    live one: skipped ticks become fetch-free, which is most of the
+    causal saving at long S (BASELINE.md measured the unclamped skip at
+    only 1.1–1.33×)."""
+    if not causal:
+        return lambda i, j: j
+    return lambda i, j: jnp.minimum(j, (i * bq + bq - 1) // bk)
 
 
 def _norm_segments(segment_ids):
@@ -299,10 +320,11 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
 
     kw = dict(scale=scale, kv_len=kv_len, q_len=s, block_q=bq, block_k=bk,
               causal=causal, has_segments=has_seg)
+    kvc = _kv_clamp(causal, bq, bk)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda g, i, j: (g, kvc(i, j), 0)),
+        pl.BlockSpec((1, bk, d), lambda g, i, j: (g, kvc(i, j), 0)),
     ]
     inputs = [qb, kb_, vb]
     if has_seg:
@@ -311,7 +333,8 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
         # head out of the grid's batch·head axis.
         in_specs += [
             pl.BlockSpec((1, bq, 128), lambda g, i, j: (g // h, i, 0)),
-            pl.BlockSpec((1, bk), lambda g, i, j: (g // h, j)),
+            pl.BlockSpec((1, 8, bk),
+                         lambda g, i, j: (g // h, 0, kvc(i, j))),
         ]
         inputs += [_seg_tile(q_seg, bq), _seg_lane(kv_seg, bk)]
 
@@ -380,7 +403,7 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s.shape, kv_len=kv_len, q_len=q_len, row0=row0, col0=col0,
         causal=causal,
         qseg=None if qseg_ref is None else qseg_ref[0][:, :1],
-        kseg=None if kseg_ref is None else kseg_ref[0][None, :])
+        kseg=None if kseg_ref is None else kseg_ref[0, :1])
     s = jnp.where(mask, s, NEG_INF)
 
     p = jnp.exp(s - lse)                  # [bq, bk], true probabilities
@@ -503,8 +526,9 @@ def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
     has_seg = segment_ids is not None
     kw = dict(scale=scale, kv_len=kv_len, q_len=s, block_q=bq, block_k=bk,
               causal=causal, has_segments=has_seg)
+    kvc = _kv_clamp(causal, bq, bk)
     q_spec_i = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
-    kv_spec_j = pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0))
+    kv_spec_j = pl.BlockSpec((1, bk, d), lambda g, i, j: (g, kvc(i, j), 0))
     stat_spec_i = pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0))
 
     in_specs = [q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, stat_spec_i,
@@ -514,7 +538,8 @@ def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
         q_seg, kv_seg = _norm_segments(segment_ids)
         in_specs += [
             pl.BlockSpec((1, bq, 128), lambda g, i, j: (g // h, i, 0)),
-            pl.BlockSpec((1, bk), lambda g, i, j: (g // h, j)),
+            pl.BlockSpec((1, 8, bk),
+                         lambda g, i, j: (g // h, 0, kvc(i, j))),
         ]
         inputs += [_seg_tile(q_seg, bq), _seg_lane(kv_seg, bk)]
 
@@ -528,15 +553,30 @@ def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
         interpret=interpret,
     )(*inputs)
 
-    # dK/dV grid: k blocks outer, q blocks inner (fastest).
-    q_spec = pl.BlockSpec((1, bq, d), lambda g, j, i: (g, i, 0))
+    # dK/dV grid: k blocks outer, q blocks inner (fastest). Causal live
+    # region is i >= ceil((j·bk − bq + 1)/bq) = (j·bk)//bq; clamping the
+    # q-side maps into it makes the dead head of each j-row fetch-free
+    # (same repeat-index trick as the forward).
+    if causal:
+        def qc(j, i):
+            # Bounded above by the last q block: with kv_len > q_len the
+            # trailing k rows have NO live q block at all, and the raw
+            # max() would index past the q array on those fully-dead
+            # j-rows.
+            return jnp.minimum(nq - 1, jnp.maximum(i, (j * bk) // bq))
+    else:
+        def qc(j, i):
+            return i
+    q_spec = pl.BlockSpec((1, bq, d), lambda g, j, i: (g, qc(j, i), 0))
     kv_spec = pl.BlockSpec((1, bk, d), lambda g, j, i: (g, j, 0))
-    stat_spec = pl.BlockSpec((1, bq, 128), lambda g, j, i: (g, i, 0))
+    stat_spec = pl.BlockSpec((1, bq, 128),
+                             lambda g, j, i: (g, qc(j, i), 0))
     in_specs2 = [q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec]
     if has_seg:
         in_specs2 += [
-            pl.BlockSpec((1, bq, 128), lambda g, j, i: (g // h, i, 0)),
-            pl.BlockSpec((1, bk), lambda g, j, i: (g // h, j)),
+            pl.BlockSpec((1, bq, 128),
+                         lambda g, j, i: (g // h, qc(j, i), 0)),
+            pl.BlockSpec((1, 8, bk), lambda g, j, i: (g // h, 0, j)),
         ]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **kw),

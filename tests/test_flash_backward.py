@@ -251,3 +251,22 @@ def test_sp_attention_segment_ids(sp_mode, causal):
     g_ref = _grads(lambda q, k, v: attn.xla_attention(
         q, k, v, causal=causal, segment_ids=seg), q, k, v)
     _assert_close(g, g_ref, atol=5e-5)
+
+
+def test_cross_length_causal_bwd():
+    """kv_len > q_len with causal: trailing K rows have no live Q block,
+    and the dK/dV q-side index clamp must stay in range on those
+    fully-dead grid rows (review r3 edge case)."""
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (2, 200, 2, 32))
+    k = jax.random.normal(ks[1], (2, 512, 2, 32))
+    v = jax.random.normal(ks[2], (2, 512, 2, 32))
+    out, lse = fa.flash_attention_fwd_lse(q, k, v, causal=True)
+    ref = attn.xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-6)
+    do = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+    delta = fa.attention_delta(out, do)
+    grads = fa.flash_attention_bwd(q, k, v, do, lse, delta, causal=True)
+    _, vjp = jax.vjp(
+        lambda q, k, v: attn.xla_attention(q, k, v, causal=True), q, k, v)
+    _assert_close(grads, vjp(do), atol=5e-6)
