@@ -30,17 +30,28 @@ NEG_INF = -1e30  # finite: exp(-inf - -inf) would NaN a fully-masked row
 
 def mask_scores(scores: jax.Array, q_len: int, kv_len: int,
                 causal: bool = False,
-                segment_ids: jax.Array | None = None) -> jax.Array:
+                segment_ids: jax.Array | None = None,
+                window: int | None = None) -> jax.Array:
     """Apply the shared attention-validity mask to dense ``[..., Sq, Sk]``
     scores (jnp counterpart of the flash kernels' ``_score_mask``): causal
     keeps col ≤ row; segment_ids [B, S] keep same-segment pairs only
     (``scores`` must then be [B, H, Sq, Sk]). One definition, used by the
     XLA reference path and the ring's jnp block engines, so the masking
     semantics can't drift between the parity-tested implementations."""
+    if window is not None and window < 1:
+        # Same contract as the flash path: a non-positive window would
+        # silently mask EVERY score and softmax would emit uniform
+        # garbage.
+        raise ValueError(f"window must be >= 1, got {window}")
+    row = jnp.arange(q_len)[:, None]
+    col = jnp.arange(kv_len)[None, :]
     if causal:
-        row = jnp.arange(q_len)[:, None]
-        col = jnp.arange(kv_len)[None, :]
         scores = jnp.where(col <= row, scores, NEG_INF)
+    if window is not None:
+        band = col > row - window
+        if not causal:
+            band = band & (col < row + window)
+        scores = jnp.where(band, scores, NEG_INF)
     if segment_ids is not None:
         if isinstance(segment_ids, (tuple, list)):
             q_seg, kv_seg = segment_ids
@@ -54,7 +65,8 @@ def mask_scores(scores: jax.Array, q_len: int, kv_len: int,
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                   scale: float | None = None,
                   causal: bool = False,
-                  segment_ids: jax.Array | None = None) -> jax.Array:
+                  segment_ids: jax.Array | None = None,
+                  window: int | None = None) -> jax.Array:
     """softmax(q kᵀ · scale) v over [B, S, H, D] tensors.
 
     Computed in float32 regardless of input dtype (softmax in bf16 loses
@@ -68,7 +80,7 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kf = k.astype(jnp.float32)
     scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
     scores = mask_scores(scores, q.shape[1], k.shape[1], causal=causal,
-                         segment_ids=segment_ids)
+                         segment_ids=segment_ids, window=window)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
@@ -78,7 +90,8 @@ def dispatch_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                        use_pallas: bool = False,
                        scale: float | None = None,
                        causal: bool = False,
-                       segment_ids: jax.Array | None = None) -> jax.Array:
+                       segment_ids: jax.Array | None = None,
+                       window: int | None = None) -> jax.Array:
     """Pick the attention impl: Pallas flash kernel when asked for and the
     sequence is long enough to benefit; XLA fused attention otherwise.
     Both paths differentiate (the flash path via its custom_vjp backward
@@ -87,6 +100,6 @@ def dispatch_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if use_pallas and seq >= 128:
         from dml_cnn_cifar10_tpu.ops import flash_attention as fa
         return fa.flash_attention(q, k, v, scale=scale, causal=causal,
-                                  segment_ids=segment_ids)
+                                  segment_ids=segment_ids, window=window)
     return xla_attention(q, k, v, scale=scale, causal=causal,
-                         segment_ids=segment_ids)
+                         segment_ids=segment_ids, window=window)

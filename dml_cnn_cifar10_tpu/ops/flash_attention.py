@@ -114,26 +114,34 @@ def _stat_to_tile(x, block):
 
 
 def _score_mask(shape, *, kv_len, q_len, row0, col0, causal,
-                qseg=None, kseg=None):
+                qseg=None, kseg=None, window=None):
     """The shared validity mask for one [bq, bk] score block: padded K/V
     columns off; optionally causal (col ≤ row in global coordinates);
-    optionally same-segment only (packed sequences). Padded Q rows
-    (row ≥ q_len) are *exempt* from the segment mask so every padded row
-    keeps l > 0 — their lse stays finite, and their gradient contributions
-    vanish anyway because dO is zero-padded."""
+    optionally same-segment only (packed sequences); optionally a
+    sliding window (band |row − col| < window; with causal only the
+    lower half remains — Mistral-style local attention). Padded Q rows
+    (row ≥ q_len) are *exempt* from the segment and window masks so
+    every padded row keeps l > 0 — their lse stays finite, and their
+    gradient contributions vanish anyway because dO is zero-padded."""
     col = col0 + lax.broadcasted_iota(jnp.int32, shape, 1)
     mask = col < kv_len
     row = row0 + lax.broadcasted_iota(jnp.int32, shape, 0)
+    pad_row = row >= q_len
     if causal:
         mask = mask & (col <= row)
+    if window is not None:
+        band = col > row - window
+        if not causal:
+            band = band & (col < row + window)
+        mask = mask & (band | pad_row)
     if qseg is not None:
-        mask = mask & ((qseg == kseg) | (row >= q_len))
+        mask = mask & ((qseg == kseg) | pad_row)
     return mask
 
 
 def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
                   scale: float, kv_len: int, q_len: int, block_q: int,
-                  block_k: int, causal: bool,
+                  block_k: int, causal: bool, window=None,
                   qseg_ref=None, kseg_ref=None):
     """One K/V-block update of the running (m, l, acc) — shared by the
     plain, lse-emitting, and stats-emitting kernels."""
@@ -155,7 +163,7 @@ def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
                             preferred_element_type=jnp.float32) * scale
         mask = _score_mask(
             s.shape, kv_len=kv_len, q_len=q_len, row0=ib * block_q,
-            col0=kb * block_k, causal=causal,
+            col0=kb * block_k, causal=causal, window=window,
             qseg=None if qseg_ref is None else qseg_ref[0][:, :1],
             kseg=None if kseg_ref is None else kseg_ref[0, :1])
         s = jnp.where(mask, s, NEG_INF)
@@ -171,11 +179,10 @@ def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
             preferred_element_type=jnp.float32)
         m_scr[:, :1] = m_cur
 
-    if causal:
-        # Skip K/V blocks strictly above the diagonal: their whole score
-        # block would be masked. First col of block kb vs last row of
-        # block ib.
-        @pl.when(kb * block_k <= ib * block_q + block_q - 1)
+    live = _band_live(ib * block_q, block_q, kb * block_k, block_k,
+                      causal, window)
+    if live is not None:
+        @pl.when(live)
         def _live():
             _update()
     else:
@@ -196,6 +203,15 @@ def _unpack(refs, n_out, has_segments, n_base=3):
     return ins, outs, scratch
 
 
+def _safe_l(l_col):
+    """Guard against fully-dead rows (every block skipped — possible when
+    a window/cross-length geometry leaves a row with no keys): l stays 0
+    there, and the plain division would emit NaN that poisons the
+    backward. Any live element contributes exp(0)=1, so l >= 1 whenever
+    a row has keys; dead rows divide by 1 and output exact zeros."""
+    return jnp.maximum(l_col, 1e-30)
+
+
 def _flash_kernel(*refs, has_segments: bool = False, **kw):
     (q_ref, k_ref, v_ref, qseg_ref, kseg_ref), (o_ref,), \
         (m_scr, l_scr, acc_scr) = _unpack(refs, 1, has_segments)
@@ -204,7 +220,7 @@ def _flash_kernel(*refs, has_segments: bool = False, **kw):
 
     @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] / _safe_l(l_scr[:, :1])).astype(o_ref.dtype)
 
 
 def _flash_fwd_kernel(*refs, has_segments: bool = False, **kw):
@@ -217,10 +233,15 @@ def _flash_fwd_kernel(*refs, has_segments: bool = False, **kw):
 
     @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] / _safe_l(l_scr[:, :1])).astype(o_ref.dtype)
         # Lane cols 1..127 hold -inf-ish garbage (NEG_INF + log 0); only
-        # col 0 is ever read back.
-        lse_ref[0] = m_scr[:] + jnp.log(l_scr[:])
+        # col 0 is ever read back. Fully-dead rows (l == 0) publish a
+        # LARGE lse so the backward's p = exp(s − lse) is exactly 0 —
+        # their arbitrary outputs must not leak gradient into other
+        # rows' dK/dV accumulators.
+        lse = jnp.where(l_scr[:] > 0.0, m_scr[:] + jnp.log(_safe_l(l_scr[:])),
+                        1e30)
+        lse_ref[0] = lse
 
 
 def _flash_stats_kernel(*refs, has_segments: bool = False, **kw):
@@ -267,20 +288,51 @@ def _seg_lane(seg, block):
                             (seg.shape[0], 8, seg.shape[1]))
 
 
-def _kv_clamp(causal, bq, bk):
+def _kv_clamp(causal, bq, bk, window=None, nk=None):
     """K/V block-index map component for (…, q_block i, k_block j) grids.
 
-    Causal grids never read blocks strictly above the diagonal (the
+    Causal/windowed grids never read blocks outside the live band (the
     kernels guard compute with ``pl.when``), but Pallas still issues the
     operand DMA for every grid step — UNLESS the block index repeats, in
     which case the pipeline skips the re-fetch. Clamping the index into
-    the live triangle makes every dead iteration a repeat of the last
-    live one: skipped ticks become fetch-free, which is most of the
-    causal saving at long S (BASELINE.md measured the unclamped skip at
-    only 1.1–1.33×)."""
-    if not causal:
+    the live band makes every dead iteration a repeat of a live one:
+    skipped ticks become fetch-free, which is most of the saving at long
+    S (BASELINE.md measured the unclamped causal skip at only
+    1.1–1.33× vs 1.4–1.55× clamped)."""
+    if not causal and window is None:
         return lambda i, j: j
-    return lambda i, j: jnp.minimum(j, (i * bq + bq - 1) // bk)
+
+    def clamp(i, j):
+        out = j
+        if causal:
+            out = jnp.minimum(out, (i * bq + bq - 1) // bk)
+        elif window is not None:
+            out = jnp.minimum(out, (i * bq + bq - 1 + window - 1) // bk)
+        if window is not None:
+            out = jnp.maximum(out, (i * bq - window + 1) // bk)
+        # Bound into the K/V block range: q_len > kv_len leaves some
+        # q blocks with no live K/V block at all, and an unbounded clamp
+        # would index past the array on those fully-dead rows.
+        return jnp.clip(out, 0, nk - 1)
+
+    return clamp
+
+
+def _band_live(row0, rows, col0, cols, causal, window):
+    """Block-liveness predicate for a [rows, cols] score block whose
+    top-left is global (row0, col0): does the block intersect the valid
+    causal/window band? None when nothing can be skipped. ONE definition
+    for all three kernels (fwd, dQ, dK/dV) so the skip logic cannot
+    drift from ``_score_mask``'s element mask."""
+    live = None
+    if causal:
+        live = col0 <= row0 + rows - 1
+    if window is not None:
+        lo = col0 + cols - 1 > row0 - window
+        live = lo if live is None else live & lo
+        if not causal:
+            live = live & (col0 < row0 + rows - 1 + window)
+    return live
 
 
 def _norm_segments(segment_ids):
@@ -297,7 +349,7 @@ def _norm_segments(segment_ids):
 
 
 def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
-              mode: str, segment_ids=None):
+              mode: str, segment_ids=None, window=None):
     """Shared forward pallas_call builder.
 
     mode: "out" → out; "lse" → (out, lse [B,S,H]);
@@ -319,8 +371,8 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
     has_seg = segment_ids is not None
 
     kw = dict(scale=scale, kv_len=kv_len, q_len=s, block_q=bq, block_k=bk,
-              causal=causal, has_segments=has_seg)
-    kvc = _kv_clamp(causal, bq, bk)
+              causal=causal, window=window, has_segments=has_seg)
+    kvc = _kv_clamp(causal, bq, bk, window=window, nk=nk)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
         pl.BlockSpec((1, bk, d), lambda g, i, j: (g, kvc(i, j), 0)),
@@ -386,7 +438,7 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
 
 def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                qseg_ref, kseg_ref, *, scale, kv_len, q_len, row0, col0,
-               causal):
+               causal, window=None):
     """Rebuild one score block and its softmax-Jacobian products:
     returns ``(p, ds, do_f32)`` with ``p = exp(s − lse)`` the exact
     softmax probabilities and ``ds = p ∘ (dp − delta) · scale``."""
@@ -401,7 +453,7 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         preferred_element_type=jnp.float32) * scale
     mask = _score_mask(
         s.shape, kv_len=kv_len, q_len=q_len, row0=row0, col0=col0,
-        causal=causal,
+        causal=causal, window=window,
         qseg=None if qseg_ref is None else qseg_ref[0][:, :1],
         kseg=None if kseg_ref is None else kseg_ref[0, :1])
     s = jnp.where(mask, s, NEG_INF)
@@ -415,7 +467,7 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dq_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
-                         causal, has_segments=False):
+                         causal, window=None, has_segments=False):
     """Grid (b·h, q_blocks, k_blocks): dQ_i = Σ_j dS_ij K_j (scale folded
     into dS)."""
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
@@ -432,13 +484,15 @@ def _flash_bwd_dq_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
                               delta_ref, qseg_ref, kseg_ref, scale=scale,
                               kv_len=kv_len, q_len=q_len,
                               row0=ib * block_q, col0=jb * block_k,
-                              causal=causal)
+                              causal=causal, window=window)
         dq_scr[:] += lax.dot_general(
             ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(jb * block_k <= ib * block_q + block_q - 1)
+    live = _band_live(ib * block_q, block_q, jb * block_k, block_k,
+                      causal, window)
+    if live is not None:
+        @pl.when(live)
         def _live():
             _compute()
     else:
@@ -450,7 +504,7 @@ def _flash_bwd_dq_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
 
 
 def _flash_bwd_dkv_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
-                          causal, has_segments=False):
+                          causal, window=None, has_segments=False):
     """Grid (b·h, k_blocks, q_blocks): dV_j = Σ_i P_ijᵀ dO_i and
     dK_j = Σ_i dS_ijᵀ Q_i (scale folded into dS). Padded Q rows contribute
     exactly zero because their dO rows are zero-padded."""
@@ -469,17 +523,20 @@ def _flash_bwd_dkv_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
                                delta_ref, qseg_ref, kseg_ref, scale=scale,
                                kv_len=kv_len, q_len=q_len,
                                row0=ib * block_q, col0=jb * block_k,
-                               causal=causal)
+                               causal=causal, window=window)
         dv_scr[:] += lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
         dk_scr[:] += lax.dot_general(
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        # Live iff the block intersects the lower triangle: last row of
-        # Q block ib reaches col jb·bk.
-        @pl.when(ib * block_q + block_q - 1 >= jb * block_k)
+    # Same band, transposed view: the block is live iff its row range
+    # intersects the k block's attended-row band — which is exactly the
+    # q-major predicate with the same coordinates.
+    live = _band_live(ib * block_q, block_q, jb * block_k, block_k,
+                      causal, window)
+    if live is not None:
+        @pl.when(live)
         def _live():
             _compute()
     else:
@@ -494,7 +551,7 @@ def _flash_bwd_dkv_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
 def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
                         block_q=None, block_k=None, interpret=None,
                         causal: bool = False, out_dtype=None,
-                        segment_ids=None):
+                        segment_ids=None, window=None):
     """The flash backward as a standalone op: ``(dq, dk, dv)`` from saved
     forward state. ``lse``/``delta`` are [B, S, H] f32 — the row logsumexp
     from the forward and ``rowsum(dO ∘ O)``. Exposed (not just wired into
@@ -525,8 +582,8 @@ def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
 
     has_seg = segment_ids is not None
     kw = dict(scale=scale, kv_len=kv_len, q_len=s, block_q=bq, block_k=bk,
-              causal=causal, has_segments=has_seg)
-    kvc = _kv_clamp(causal, bq, bk)
+              causal=causal, window=window, has_segments=has_seg)
+    kvc = _kv_clamp(causal, bq, bk, window=window, nk=nk)
     q_spec_i = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
     kv_spec_j = pl.BlockSpec((1, bk, d), lambda g, i, j: (g, kvc(i, j), 0))
     stat_spec_i = pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0))
@@ -557,13 +614,21 @@ def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
     # region is i >= ceil((j·bk − bq + 1)/bq) = (j·bk)//bq; clamping the
     # q-side maps into it makes the dead head of each j-row fetch-free
     # (same repeat-index trick as the forward).
-    if causal:
+    if causal or window is not None:
         def qc(j, i):
-            # Bounded above by the last q block: with kv_len > q_len the
-            # trailing k rows have NO live q block at all, and the raw
-            # max() would index past the q array on those fully-dead
-            # j-rows.
-            return jnp.minimum(nq - 1, jnp.maximum(i, (j * bk) // bq))
+            # Bounded into [0, nq-1]: with kv_len > q_len the trailing k
+            # rows have NO live q block at all, and an unbounded clamp
+            # would index past the q array on those fully-dead j-rows.
+            out = i
+            if causal:
+                out = jnp.maximum(out, (j * bk) // bq)
+            elif window is not None:
+                out = jnp.maximum(
+                    out, jnp.maximum(0, (j * bk - window + 1) // bq))
+            if window is not None:
+                out = jnp.minimum(
+                    out, (j * bk + bk - 1 + window - 1) // bq)
+            return jnp.clip(out, 0, nq - 1)
     else:
         def qc(j, i):
             return i
@@ -605,21 +670,23 @@ def attention_delta(o, do):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _flash(q, k, v, segment_ids, scale, block_q, block_k, interpret,
-           causal):
+           causal, window):
     return _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
-                     mode="out", segment_ids=segment_ids)
+                     mode="out", segment_ids=segment_ids, window=window)
 
 
 def _flash_fwd_rule(q, k, v, segment_ids, scale, block_q, block_k,
-                    interpret, causal):
+                    interpret, causal, window):
     out, lse = _fwd_call(q, k, v, scale, block_q, block_k, interpret,
-                         causal, mode="lse", segment_ids=segment_ids)
+                         causal, mode="lse", segment_ids=segment_ids,
+                         window=window)
     return out, (q, k, v, segment_ids, out, lse)
 
 
-def _flash_bwd_rule(scale, block_q, block_k, interpret, causal, res, do):
+def _flash_bwd_rule(scale, block_q, block_k, interpret, causal, window,
+                    res, do):
     import numpy as np
 
     q, k, v, segment_ids, out, lse = res
@@ -627,7 +694,8 @@ def _flash_bwd_rule(scale, block_q, block_k, interpret, causal, res, do):
     dq, dk, dv = flash_attention_bwd(q, k, v, do, lse, delta, scale=scale,
                                      block_q=block_q, block_k=block_k,
                                      interpret=interpret, causal=causal,
-                                     segment_ids=segment_ids)
+                                     segment_ids=segment_ids,
+                                     window=window)
     # Integer segment ids carry no gradient: float0 cotangent (None stays
     # None — it's an empty pytree; tuples map per-leaf).
     dseg = jax.tree.map(
@@ -640,14 +708,15 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "block_q", "block_k",
-                                    "interpret", "causal"))
+                                    "interpret", "causal", "window"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     scale: float | None = None,
                     block_q: int | None = None,
                     block_k: int | None = None,
                     interpret: bool | None = None,
                     causal: bool = False,
-                    segment_ids: jax.Array | None = None) -> jax.Array:
+                    segment_ids: jax.Array | None = None,
+                    window: int | None = None) -> jax.Array:
     """FlashAttention over [B, S, H, D] tensors → [B, S, H, D].
 
     Contract-identical to :func:`ops.attention.xla_attention` (including
@@ -660,24 +729,30 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     both directions; combine with ``causal`` for packed causal LM
     batches. A ``(q_seg [B, Sq], kv_seg [B, Skv])`` pair serves
     cross-shard callers (the ring walks K/V shards whose ids differ from
-    the local Q shard's).
+    the local Q shard's). ``window=W`` restricts attention to the band
+    ``|row − col| < W`` (with ``causal`` only the lower half —
+    sliding-window/local attention); out-of-band blocks are skipped
+    fetch-free, so cost scales with W·S instead of S².
     """
     scale, block_q, block_k, interpret = _resolve(
         q, scale, block_q, block_k, interpret)
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     return _flash(q, k, v, segment_ids, scale, block_q, block_k, interpret,
-                  causal)
+                  causal, window)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "block_q", "block_k",
-                                    "interpret", "causal"))
+                                    "interpret", "causal", "window"))
 def flash_attention_fwd_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                             scale: float | None = None,
                             block_q: int | None = None,
                             block_k: int | None = None,
                             interpret: bool | None = None,
                             causal: bool = False,
-                            segment_ids: jax.Array | None = None):
+                            segment_ids: jax.Array | None = None,
+                            window: int | None = None):
     """Forward with residual: ``(out [B,S,H,D], lse [B,S,H] f32)``.
 
     The save-for-backward interface: ``lse`` is the row logsumexp, the
@@ -691,19 +766,20 @@ def flash_attention_fwd_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     scale, block_q, block_k, interpret = _resolve(
         q, scale, block_q, block_k, interpret)
     return _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
-                     mode="lse", segment_ids=segment_ids)
+                     mode="lse", segment_ids=segment_ids, window=window)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "block_q", "block_k",
-                                    "interpret", "causal"))
+                                    "interpret", "causal", "window"))
 def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
                           scale: float | None = None,
                           block_q: int | None = None,
                           block_k: int | None = None,
                           interpret: bool | None = None,
                           causal: bool = False,
-                          segment_ids: jax.Array | None = None):
+                          segment_ids: jax.Array | None = None,
+                          window: int | None = None):
     """FlashAttention's raw partial-softmax state:
     ``(acc [B,S,H,D] f32 UNNORMALIZED accumulator, m [B,S,H] f32 row max,
     l [B,S,H] f32 normalizer)``; the normalized output is ``acc / l``.
@@ -716,4 +792,4 @@ def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
     scale, block_q, block_k, interpret = _resolve(
         q, scale, block_q, block_k, interpret)
     return _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
-                     mode="stats", segment_ids=segment_ids)
+                     mode="stats", segment_ids=segment_ids, window=window)

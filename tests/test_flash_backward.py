@@ -270,3 +270,65 @@ def test_cross_length_causal_bwd():
     _, vjp = jax.vjp(
         lambda q, k, v: attn.xla_attention(q, k, v, causal=True), q, k, v)
     _assert_close(grads, vjp(do), atol=5e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_sliding_window(causal):
+    """Sliding-window/local attention: the band |row-col| < W (lower half
+    only under causal) in both directions, vs the banded dense
+    reference; ragged S so padded rows (window-mask-exempt) stay
+    finite."""
+    q, k, v = _qkv((1, 300, 2, 16), seed=13)
+    for W in (64, 200):
+        out = fa.flash_attention(q, k, v, causal=causal, window=W)
+        ref = attn.xla_attention(q, k, v, causal=causal, window=W)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=5e-6)
+        g = _grads(lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=causal, window=W), q, k, v)
+        g_ref = _grads(lambda q, k, v: attn.xla_attention(
+            q, k, v, causal=causal, window=W), q, k, v)
+        _assert_close(g, g_ref, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_flash_window_composes_with_segments():
+    """window x segment_ids x causal in one kernel call — the packed
+    local-attention LM layout."""
+    q, k, v = _qkv((2, 256, 2, 16), seed=14)
+    seg = jnp.concatenate([jnp.zeros((2, 120), jnp.int32),
+                           jnp.ones((2, 136), jnp.int32)], axis=1)
+    kw = dict(causal=True, window=48, segment_ids=seg)
+    out = fa.flash_attention(q, k, v, **kw)
+    ref = attn.xla_attention(q, k, v, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-6)
+    g = _grads(lambda q, k, v: fa.flash_attention(q, k, v, **kw), q, k, v)
+    g_ref = _grads(lambda q, k, v: attn.xla_attention(q, k, v, **kw),
+                   q, k, v)
+    _assert_close(g, g_ref, atol=2e-5)
+
+
+def test_window_fully_dead_rows_are_finite_and_inert():
+    """A cross-length window geometry can leave Q rows with NO keys at
+    all (row - window + 1 >= kv_len). Those rows must emit zeros, not
+    NaN, and their (arbitrary) cotangents must not leak into other rows'
+    dK/dV — the forward publishes a large lse so the backward's
+    p = exp(s - lse) is exactly zero there."""
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (1, 512, 1, 64))
+    k = jax.random.normal(ks[1], (1, 128, 1, 64))
+    v = jax.random.normal(ks[2], (1, 128, 1, 64))
+    out = fa.flash_attention(q, k, v, window=64)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    assert bool(jnp.all(out[:, 256:] == 0))       # rows past kv+window
+    g = _grads(lambda q, k, v: fa.flash_attention(q, k, v, window=64),
+               q, k, v)
+    for t in g:
+        assert bool(jnp.all(jnp.isfinite(t)))
+    # Live-region gradients still match the dense reference exactly
+    # (no contamination from the dead rows).
+    g_live = _grads(lambda q, k, v: fa.flash_attention(
+        q, k, v, window=64)[:, :190], q, k, v)
+    g_ref = _grads(lambda q, k, v: attn.xla_attention(
+        q, k, v, window=64)[:, :190], q, k, v)
+    _assert_close(g_live, g_ref, atol=5e-6)
