@@ -70,12 +70,17 @@ def _resolve(q, scale, block_q, block_k, interpret):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     s = q.shape[1]
-    # Auto block size (None): measured on a v5e (BASELINE.md round 2),
-    # 512x512 blocks are 1.6-4.3x faster than 128x128 from S=2048 up
-    # (5.0 vs 8.0 ms at S=2048; 65 vs 281 ms at S=16384) while 128 wins
-    # slightly below (4.2 vs 4.5 ms at S=512) — fewer grid steps amortize
-    # the per-block softmax/rescale overhead once the sequence is long.
-    auto_block = 512 if s >= 2048 else 128
+    # Auto block size (None): re-tuned on a v5e each round. Round 2 found
+    # 512 beats 128 from S>=2048; the round-3 sweep (with the backward
+    # kernels and fetch-free clamps in play) found 1024 beats 512 across
+    # the whole fwd+bwd training path — 1.64x at S=2048 (7.5 vs 12.3 ms),
+    # 1.28x at S=16384 (129.6 vs 165.6 ms), causal 107->76 ms — while
+    # 2048 exceeds the 16 MB scoped-VMEM limit. 1024 is taken only at
+    # head_dim <= 64 (the ladder's geometry; bigger heads double the
+    # block buffers and the fwd acc scratch, re-approaching the VMEM
+    # ceiling 2048 hit). 128 still wins below S=2048.
+    d = q.shape[-1]
+    auto_block = (1024 if d <= 64 else 512) if s >= 2048 else 128
     block_q = auto_block if block_q is None else block_q
     block_k = auto_block if block_k is None else block_k
     return float(scale), block_q, block_k, interpret
