@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Flash-attention kernel benchmark — the sweep behind BASELINE.md's
+round-3 attention tables.
+
+Runs on the REAL chip (axon): forward-only and full fwd+bwd
+(``jax.grad`` through the custom_vjp backward kernels) at the ladder
+geometry [B=4, S, H=8, D=64] bf16, for full / causal / sliding-window
+attention, optionally sweeping block sizes. Timing drains with a
+``device_get`` of a value depending on every output — the only reliable
+barrier on a tunneled TPU (ARCHITECTURE.md §3).
+
+Usage:
+    python tools/bench_flash.py                  # standard table
+    python tools/bench_flash.py --blocks 512 1024  # block-size sweep
+    python tools/bench_flash.py --seqs 8192 16384 --iters 20
+
+TF/s columns use the ALGORITHMIC flop counts (4·B·H·S²·D forward;
+3.5× that for fwd+bwd — dQ pass + dK/dV pass with recompute), so
+causal/window rows show their *speedup* rather than inflated rates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def bench(fn, *args, iters: int = 10) -> float:
+    s = fn(*args)
+    jax.device_get(s)                    # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        s = fn(*args)
+    jax.device_get(s)                    # drain
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seqs", type=int, nargs="+",
+                   default=[4096, 8192, 16384])
+    p.add_argument("--blocks", type=int, nargs="+", default=[None],
+                   help="explicit block sizes to sweep (default: auto)")
+    p.add_argument("--windows", type=int, nargs="+", default=[1024, 4096])
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head_dim", type=int, default=64)
+    args = p.parse_args()
+
+    from dml_cnn_cifar10_tpu.ops import flash_attention as fa
+
+    B, H, D = args.batch, args.heads, args.head_dim
+    key = jax.random.PRNGKey(0)
+
+    def grad_fn(blk, **kw):
+        bkw = {} if blk is None else dict(block_q=blk, block_k=blk)
+
+        @jax.jit
+        def g(q, k, v):
+            gr = jax.grad(lambda q, k, v: jnp.sum(
+                fa.flash_attention(q, k, v, **bkw, **kw)
+                .astype(jnp.float32)), argnums=(0, 1, 2))(q, k, v)
+            return sum(jnp.sum(t.astype(jnp.float32)) for t in gr)
+        return g
+
+    def fwd_fn(blk, **kw):
+        bkw = {} if blk is None else dict(block_q=blk, block_k=blk)
+        return jax.jit(lambda q, k, v: jnp.sum(
+            fa.flash_attention(q, k, v, **bkw, **kw)
+            .astype(jnp.float32)))
+
+    print(f"[B={B}, S, H={H}, D={D}] bf16 on {jax.devices()[0].platform}; "
+          f"{args.iters} timed iters\n")
+    print("| S | block | variant | fwd ms | fwd+bwd ms | fwd+bwd TF/s | "
+          "vs full |")
+    print("|---|---|---|---|---|---|---|")
+    for S in args.seqs:
+        q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+                   for kk in jax.random.split(key, 3))
+        algo = 3.5 * 4 * B * H * S * S * D
+        for blk in args.blocks:
+            variants = [("full", {})] + [("causal", dict(causal=True))] + [
+                (f"W={w}", dict(window=w)) for w in args.windows
+                if w < S] + [
+                (f"W={w} causal", dict(window=w, causal=True))
+                for w in args.windows if w < S]
+            base = None
+            for name, kw in variants:
+                dt_f = bench(fwd_fn(blk, **kw), q, k, v, iters=args.iters)
+                dt = bench(grad_fn(blk, **kw), q, k, v, iters=args.iters)
+                base = dt if base is None else base
+                bs = "auto" if blk is None else str(blk)
+                print(f"| {S} | {bs} | {name} | {dt_f*1e3:.1f} | "
+                      f"{dt*1e3:.1f} | {algo/dt/1e12:.1f} | "
+                      f"{base/dt:.2f}x |")
+
+
+if __name__ == "__main__":
+    main()
