@@ -55,8 +55,13 @@ def _peak_tflops(device_kind: str):
     return None
 
 
-def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60) -> dict:
-    """Steady-state throughput + MFU for one compute dtype."""
+def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
+            dev_stream: bool = False) -> dict:
+    """Steady-state throughput + MFU for one compute dtype.
+
+    ``dev_stream`` switches the shuffled index stream to the on-device
+    stateless generator (``data/device_stream.py``): the dispatch then
+    carries NO host data at all (round-3 verdict #4's decoupling)."""
     import jax
 
     from dml_cnn_cifar10_tpu.config import reference_config
@@ -104,27 +109,35 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60) -> dict:
     chunk = step_lib.make_train_chunk_resident(
         trainer.model_def, cfg.model, cfg.optim, trainer.mesh,
         ds_images, ds_labels, state_sharding=trainer.state_sharding,
-        data_cfg=cfg.data)
-    idx_sh = mesh_lib.batch_sharding(trainer.mesh, 2, leading_dims=1)
+        data_cfg=cfg.data,
+        index_stream=((cfg.data.seed, cfg.batch_size, chunk_k)
+                      if dev_stream else None))
+    if dev_stream:
+        def feed():
+            return ()
+        prefetch = pipe.PrefetchIterator(
+            iter(feed, None), depth=1, place=None)
+    else:
+        idx_sh = mesh_lib.batch_sharding(trainer.mesh, 2, leading_dims=1)
 
-    def next_idx():
-        return jax.device_put(train_it.next_index_chunk(chunk_k), idx_sh)
-
-    prefetch = pipe.PrefetchIterator(
-        iter(next_idx, None), depth=cfg.data.prefetch, place=None)
+        def next_idx():
+            return (jax.device_put(train_it.next_index_chunk(chunk_k),
+                                   idx_sh),)
+        prefetch = pipe.PrefetchIterator(
+            iter(next_idx, None), depth=cfg.data.prefetch, place=None)
 
     # Warmup: first call compiles (~20-40s), more to fill the pipeline.
     # Drain with device_get, NOT block_until_ready: on the tunneled TPU
     # platform block_until_ready can return before the execution queue
     # drains, which would inflate the measurement ~16x.
     for _ in range(3):
-        state, metrics = chunk(state, next(prefetch))
+        state, metrics = chunk(state, *next(prefetch))
     float(jax.device_get(metrics["loss"]))
 
     # Timed steady state.
     t0 = time.perf_counter()
     for _ in range(chunks):
-        state, metrics = chunk(state, next(prefetch))
+        state, metrics = chunk(state, *next(prefetch))
     float(jax.device_get(metrics["loss"]))  # full drain: loss of the last step
     dt = time.perf_counter() - t0
     steps = chunks * chunk_k

@@ -110,6 +110,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ViT head pooling; defaults to cls, or mean when "
                         "seq_axis > 1 (sequence sharding excludes a lone "
                         "cls token)")
+    p.add_argument("--resnet_s2d", type="bool", default=False,
+                   help="space-to-depth ResNet stem (ImageNet stems only): "
+                        "4x4/1 conv on the 2x2-folded [112,112,12] input "
+                        "instead of 7x7/2 on [224,224,3] - the MLPerf MXU-"
+                        "occupancy trick; changes stem param shape")
+    p.add_argument("--attn_window", type=int, default=None,
+                   help="sliding-window (local) attention width for the "
+                        "ViT family: band |row-col| < W on every path "
+                        "(XLA, flash kernels, ring, ulysses); under ring "
+                        "SP the window must fit one sequence shard")
+    p.add_argument("--attn_causal", type="bool", default=False,
+                   help="causal (autoregressive) attention mask in the "
+                        "ViT family's transformer blocks")
     p.add_argument("--vit_heads", type=int, default=None,
                    help="ViT attention heads (default 3; ulysses sp needs "
                         "heads divisible by seq_axis)")
@@ -147,6 +160,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "only index slices. The trainer auto-switches to "
                         "the NumPy pipeline for this path (the C++ "
                         "pool's bounded-shuffle stream has no index view)")
+    p.add_argument("--device_index_stream", type="bool", default=False,
+                   help="resident path only: generate the shuffled index "
+                        "stream ON DEVICE inside the compiled chunk "
+                        "(stateless per-epoch pseudo-permutation keyed on "
+                        "the global step) — a training dispatch uploads "
+                        "nothing. Different (equally valid) permutation "
+                        "than the host stream; toggling changes data "
+                        "order")
     p.add_argument("--use_native_loader", type="bool", default=True,
                    help="stream batches from the C++ bounded shuffle pool "
                         "(reference RandomShuffleQueue parity); false uses "
@@ -289,12 +310,16 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         cfg.optim.cosine_decay_steps = cfg.total_steps
     cfg.steps_per_dispatch = args.steps_per_dispatch
     cfg.resident_data = args.resident_data
+    cfg.data.device_index_stream = args.device_index_stream
     cfg.data.use_native_loader = args.use_native_loader
     # Seed the data stream (shuffle + device-side augmentation draws) from
     # the run seed too — otherwise --seed would not vary augmentation.
     cfg.data.seed = args.seed
     cfg.async_checkpoint = args.async_checkpoint
     cfg.model.sp_mode = args.sp_mode
+    cfg.model.attn_window = args.attn_window
+    cfg.model.attn_causal = args.attn_causal
+    cfg.model.resnet_s2d = args.resnet_s2d
     if args.pool is not None:
         cfg.model.pool = args.pool
     elif args.seq_axis > 1:

@@ -54,6 +54,13 @@ class DataConfig:
     normalize: str = "none"               # none | scale | standardize
     prefetch: int = 2                     # host->HBM prefetch depth
     seed: int = 0
+    # HBM-resident path only: generate the shuffled index stream ON
+    # DEVICE inside the compiled chunk (data/device_stream.py stateless
+    # per-epoch pseudo-permutation keyed on the global step) — a training
+    # dispatch then uploads nothing at all. The shuffle is a different
+    # (equally valid) permutation than the host stream's numpy-PCG one,
+    # so toggling this flag changes the data order.
+    device_index_stream: bool = False
     # Use the native C++ record loader when the shared library is available;
     # falls back to the pure-NumPy path otherwise.
     use_native_loader: bool = True
@@ -130,6 +137,22 @@ class ModelConfig:
     # dense full-sequence kernel per head slice (needs heads % seq_axis
     # == 0, best MXU utilization at moderate seq degree).
     sp_mode: str = "ring"                 # ring | ulysses
+    # Sliding-window (local) attention width: None = full attention.
+    # Band |row - col| < attn_window, composed with ``attn_causal`` the
+    # Mistral-style local-LM mask. Applies to the ViT family's attention
+    # on every path (XLA short-seq, flash kernels, ring, Ulysses); under
+    # ring SP the window must not exceed the per-shard sequence length.
+    attn_window: int | None = None
+    # Causal (autoregressive) attention mask for the transformer blocks.
+    attn_causal: bool = False
+    # MLPerf-style space-to-depth stem for the ImageNet-stem ResNets:
+    # [B,224,224,3] re-laid-out to [B,112,112,12] and the 7x7/2 stem conv
+    # replaced by the equivalent 4x4/1 conv on the re-laid tensor (the
+    # 7x7 kernel embeds in the 4x4x12 class, zero-padded to 8x8). C=3
+    # tiles the MXU contraction at ~2% occupancy; 12 channels x 16 taps
+    # quadruple it. Changes the stem param shape (checkpoints don't
+    # interchange across this flag).
+    resnet_s2d: bool = False
     # GPipe microbatches per step under pipeline parallelism (0 = one per
     # stage). The bubble fraction is (M+P-1)/M: at the M=P default every
     # stage idles ~half the ticks; M = 4P costs 1/4 the bubble in

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig
 from dml_cnn_cifar10_tpu.ops import layers as L
@@ -92,9 +93,16 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig,
     ki = iter(range(len(keys)))
 
     p: Params = {}
-    stem_k = (7, 7) if imagenet_stem else (3, 3)
-    p["stem"] = {"conv": _conv_init(keys[next(ki)],
-                                    (*stem_k, data.num_channels, 64), dtype)}
+    if imagenet_stem and cfg.resnet_s2d:
+        # Space-to-depth stem (BASELINE.md round-4): 4x4/1 conv over the
+        # 2x2-folded input — same function class as 7x7/2 on the raw
+        # image (zero-pad 7x7 to 8x8, fold into 4x4 x 4C), trained
+        # directly in the folded parameterization as MLPerf does.
+        stem_shape = (4, 4, 4 * data.num_channels, 64)
+    else:
+        stem_k = (7, 7) if imagenet_stem else (3, 3)
+        stem_shape = (*stem_k, data.num_channels, 64)
+    p["stem"] = {"conv": _conv_init(keys[next(ki)], stem_shape, dtype)}
     p["stem"]["bn"] = L.bn_init(64, dtype)
 
     cin = 64
@@ -181,7 +189,9 @@ def apply(params: Params, state: State, images: jax.Array, cfg: ModelConfig,
     x = images.astype(cdt)
     p = jax.tree.map(lambda a: a.astype(cdt), params)
 
-    imagenet_stem = p["stem"]["conv"].shape[0] == 7
+    stem_kh = p["stem"]["conv"].shape[0]
+    imagenet_stem = stem_kh == 7
+    s2d_stem = stem_kh == 4
     block = (_bottleneck_block if "bn3" in p["stage1"][0]
              else _basic_block)
     if cfg.remat:
@@ -200,12 +210,28 @@ def apply(params: Params, state: State, images: jax.Array, cfg: ModelConfig,
     # Mirror init_state's structure exactly: a treedef change between step 1
     # and step 2 would silently retrigger compilation.
     new_state: State = {"fc": {"kernel": None, "bias": None}}
-    x = L.conv2d(x, p["stem"]["conv"], stride=2 if imagenet_stem else 1)
+    if s2d_stem:
+        # Space-to-depth: [B,2h,2w,C] -> [B,h,w,4C] (2x2 phases into
+        # channels), then the stride-1 4x4 conv with explicit padding
+        # (1,2): the 7x7/2 SAME conv (XLA pad lo=2) reads raw rows
+        # 2i-2..2i+4 for output i, which fold to rows i-1..i+2 — a 7x7
+        # kernel embeds as ws[m,n,(a,b,c)] = w7[2m+a-... w8[2m+a] with
+        # w8[0:7]=w7, w8[7]=0 (tests/test_resnet.py pins the fold).
+        b_, hh, ww, c_ = x.shape
+        x = x.reshape(b_, hh // 2, 2, ww // 2, 2, c_)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
+            b_, hh // 2, ww // 2, 4 * c_)
+        x = lax.conv_general_dilated(
+            x, p["stem"]["conv"], window_strides=(1, 1),
+            padding=((1, 2), (1, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    else:
+        x = L.conv2d(x, p["stem"]["conv"], stride=2 if imagenet_stem else 1)
     x, stem_bn = _bn(x, p["stem"]["bn"], state["stem"]["bn"], cfg, train,
                      axis_name)
     new_state["stem"] = {"conv": None, "bn": stem_bn}
     x = jax.nn.relu(x)
-    if imagenet_stem:
+    if imagenet_stem or s2d_stem:
         x = L.max_pool(x, window=3, stride=2)
 
     for si in range(1, 5):

@@ -117,7 +117,7 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
 
 def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
            capacity_factor: float, mesh=None, sp_mode: str = "ring",
-           moe_top_k: int = 1):
+           moe_top_k: int = 1, causal: bool = False, window=None):
     """One transformer block → ``(x, aux_loss)`` (aux 0.0 for dense MLP)."""
     b, s, dim = x.shape
     h = layer_norm(x, p["ln1"])
@@ -135,14 +135,17 @@ def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
         if sp_mode == "ulysses":
             from dml_cnn_cifar10_tpu.parallel import ulysses
             o = ulysses.ulysses_attention(q, k, v, mesh,
-                                          use_pallas=use_pallas)
+                                          use_pallas=use_pallas,
+                                          causal=causal, window=window)
         elif sp_mode == "ring":
             from dml_cnn_cifar10_tpu.parallel import ring_attention as ring
-            o = ring.ring_attention(q, k, v, mesh, use_pallas=use_pallas)
+            o = ring.ring_attention(q, k, v, mesh, use_pallas=use_pallas,
+                                    causal=causal, window=window)
         else:
             raise ValueError(f"unknown sp_mode {sp_mode!r}")
     else:
-        o = attn.dispatch_attention(q, k, v, use_pallas=use_pallas)
+        o = attn.dispatch_attention(q, k, v, use_pallas=use_pallas,
+                                    causal=causal, window=window)
     x = x + L.dense(o.reshape(b, s, dim), p["proj"]["kernel"],
                     p["proj"]["bias"])
     h = layer_norm(x, p["ln2"])
@@ -222,7 +225,8 @@ def apply_with_aux(params: Params, images: jax.Array, cfg: ModelConfig,
 
         def stage_fn(h, bp):
             return _block(h, bp, cfg.vit_heads, cfg.use_pallas_attention,
-                          cfg.moe_capacity_factor)[0]
+                          cfg.moe_capacity_factor, causal=cfg.attn_causal,
+                          window=cfg.attn_window)[0]
 
         if cfg.remat:
             # Same memory lever inside each pipeline stage body.
@@ -237,7 +241,8 @@ def apply_with_aux(params: Params, images: jax.Array, cfg: ModelConfig,
                           cfg.use_pallas_attention,
                           cfg.moe_capacity_factor, mesh=attn_mesh,
                           sp_mode=cfg.sp_mode,
-                          moe_top_k=cfg.moe_top_k)
+                          moe_top_k=cfg.moe_top_k,
+                          causal=cfg.attn_causal, window=cfg.attn_window)
 
         if cfg.remat:
             # Recompute block activations in backward: scan(checkpoint)

@@ -31,20 +31,23 @@ NEG_INF = -1e30  # finite: exp(-inf - -inf) would NaN a fully-masked row
 def mask_scores(scores: jax.Array, q_len: int, kv_len: int,
                 causal: bool = False,
                 segment_ids: jax.Array | None = None,
-                window: int | None = None) -> jax.Array:
+                window: int | None = None,
+                kv_start: int = 0) -> jax.Array:
     """Apply the shared attention-validity mask to dense ``[..., Sq, Sk]``
     scores (jnp counterpart of the flash kernels' ``_score_mask``): causal
     keeps col ≤ row; segment_ids [B, S] keep same-segment pairs only
     (``scores`` must then be [B, H, Sq, Sk]). One definition, used by the
     XLA reference path and the ring's jnp block engines, so the masking
-    semantics can't drift between the parity-tested implementations."""
+    semantics can't drift between the parity-tested implementations.
+    ``kv_start`` offsets the columns' global coordinates (ring window
+    blocks attend a neighbor shard sitting ``±S_local`` away)."""
     if window is not None and window < 1:
         # Same contract as the flash path: a non-positive window would
         # silently mask EVERY score and softmax would emit uniform
         # garbage.
         raise ValueError(f"window must be >= 1, got {window}")
     row = jnp.arange(q_len)[:, None]
-    col = jnp.arange(kv_len)[None, :]
+    col = kv_start + jnp.arange(kv_len)[None, :]
     if causal:
         scores = jnp.where(col <= row, scores, NEG_INF)
     if window is not None:
@@ -73,6 +76,11 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     mass at S large); output is cast back to q.dtype. ``causal=True``
     masks scores above the diagonal (the flash kernel's contract-identical
     reference for parity tests).
+
+    Rows with NO live key (possible under window/cross-length/segment
+    geometries) emit exact zeros, matching the flash kernels' ``_safe_l``
+    behavior — a plain softmax over all-NEG_INF scores would instead emit
+    a uniform average of V (round-3 advisor finding).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -82,6 +90,10 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     scores = mask_scores(scores, q.shape[1], k.shape[1], causal=causal,
                          segment_ids=segment_ids, window=window)
     probs = jax.nn.softmax(scores, axis=-1)
+    # A fully-masked row's max is exactly NEG_INF (real scores are many
+    # orders of magnitude above it); zero such rows like the flash path.
+    live = jnp.max(scores, axis=-1, keepdims=True) > NEG_INF * 0.5
+    probs = jnp.where(live, probs, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
 

@@ -86,6 +86,21 @@ def _resolve(q, scale, block_q, block_k, interpret):
     return float(scale), block_q, block_k, interpret
 
 
+def _static_kv_start(kv_start):
+    """``kv_start`` parameterizes the Python-level schedule and mask
+    construction, so it MUST be a static int — a traced value would
+    reach ``_fold_schedule``'s lru_cache (TypeError) or silently bake
+    wrong masks. The ring passes ``±S_local`` from static shapes; any
+    traced value is a caller bug worth a clear message."""
+    if isinstance(kv_start, jax.core.Tracer):
+        raise TypeError(
+            "kv_start must be a static Python int (it selects the block "
+            "schedule and mask offsets at trace time); got a traced "
+            "value. Pass shard offsets from static shapes, e.g. "
+            "q.shape[1].")
+    return int(kv_start)
+
+
 def _to_bh(x, block):
     """[B, S, H, D] → [B·H, S_padded, D], S padded to a ``block`` multiple."""
     b, s, h, d = x.shape
@@ -119,7 +134,8 @@ def _stat_to_tile(x, block):
 
 
 def _score_mask(shape, *, kv_len, q_len, row0, col0, causal,
-                qseg=None, kseg=None, window=None):
+                qseg=None, kseg=None, window=None,
+                kv_aligned=False, q_aligned=False, col_shift=0):
     """The shared validity mask for one [bq, bk] score block: padded K/V
     columns off; optionally causal (col ≤ row in global coordinates);
     optionally same-segment only (packed sequences); optionally a
@@ -127,37 +143,91 @@ def _score_mask(shape, *, kv_len, q_len, row0, col0, causal,
     lower half remains — Mistral-style local attention). Padded Q rows
     (row ≥ q_len) are *exempt* from the segment and window masks so
     every padded row keeps l > 0 — their lse stays finite, and their
-    gradient contributions vanish anyway because dO is zero-padded."""
-    col = col0 + lax.broadcasted_iota(jnp.int32, shape, 1)
-    mask = col < kv_len
-    row = row0 + lax.broadcasted_iota(jnp.int32, shape, 0)
-    pad_row = row >= q_len
+    gradient contributions vanish anyway because dO is zero-padded.
+
+    ``kv_aligned``/``q_aligned`` are compile-time facts from the caller
+    (sequence length divides the block size): they elide the padded-col
+    bound and the pad-row exemption entirely — the masked variants'
+    whole chain runs fused on the VPU, so dropping terms buys real
+    per-tick time on the aligned (common, benchmarked) geometry.
+
+    ``col0`` is the LOCAL column base (block offset into the K/V array
+    — the padded-column bound keys on it), while ``col_shift`` is the
+    ring-window global displacement (``kv_start``) that only the
+    positional (causal/window) comparisons see: a visiting ring shard's
+    columns sit ``±S_local`` away in global coordinates, but its array
+    padding is at its own local tail (round-4 review finding)."""
+    col = None
+    mask = None
+    if not kv_aligned:
+        col_local = col0 + lax.broadcasted_iota(jnp.int32, shape, 1)
+        mask = col_local < kv_len
+        col = col_local + col_shift
+    if causal or window is not None:
+        if col is None:
+            col = (col0 + col_shift
+                   + lax.broadcasted_iota(jnp.int32, shape, 1))
+        row = row0 + lax.broadcasted_iota(jnp.int32, shape, 0)
+    pad_row = None
+    if not q_aligned and (window is not None or qseg is not None):
+        if causal or window is not None:
+            pad_row = row >= q_len
+        else:
+            pad_row = (row0 + lax.broadcasted_iota(jnp.int32, shape, 0)
+                       >= q_len)
+
+    def _and(m, term):
+        return term if m is None else m & term
+
     if causal:
-        mask = mask & (col <= row)
+        mask = _and(mask, col <= row)
     if window is not None:
         band = col > row - window
         if not causal:
             band = band & (col < row + window)
-        mask = mask & (band | pad_row)
+        mask = _and(mask, band if pad_row is None else (band | pad_row))
     if qseg is not None:
-        mask = mask & ((qseg == kseg) | pad_row)
+        same = qseg == kseg
+        mask = _and(mask, same if pad_row is None else (same | pad_row))
     return mask
 
 
 def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
                   scale: float, kv_len: int, q_len: int, block_q: int,
-                  block_k: int, causal: bool, window=None,
-                  qseg_ref=None, kseg_ref=None):
+                  block_k: int, causal: bool, window=None, kv_start=0,
+                  qseg_ref=None, kseg_ref=None, coords=None):
     """One K/V-block update of the running (m, l, acc) — shared by the
-    plain, lse-emitting, and stats-emitting kernels."""
-    ib = pl.program_id(1)
-    kb = pl.program_id(2)
+    plain, lse-emitting, and stats-emitting kernels.
 
-    @pl.when(kb == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+    ``coords``: ``(ib, kb, init)`` for the folded (live-blocks-only)
+    schedule — block coordinates come from the prefetched schedule and
+    every tick is live; ``None`` for the rectangular grid, where they
+    derive from the program ids and dead band blocks are skipped."""
+    if coords is None:
+        ib = pl.program_id(1)
+        kb = pl.program_id(2)
+        init = kb == 0
+        first_tick = (pl.program_id(0) == 0) & (ib == 0) & init
+    else:
+        ib, kb, init = coords
+        first_tick = (pl.program_id(0) == 0) & (pl.program_id(1) == 0)
+
+    @pl.when(first_tick)
+    def _zero_all():
+        # Once per launch: VMEM scratch starts as garbage that could be
+        # NaN/Inf, which the alpha=0 rescale below cannot kill (0·NaN).
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(init)
+    def _init():
+        # Per-row init only resets the row max (column 0 is all the
+        # kernels read). l/acc keep the PREVIOUS row's values: the first
+        # live tick has alpha = exp(NEG_INF − m_cur) = 0, which zeroes
+        # the stale state for free. Rows that never go live keep m ==
+        # NEG_INF and finalize through the _dead_rows guard, so their
+        # stale l/acc are never observable.
+        m_scr[:, :1] = jnp.full_like(m_scr[:, :1], NEG_INF)
 
     def _update():
         q = q_ref[0]                      # [bq, d]
@@ -168,14 +238,24 @@ def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
                             preferred_element_type=jnp.float32) * scale
         mask = _score_mask(
             s.shape, kv_len=kv_len, q_len=q_len, row0=ib * block_q,
-            col0=kb * block_k, causal=causal, window=window,
+            col0=kb * block_k, col_shift=kv_start, causal=causal,
+            window=window,
             qseg=None if qseg_ref is None else qseg_ref[0][:, :1],
-            kseg=None if kseg_ref is None else kseg_ref[0, :1])
-        s = jnp.where(mask, s, NEG_INF)
+            kseg=None if kseg_ref is None else kseg_ref[0, :1],
+            kv_aligned=kv_len % block_k == 0,
+            q_aligned=q_len % block_q == 0)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, :1]                                   # [bq, 1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)
+        # Dead rows (EVERY key masked so far) keep m_cur == NEG_INF, so
+        # exp(s - m_cur) = exp(0) = 1 for their masked entries and l/acc
+        # accumulate garbage (masked entries in live-max rows underflow
+        # to exactly 0, so only dead rows are affected). Rather than a
+        # per-tick select on p, the finalizers detect dead rows by
+        # ``m == NEG_INF`` and emit zeros + a LARGE lse — see _dead_rows.
         p = jnp.exp(s - m_cur)                                  # [bq, bk]
         l_scr[:, :1] = (l_scr[:, :1] * alpha
                         + jnp.sum(p, axis=-1, keepdims=True))
@@ -184,8 +264,14 @@ def _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
             preferred_element_type=jnp.float32)
         m_scr[:, :1] = m_cur
 
-    live = _band_live(ib * block_q, block_q, kb * block_k, block_k,
-                      causal, window)
+    if coords is not None:
+        # Folded schedule: every tick IS a live block by construction
+        # (or a dead placeholder whose element mask kills everything and
+        # whose row finalizes to zeros via _dead_rows).
+        _update()
+        return
+    live = _band_live(ib * block_q, block_q, kv_start + kb * block_k,
+                      block_k, causal, window)
     if live is not None:
         @pl.when(live)
         def _live():
@@ -209,47 +295,80 @@ def _unpack(refs, n_out, has_segments, n_base=3):
 
 
 def _safe_l(l_col):
-    """Guard against fully-dead rows (every block skipped — possible when
-    a window/cross-length geometry leaves a row with no keys): l stays 0
-    there, and the plain division would emit NaN that poisons the
-    backward. Any live element contributes exp(0)=1, so l >= 1 whenever
-    a row has keys; dead rows divide by 1 and output exact zeros."""
+    """Divide-by-zero guard for the normalizer: fully-dead rows (every
+    block skipped — window/cross-length geometries) keep l == 0 and the
+    plain division would emit NaN that poisons the backward."""
     return jnp.maximum(l_col, 1e-30)
 
 
-def _flash_kernel(*refs, has_segments: bool = False, **kw):
+def _dead_rows(m_col):
+    """Dead-row predicate at finalize time: a row with NO live key ever
+    (blocks skipped by the schedule, or visited but fully masked —
+    segment/window geometries) still has ``m == NEG_INF``; any live
+    score is many orders of magnitude above NEG_INF/2. Visited-but-dead
+    rows accumulate garbage (``exp(NEG_INF − NEG_INF) = 1`` per masked
+    entry ⇒ l = #keys, acc = Σ V), so the finalizers must zero their
+    output and publish a LARGE lse — otherwise the backward's
+    ``p = exp(s − lse)`` becomes 1/#keys and leaks gradient into dK/dV
+    (round-3 advisor finding, extended to the visited-block case)."""
+    return m_col <= NEG_INF * 0.5
+
+
+def _fold_coords(refs, folded):
+    """Split off the prefetched schedule ref (folded mode) and derive
+    ``(remaining_refs, coords, last)``: coords feed ``_flash_update``,
+    ``last`` gates the finalizer. Rect mode reads the program ids."""
+    if not folded:
+        return refs, None, pl.program_id(2) == pl.num_programs(2) - 1
+    info_ref, refs = refs[0], refs[1:]
+    t = pl.program_id(1)
+    coords = (info_ref[0, t], info_ref[1, t], info_ref[2, t] == 1)
+    return refs, coords, info_ref[3, t] == 1
+
+
+def _flash_kernel(*refs, has_segments: bool = False, folded: bool = False,
+                  **kw):
+    refs, coords, last = _fold_coords(refs, folded)
     (q_ref, k_ref, v_ref, qseg_ref, kseg_ref), (o_ref,), \
         (m_scr, l_scr, acc_scr) = _unpack(refs, 1, has_segments)
     _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
-                  qseg_ref=qseg_ref, kseg_ref=kseg_ref, **kw)
+                  qseg_ref=qseg_ref, kseg_ref=kseg_ref, coords=coords, **kw)
 
-    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    @pl.when(last)
     def _finalize():
-        o_ref[0] = (acc_scr[:] / _safe_l(l_scr[:, :1])).astype(o_ref.dtype)
+        o = acc_scr[:] / _safe_l(l_scr[:, :1])
+        o_ref[0] = jnp.where(_dead_rows(m_scr[:, :1]), 0.0,
+                             o).astype(o_ref.dtype)
 
 
-def _flash_fwd_kernel(*refs, has_segments: bool = False, **kw):
+def _flash_fwd_kernel(*refs, has_segments: bool = False,
+                      folded: bool = False, **kw):
     """Forward that additionally emits the row logsumexp — the single
     statistic the FlashAttention-2 backward needs."""
+    refs, coords, last = _fold_coords(refs, folded)
     (q_ref, k_ref, v_ref, qseg_ref, kseg_ref), (o_ref, lse_ref), \
         (m_scr, l_scr, acc_scr) = _unpack(refs, 2, has_segments)
     _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
-                  qseg_ref=qseg_ref, kseg_ref=kseg_ref, **kw)
+                  qseg_ref=qseg_ref, kseg_ref=kseg_ref, coords=coords, **kw)
 
-    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    @pl.when(last)
     def _finalize():
-        o_ref[0] = (acc_scr[:] / _safe_l(l_scr[:, :1])).astype(o_ref.dtype)
-        # Lane cols 1..127 hold -inf-ish garbage (NEG_INF + log 0); only
-        # col 0 is ever read back. Fully-dead rows (l == 0) publish a
-        # LARGE lse so the backward's p = exp(s − lse) is exactly 0 —
-        # their arbitrary outputs must not leak gradient into other
-        # rows' dK/dV accumulators.
-        lse = jnp.where(l_scr[:] > 0.0, m_scr[:] + jnp.log(_safe_l(l_scr[:])),
-                        1e30)
-        lse_ref[0] = lse
+        o = acc_scr[:] / _safe_l(l_scr[:, :1])
+        o_ref[0] = jnp.where(_dead_rows(m_scr[:, :1]), 0.0,
+                             o).astype(o_ref.dtype)
+        # The stat computes on column 0 ONLY (a [bq, 1] log instead of a
+        # full-tile one — the [bq, 128] log was ~45 % of a short row's
+        # finalize cost) and broadcast-stores across the tile; only
+        # col 0 is ever read back. Dead rows publish a LARGE lse so the
+        # backward's p = exp(s − lse) is exactly 0 (see _dead_rows).
+        m_col = m_scr[:, :1]
+        lse_col = jnp.where(_dead_rows(m_col), 1e30,
+                            m_col + jnp.log(_safe_l(l_scr[:, :1])))
+        lse_ref[0] = jnp.broadcast_to(lse_col, lse_ref.shape[1:])
 
 
-def _flash_stats_kernel(*refs, has_segments: bool = False, **kw):
+def _flash_stats_kernel(*refs, has_segments: bool = False,
+                        folded: bool = False, **kw):
     """Like ``_flash_kernel`` but emits the raw running state — f32
     UNNORMALIZED accumulator plus row max ``m`` and normalizer ``l`` —
     the partial-softmax interface the ring-attention merge rule needs
@@ -257,12 +376,13 @@ def _flash_stats_kernel(*refs, has_segments: bool = False, **kw):
     partial in f32 regardless of input dtype (normalizing to the input
     dtype and re-multiplying by ``l`` would quantize every ring step's
     partial)."""
+    refs, coords, last = _fold_coords(refs, folded)
     (q_ref, k_ref, v_ref, qseg_ref, kseg_ref), (acc_ref, m_ref, l_ref), \
         (m_scr, l_scr, acc_scr) = _unpack(refs, 3, has_segments)
     _flash_update(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
-                  qseg_ref=qseg_ref, kseg_ref=kseg_ref, **kw)
+                  qseg_ref=qseg_ref, kseg_ref=kseg_ref, coords=coords, **kw)
 
-    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    @pl.when(last)
     def _finalize():
         acc_ref[0] = acc_scr[:]
         m_ref[0] = m_scr[:]
@@ -293,34 +413,47 @@ def _seg_lane(seg, block):
                             (seg.shape[0], 8, seg.shape[1]))
 
 
-def _kv_clamp(causal, bq, bk, window=None, nk=None):
-    """K/V block-index map component for (…, q_block i, k_block j) grids.
+import numpy as _np
 
-    Causal/windowed grids never read blocks outside the live band (the
-    kernels guard compute with ``pl.when``), but Pallas still issues the
-    operand DMA for every grid step — UNLESS the block index repeats, in
-    which case the pipeline skips the re-fetch. Clamping the index into
-    the live band makes every dead iteration a repeat of a live one:
-    skipped ticks become fetch-free, which is most of the saving at long
-    S (BASELINE.md measured the unclamped causal skip at only
-    1.1–1.33× vs 1.4–1.55× clamped)."""
+
+@functools.lru_cache(maxsize=256)
+def _fold_schedule(nq, nk, bq, bk, causal, window, major="q", kv_start=0):
+    """The folded (live-blocks-only) grid schedule → int32 ``[4, T]``
+    rows ``(outer_block, inner_block, is_first, is_last)`` — or ``None``
+    when nothing can be skipped (full attention runs the plain
+    rectangular grid: no SMEM prefetch needed).
+
+    Instead of walking the full ``outer × inner`` rectangle and
+    ``pl.when``-skipping dead band blocks (which still pay per-grid-step
+    overhead — round-3 measured dead ticks at ~0.4 µs each, ~45 % of the
+    W=1024 forward), the grid's second dimension enumerates ONLY the
+    blocks that intersect the causal/window band, flattened row-major:
+    ~half the ticks for causal, ``O(W/block)`` per row for a window.
+    Block coordinates ride a scalar-prefetch array (SMEM), the standard
+    TPU sparse-schedule technique. ``major='q'`` orders by q block
+    (forward + dQ kernels), ``'k'`` by k block (dK/dV kernel). An outer
+    block with NO live inner block (cross-length geometries) gets one
+    placeholder tick — its element mask kills every score, so the row
+    finalizes as dead (zero output, LARGE lse). ``kv_start`` shifts
+    the K/V columns' global coordinates (ring window steps attend a
+    neighbor shard whose columns sit ``±S_local`` away)."""
     if not causal and window is None:
-        return lambda i, j: j
-
-    def clamp(i, j):
-        out = j
-        if causal:
-            out = jnp.minimum(out, (i * bq + bq - 1) // bk)
-        elif window is not None:
-            out = jnp.minimum(out, (i * bq + bq - 1 + window - 1) // bk)
-        if window is not None:
-            out = jnp.maximum(out, (i * bq - window + 1) // bk)
-        # Bound into the K/V block range: q_len > kv_len leaves some
-        # q blocks with no live K/V block at all, and an unbounded clamp
-        # would index past the array on those fully-dead rows.
-        return jnp.clip(out, 0, nk - 1)
-
-    return clamp
+        return None
+    ticks = []
+    n_outer, n_inner = (nq, nk) if major == "q" else (nk, nq)
+    for r in range(n_outer):
+        cols = []
+        for c in range(n_inner):
+            i, j = (r, c) if major == "q" else (c, r)
+            if bool(_band_live(i * bq, bq, kv_start + j * bk, bk, causal,
+                               window)):
+                cols.append(c)
+        if not cols:
+            cols = [0]
+        for n, c in enumerate(cols):
+            ticks.append((r, c, 1 if n == 0 else 0,
+                          1 if n == len(cols) - 1 else 0))
+    return _np.asarray(ticks, _np.int32).T.copy()
 
 
 def _band_live(row0, rows, col0, cols, causal, window):
@@ -353,8 +486,38 @@ def _norm_segments(segment_ids):
     return seg, seg
 
 
+def _index_maps(folded: bool, h: int, q_major: bool = True):
+    """The four pallas index maps (q-side, kv-side, and their segment-id
+    variants) for one kernel pass — ONE definition so the folded/rect and
+    q-major/k-major variants cannot drift (round-4 review finding).
+
+    Folded grids read block coordinates from the prefetched schedule:
+    row 0 of the schedule is the OUTER (accumulator) block, row 1 the
+    inner — which is (q, k) for the q-major passes (forward, dQ) and
+    (k, q) for the k-major dK/dV pass. Rect grids read the grid indices
+    directly, whose order is (outer, inner) the same way. Segment maps
+    fold the head out of the batch·head grid axis (ids are per batch)."""
+    qrow, krow = (0, 1) if q_major else (1, 0)
+    if folded:
+        qi = lambda g, t, info: (g, info[qrow, t], 0)         # noqa: E731
+        kj = lambda g, t, info: (g, info[krow, t], 0)         # noqa: E731
+        qi_seg = lambda g, t, info: (g // h, info[qrow, t], 0)  # noqa: E731
+        kj_seg = lambda g, t, info: (g // h, 0, info[krow, t])  # noqa: E731
+    elif q_major:
+        qi = lambda g, i, j: (g, i, 0)                        # noqa: E731
+        kj = lambda g, i, j: (g, j, 0)                        # noqa: E731
+        qi_seg = lambda g, i, j: (g // h, i, 0)               # noqa: E731
+        kj_seg = lambda g, i, j: (g // h, 0, j)               # noqa: E731
+    else:
+        qi = lambda g, j, i: (g, i, 0)                        # noqa: E731
+        kj = lambda g, j, i: (g, j, 0)                        # noqa: E731
+        qi_seg = lambda g, j, i: (g // h, i, 0)               # noqa: E731
+        kj_seg = lambda g, j, i: (g // h, 0, j)               # noqa: E731
+    return qi, kj, qi_seg, kj_seg
+
+
 def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
-              mode: str, segment_ids=None, window=None):
+              mode: str, segment_ids=None, window=None, kv_start=0):
     """Shared forward pallas_call builder.
 
     mode: "out" → out; "lse" → (out, lse [B,S,H]);
@@ -374,14 +537,18 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
     spq, spk = qb.shape[1], kb_.shape[1]
     nq, nk = spq // bq, spk // bk
     has_seg = segment_ids is not None
+    sched = _fold_schedule(nq, nk, bq, bk, causal, window, "q",
+                           kv_start=kv_start)
+    folded = sched is not None
 
     kw = dict(scale=scale, kv_len=kv_len, q_len=s, block_q=bq, block_k=bk,
-              causal=causal, window=window, has_segments=has_seg)
-    kvc = _kv_clamp(causal, bq, bk, window=window, nk=nk)
+              causal=causal, window=window, kv_start=kv_start,
+              has_segments=has_seg, folded=folded)
+    qi, kj, qi_seg, kj_seg = _index_maps(folded, h)
     in_specs = [
-        pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda g, i, j: (g, kvc(i, j), 0)),
-        pl.BlockSpec((1, bk, d), lambda g, i, j: (g, kvc(i, j), 0)),
+        pl.BlockSpec((1, bq, d), qi),
+        pl.BlockSpec((1, bk, d), kj),
+        pl.BlockSpec((1, bk, d), kj),
     ]
     inputs = [qb, kb_, vb]
     if has_seg:
@@ -389,14 +556,13 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
         # Segment ids are per (batch, position) — the index maps fold the
         # head out of the grid's batch·head axis.
         in_specs += [
-            pl.BlockSpec((1, bq, 128), lambda g, i, j: (g // h, i, 0)),
-            pl.BlockSpec((1, 8, bk),
-                         lambda g, i, j: (g // h, 0, kvc(i, j))),
+            pl.BlockSpec((1, bq, 128), qi_seg),
+            pl.BlockSpec((1, 8, bk), kj_seg),
         ]
         inputs += [_seg_tile(q_seg, bq), _seg_lane(kv_seg, bk)]
 
-    o_spec = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
-    stat_spec = pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0))
+    o_spec = pl.BlockSpec((1, bq, d), qi)
+    stat_spec = pl.BlockSpec((1, bq, 128), qi)
     stat_shape = jax.ShapeDtypeStruct((b * h, spq, 128), jnp.float32)
     if mode == "out":
         kernel, out_shape, out_specs = (
@@ -411,19 +577,33 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
                      stat_shape, stat_shape]
         out_specs = [o_spec, stat_spec, stat_spec]
 
-    res = pl.pallas_call(
-        functools.partial(kernel, **kw),
-        out_shape=out_shape,
-        grid=(b * h, nq, nk),
-        in_specs=in_specs,
-        out_specs=out_specs,
-        scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),   # m (col 0 used)
-            pltpu.VMEM((bq, 128), jnp.float32),   # l (col 0 used)
-            pltpu.VMEM((bq, d), jnp.float32),     # acc
-        ],
-        interpret=interpret,
-    )(*inputs)
+    scratch = [
+        pltpu.VMEM((bq, 128), jnp.float32),   # m (col 0 used)
+        pltpu.VMEM((bq, 128), jnp.float32),   # l (col 0 used)
+        pltpu.VMEM((bq, d), jnp.float32),     # acc
+    ]
+    if folded:
+        res = pl.pallas_call(
+            functools.partial(kernel, **kw),
+            out_shape=out_shape,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(b * h, sched.shape[1]),
+                in_specs=in_specs,
+                out_specs=out_specs,
+                scratch_shapes=scratch),
+            interpret=interpret,
+        )(jnp.asarray(sched), *inputs)
+    else:
+        res = pl.pallas_call(
+            functools.partial(kernel, **kw),
+            out_shape=out_shape,
+            grid=(b * h, nq, nk),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(*inputs)
 
     if mode == "out":
         return _from_bh(res, b, s, h)
@@ -443,7 +623,7 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
 
 def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                qseg_ref, kseg_ref, *, scale, kv_len, q_len, row0, col0,
-               causal, window=None):
+               causal, window=None, col_shift=0):
     """Rebuild one score block and its softmax-Jacobian products:
     returns ``(p, ds, do_f32)`` with ``p = exp(s − lse)`` the exact
     softmax probabilities and ``ds = p ∘ (dp − delta) · scale``."""
@@ -458,10 +638,13 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         preferred_element_type=jnp.float32) * scale
     mask = _score_mask(
         s.shape, kv_len=kv_len, q_len=q_len, row0=row0, col0=col0,
-        causal=causal, window=window,
+        col_shift=col_shift, causal=causal, window=window,
         qseg=None if qseg_ref is None else qseg_ref[0][:, :1],
-        kseg=None if kseg_ref is None else kseg_ref[0, :1])
-    s = jnp.where(mask, s, NEG_INF)
+        kseg=None if kseg_ref is None else kseg_ref[0, :1],
+        kv_aligned=kv_len % s.shape[1] == 0,
+        q_aligned=q_len % s.shape[0] == 0)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
 
     p = jnp.exp(s - lse)                  # [bq, bk], true probabilities
     dp = lax.dot_general(do, v.astype(jnp.float32),
@@ -472,15 +655,21 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dq_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
-                         causal, window=None, has_segments=False):
-    """Grid (b·h, q_blocks, k_blocks): dQ_i = Σ_j dS_ij K_j (scale folded
-    into dS)."""
+                         causal, window=None, kv_start=0,
+                         has_segments=False, folded=False):
+    """Grid (b·h, q_blocks, k_blocks) — or the folded q-major live-block
+    enumeration: dQ_i = Σ_j dS_ij K_j (scale folded into dS)."""
+    refs, coords, last = _fold_coords(refs, folded)
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
      kseg_ref), (dq_ref,), (dq_scr,) = _unpack(refs, 1, has_segments,
                                                n_base=6)
-    ib, jb = pl.program_id(1), pl.program_id(2)
+    if coords is None:
+        ib, jb = pl.program_id(1), pl.program_id(2)
+        init = jb == 0
+    else:
+        ib, jb, init = coords
 
-    @pl.when(jb == 0)
+    @pl.when(init)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
@@ -488,37 +677,49 @@ def _flash_bwd_dq_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
         _, ds, _ = _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref,
                               delta_ref, qseg_ref, kseg_ref, scale=scale,
                               kv_len=kv_len, q_len=q_len,
-                              row0=ib * block_q, col0=jb * block_k,
+                              row0=ib * block_q,
+                              col0=jb * block_k, col_shift=kv_start,
                               causal=causal, window=window)
         dq_scr[:] += lax.dot_general(
             ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    live = _band_live(ib * block_q, block_q, jb * block_k, block_k,
-                      causal, window)
-    if live is not None:
-        @pl.when(live)
-        def _live():
-            _compute()
-    else:
+    if folded:
         _compute()
+    else:
+        live = _band_live(ib * block_q, block_q,
+                          kv_start + jb * block_k, block_k,
+                          causal, window)
+        if live is not None:
+            @pl.when(live)
+            def _live():
+                _compute()
+        else:
+            _compute()
 
-    @pl.when(jb == pl.num_programs(2) - 1)
+    @pl.when(last)
     def _finalize():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
-                          causal, window=None, has_segments=False):
-    """Grid (b·h, k_blocks, q_blocks): dV_j = Σ_i P_ijᵀ dO_i and
-    dK_j = Σ_i dS_ijᵀ Q_i (scale folded into dS). Padded Q rows contribute
-    exactly zero because their dO rows are zero-padded."""
+                          causal, window=None, kv_start=0,
+                          has_segments=False, folded=False):
+    """Grid (b·h, k_blocks, q_blocks) — or the folded k-major live-block
+    enumeration: dV_j = Σ_i P_ijᵀ dO_i and dK_j = Σ_i dS_ijᵀ Q_i (scale
+    folded into dS). Padded Q rows contribute exactly zero because their
+    dO rows are zero-padded."""
+    refs, coords, last = _fold_coords(refs, folded)
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
      kseg_ref), (dk_ref, dv_ref), (dk_scr, dv_scr) = _unpack(
         refs, 2, has_segments, n_base=6)
-    jb, ib = pl.program_id(1), pl.program_id(2)
+    if coords is None:
+        jb, ib = pl.program_id(1), pl.program_id(2)
+        init = ib == 0
+    else:
+        jb, ib, init = coords
 
-    @pl.when(ib == 0)
+    @pl.when(init)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -527,7 +728,8 @@ def _flash_bwd_dkv_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
         p, ds, do = _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref,
                                delta_ref, qseg_ref, kseg_ref, scale=scale,
                                kv_len=kv_len, q_len=q_len,
-                               row0=ib * block_q, col0=jb * block_k,
+                               row0=ib * block_q,
+                               col0=jb * block_k, col_shift=kv_start,
                                causal=causal, window=window)
         dv_scr[:] += lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
@@ -535,19 +737,23 @@ def _flash_bwd_dkv_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    # Same band, transposed view: the block is live iff its row range
-    # intersects the k block's attended-row band — which is exactly the
-    # q-major predicate with the same coordinates.
-    live = _band_live(ib * block_q, block_q, jb * block_k, block_k,
-                      causal, window)
-    if live is not None:
-        @pl.when(live)
-        def _live():
-            _compute()
-    else:
+    if folded:
         _compute()
+    else:
+        # Same band, transposed view: the block is live iff its row range
+        # intersects the k block's attended-row band — which is exactly
+        # the q-major predicate with the same coordinates.
+        live = _band_live(ib * block_q, block_q,
+                          kv_start + jb * block_k, block_k,
+                          causal, window)
+        if live is not None:
+            @pl.when(live)
+            def _live():
+                _compute()
+        else:
+            _compute()
 
-    @pl.when(ib == pl.num_programs(2) - 1)
+    @pl.when(last)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -556,7 +762,7 @@ def _flash_bwd_dkv_kernel(*refs, scale, kv_len, q_len, block_q, block_k,
 def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
                         block_q=None, block_k=None, interpret=None,
                         causal: bool = False, out_dtype=None,
-                        segment_ids=None, window=None):
+                        segment_ids=None, window=None, kv_start: int = 0):
     """The flash backward as a standalone op: ``(dq, dk, dv)`` from saved
     forward state. ``lse``/``delta`` are [B, S, H] f32 — the row logsumexp
     from the forward and ``rowsum(dO ∘ O)``. Exposed (not just wired into
@@ -571,6 +777,7 @@ def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
 
     scale, block_q, block_k, interpret = _resolve(
         q, scale, block_q, block_k, interpret)
+    kv_start = _static_kv_start(kv_start)
     b, s, h, d = q.shape
     kv_len = k.shape[1]
     bq, bk = min(block_q, s), min(block_k, kv_len)
@@ -586,12 +793,18 @@ def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
     nq, nk = spq // bq, spk // bk
 
     has_seg = segment_ids is not None
+    sched_q = _fold_schedule(nq, nk, bq, bk, causal, window, "q",
+                             kv_start=kv_start)
+    folded = sched_q is not None
     kw = dict(scale=scale, kv_len=kv_len, q_len=s, block_q=bq, block_k=bk,
-              causal=causal, window=window, has_segments=has_seg)
-    kvc = _kv_clamp(causal, bq, bk, window=window, nk=nk)
-    q_spec_i = pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0))
-    kv_spec_j = pl.BlockSpec((1, bk, d), lambda g, i, j: (g, kvc(i, j), 0))
-    stat_spec_i = pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0))
+              causal=causal, window=window, kv_start=kv_start,
+              has_segments=has_seg, folded=folded)
+
+    # dQ pass: q-major — outer/inner = (q block i, k block j).
+    qi, kj, qi_seg, kj_seg = _index_maps(folded, h)
+    q_spec_i = pl.BlockSpec((1, bq, d), qi)
+    kv_spec_j = pl.BlockSpec((1, bk, d), kj)
+    stat_spec_i = pl.BlockSpec((1, bq, 128), qi)
 
     in_specs = [q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, stat_spec_i,
                 stat_spec_i]
@@ -599,66 +812,75 @@ def flash_attention_bwd(q, k, v, do, lse, delta, scale=None,
     if has_seg:
         q_seg, kv_seg = _norm_segments(segment_ids)
         in_specs += [
-            pl.BlockSpec((1, bq, 128), lambda g, i, j: (g // h, i, 0)),
-            pl.BlockSpec((1, 8, bk),
-                         lambda g, i, j: (g // h, 0, kvc(i, j))),
+            pl.BlockSpec((1, bq, 128), qi_seg),
+            pl.BlockSpec((1, 8, bk), kj_seg),
         ]
         inputs += [_seg_tile(q_seg, bq), _seg_lane(kv_seg, bk)]
 
-    dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, **kw),
-        out_shape=jax.ShapeDtypeStruct(qb.shape, dq_dt),
-        grid=(b * h, nq, nk),
-        in_specs=in_specs,
-        out_specs=q_spec_i,
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        interpret=interpret,
-    )(*inputs)
-
-    # dK/dV grid: k blocks outer, q blocks inner (fastest). Causal live
-    # region is i >= ceil((j·bk − bq + 1)/bq) = (j·bk)//bq; clamping the
-    # q-side maps into it makes the dead head of each j-row fetch-free
-    # (same repeat-index trick as the forward).
-    if causal or window is not None:
-        def qc(j, i):
-            # Bounded into [0, nq-1]: with kv_len > q_len the trailing k
-            # rows have NO live q block at all, and an unbounded clamp
-            # would index past the q array on those fully-dead j-rows.
-            out = i
-            if causal:
-                out = jnp.maximum(out, (j * bk) // bq)
-            elif window is not None:
-                out = jnp.maximum(
-                    out, jnp.maximum(0, (j * bk - window + 1) // bq))
-            if window is not None:
-                out = jnp.minimum(
-                    out, (j * bk + bk - 1 + window - 1) // bq)
-            return jnp.clip(out, 0, nq - 1)
+    dq_scratch = [pltpu.VMEM((bq, d), jnp.float32)]
+    dq_shape = jax.ShapeDtypeStruct(qb.shape, dq_dt)
+    if folded:
+        dq = pl.pallas_call(
+            functools.partial(_flash_bwd_dq_kernel, **kw),
+            out_shape=dq_shape,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(b * h, sched_q.shape[1]),
+                in_specs=in_specs,
+                out_specs=q_spec_i,
+                scratch_shapes=dq_scratch),
+            interpret=interpret,
+        )(jnp.asarray(sched_q), *inputs)
     else:
-        def qc(j, i):
-            return i
-    q_spec = pl.BlockSpec((1, bq, d), lambda g, j, i: (g, qc(j, i), 0))
-    kv_spec = pl.BlockSpec((1, bk, d), lambda g, j, i: (g, j, 0))
-    stat_spec = pl.BlockSpec((1, bq, 128),
-                             lambda g, j, i: (g, qc(j, i), 0))
+        dq = pl.pallas_call(
+            functools.partial(_flash_bwd_dq_kernel, **kw),
+            out_shape=dq_shape,
+            grid=(b * h, nq, nk),
+            in_specs=in_specs,
+            out_specs=q_spec_i,
+            scratch_shapes=dq_scratch,
+            interpret=interpret,
+        )(*inputs)
+
+    # dK/dV pass: k-major — outer/inner = (k block j, q block i).
+    qi2, kj2, qi2_seg, kj2_seg = _index_maps(folded, h, q_major=False)
+    q_spec = pl.BlockSpec((1, bq, d), qi2)
+    kv_spec = pl.BlockSpec((1, bk, d), kj2)
+    stat_spec = pl.BlockSpec((1, bq, 128), qi2)
     in_specs2 = [q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec]
     if has_seg:
         in_specs2 += [
-            pl.BlockSpec((1, bq, 128),
-                         lambda g, j, i: (g // h, qc(j, i), 0)),
-            pl.BlockSpec((1, 8, bk), lambda g, j, i: (g // h, 0, j)),
+            pl.BlockSpec((1, bq, 128), qi2_seg),
+            pl.BlockSpec((1, 8, bk), kj2_seg),
         ]
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, **kw),
-        out_shape=[jax.ShapeDtypeStruct(kb_.shape, dk_dt),
-                   jax.ShapeDtypeStruct(vb.shape, dv_dt)],
-        grid=(b * h, nk, nq),
-        in_specs=in_specs2,
-        out_specs=[kv_spec, kv_spec],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
-        interpret=interpret,
-    )(*inputs)
+    dkv_shapes = [jax.ShapeDtypeStruct(kb_.shape, dk_dt),
+                  jax.ShapeDtypeStruct(vb.shape, dv_dt)]
+    dkv_scratch = [pltpu.VMEM((bk, d), jnp.float32),
+                   pltpu.VMEM((bk, d), jnp.float32)]
+    if folded:
+        sched_k = _fold_schedule(nq, nk, bq, bk, causal, window, "k",
+                                 kv_start=kv_start)
+        dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_dkv_kernel, **kw),
+            out_shape=dkv_shapes,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(b * h, sched_k.shape[1]),
+                in_specs=in_specs2,
+                out_specs=[kv_spec, kv_spec],
+                scratch_shapes=dkv_scratch),
+            interpret=interpret,
+        )(jnp.asarray(sched_k), *inputs)
+    else:
+        dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_dkv_kernel, **kw),
+            out_shape=dkv_shapes,
+            grid=(b * h, nk, nq),
+            in_specs=in_specs2,
+            out_specs=[kv_spec, kv_spec],
+            scratch_shapes=dkv_scratch,
+            interpret=interpret,
+        )(*inputs)
 
     return (_from_bh(dq, b, s, h), _from_bh(dk, b, kv_len, h),
             _from_bh(dv, b, kv_len, h))
@@ -749,7 +971,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "block_q", "block_k",
-                                    "interpret", "causal", "window"))
+                                    "interpret", "causal", "window",
+                                    "kv_start"))
 def flash_attention_fwd_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                             scale: float | None = None,
                             block_q: int | None = None,
@@ -757,7 +980,8 @@ def flash_attention_fwd_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                             interpret: bool | None = None,
                             causal: bool = False,
                             segment_ids: jax.Array | None = None,
-                            window: int | None = None):
+                            window: int | None = None,
+                            kv_start: int = 0):
     """Forward with residual: ``(out [B,S,H,D], lse [B,S,H] f32)``.
 
     The save-for-backward interface: ``lse`` is the row logsumexp, the
@@ -771,12 +995,14 @@ def flash_attention_fwd_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     scale, block_q, block_k, interpret = _resolve(
         q, scale, block_q, block_k, interpret)
     return _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
-                     mode="lse", segment_ids=segment_ids, window=window)
+                     mode="lse", segment_ids=segment_ids, window=window,
+                     kv_start=_static_kv_start(kv_start))
 
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "block_q", "block_k",
-                                    "interpret", "causal", "window"))
+                                    "interpret", "causal", "window",
+                                    "kv_start"))
 def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
                           scale: float | None = None,
                           block_q: int | None = None,
@@ -784,7 +1010,8 @@ def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
                           interpret: bool | None = None,
                           causal: bool = False,
                           segment_ids: jax.Array | None = None,
-                          window: int | None = None):
+                          window: int | None = None,
+                          kv_start: int = 0):
     """FlashAttention's raw partial-softmax state:
     ``(acc [B,S,H,D] f32 UNNORMALIZED accumulator, m [B,S,H] f32 row max,
     l [B,S,H] f32 normalizer)``; the normalized output is ``acc / l``.
@@ -797,4 +1024,5 @@ def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
     scale, block_q, block_k, interpret = _resolve(
         q, scale, block_q, block_k, interpret)
     return _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
-                     mode="stats", segment_ids=segment_ids, window=window)
+                     mode="stats", segment_ids=segment_ids, window=window,
+                     kv_start=_static_kv_start(kv_start))

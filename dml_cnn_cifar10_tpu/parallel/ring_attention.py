@@ -54,7 +54,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_stats(q, k, v, scale, causal=False, segment_ids=None):
+def _block_stats(q, k, v, scale, causal=False, segment_ids=None,
+                 window=None, kv_start=0):
     """One blockwise attention piece → (m, l, unnormalized acc).
 
     q: [B,Sq,H,D]; k,v: [B,Sk,H,D]. Returns per-row stats for the online
@@ -68,9 +69,15 @@ def _block_stats(q, k, v, scale, causal=False, segment_ids=None):
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     s = mask_scores(s, q.shape[1], k.shape[1], causal=causal,
-                    segment_ids=segment_ids)
+                    segment_ids=segment_ids, window=window,
+                    kv_start=kv_start)
     m = jnp.max(s, axis=-1, keepdims=True)            # [B,H,Sq,1]
     p = jnp.exp(s - m)
+    # Dead rows (every key masked) have m == NEG_INF, so exp(s - m) = 1
+    # for masked entries; zero them so such rows keep l = 0 and the
+    # final normalize emits zeros, matching the flash kernels and
+    # xla_attention (one dead-row contract across all engines).
+    p = jnp.where(s > NEG_INF * 0.5, p, 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)            # [B,H,Sq,1]
     acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return m, l, acc
@@ -88,7 +95,8 @@ def _merge(m1, l1, a1, m2, l2, a2):
     return m, l, a1 * wa1 + a2 * wa2
 
 
-def _block_stats_pallas(q, k, v, scale, causal=False, segment_ids=None):
+def _block_stats_pallas(q, k, v, scale, causal=False, segment_ids=None,
+                        window=None, kv_start=0):
     """The same ``(m, l, acc)`` partials as :func:`_block_stats`, computed
     by the Pallas flash kernel (``flash_attention_stats``): the local
     S/seq × S/seq block runs blocked on the MXU with the score matrix
@@ -97,14 +105,15 @@ def _block_stats_pallas(q, k, v, scale, causal=False, segment_ids=None):
 
     acc, m, l = fa.flash_attention_stats(q, k, v, scale=scale,
                                          causal=causal,
-                                         segment_ids=segment_ids)
+                                         segment_ids=segment_ids,
+                                         window=window, kv_start=kv_start)
     m_ = jnp.transpose(m, (0, 2, 1))[..., None]       # [B,H,Sq,1]
     l_ = jnp.transpose(l, (0, 2, 1))[..., None]
     return m_, l_, acc                                # acc already f32
 
 
 def _block_bwd_jnp(q, k, v, do, lse, delta, scale, causal=False,
-                   segment_ids=None):
+                   segment_ids=None, window=None, kv_start=0):
     """FlashAttention-2 block backward in plain jnp (the short-shard twin
     of ``ops.flash_attention.flash_attention_bwd``): rebuild the block's
     scores, recover exact probabilities from the global ``lse``
@@ -118,7 +127,8 @@ def _block_bwd_jnp(q, k, v, do, lse, delta, scale, causal=False,
     dof = do.astype(jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
     s = mask_scores(s, q.shape[1], k.shape[1], causal=causal,
-                    segment_ids=segment_ids)
+                    segment_ids=segment_ids, window=window,
+                    kv_start=kv_start)
     lse_t = jnp.transpose(lse, (0, 2, 1))[..., None]      # [B,H,Sq,1]
     delta_t = jnp.transpose(delta, (0, 2, 1))[..., None]  # [B,H,Sq,1]
     p = jnp.exp(s - lse_t)                                # exact probs
@@ -150,13 +160,33 @@ def _causal_switch(src, my, full, diag, skip):
     return lax.switch(branch, [full, diag, skip], None)
 
 
+def _window_switch(src, my, causal, diag, left, right, skip):
+    """Ring-step dispatch for sliding-window attention with W ≤ S_local:
+    the band ``|row − col| < W`` only ever reaches the IMMEDIATELY
+    adjacent shards, so a held shard is the diagonal block (local
+    causal+window mask), the left neighbor (columns sit S_local below —
+    static ``kv_start=-S_local`` in the block mask), the right neighbor
+    (bidirectional windows only, ``kv_start=+S_local``), or fully
+    out-of-band (skipped — no FLOPs, no fetch). The W ≤ S_local
+    precondition is asserted at the public entry."""
+    delta = my - src
+    if causal:
+        branch = jnp.where(delta == 0, 0, jnp.where(delta == 1, 1, 2))
+        return lax.switch(branch, [diag, left, skip], None)
+    branch = jnp.where(delta == 0, 0,
+                       jnp.where(delta == 1, 1,
+                                 jnp.where(delta == -1, 2, 3)))
+    return lax.switch(branch, [diag, left, right, skip], None)
+
+
 # ---------------------------------------------------------------------------
 # custom_vjp core. Forward: ring of flash partials, saving (q,k,v,out,lse).
 # Backward: second ring rotating (k, v, dk, dv).
 # ---------------------------------------------------------------------------
 
 
-def _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas, causal):
+def _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas, causal,
+                   window=None):
     nsteps = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
@@ -172,7 +202,19 @@ def _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas, causal):
         src = (my - t) % nsteps          # home index of the held shard
         pair = None if seg is None else (seg, kv_seg)
 
-        if causal:
+        if window is not None:
+            bm, bl, bacc = _window_switch(
+                src, my, causal,
+                lambda _: stats(q, k, v, scale, causal=causal,
+                                window=window, segment_ids=pair),
+                lambda _: stats(q, k, v, scale, causal=False,
+                                window=window, kv_start=-sq,
+                                segment_ids=pair),
+                lambda _: stats(q, k, v, scale, causal=False,
+                                window=window, kv_start=sq,
+                                segment_ids=pair),
+                lambda _: _zero_partials(b, h, sq, d))
+        elif causal:
             bm, bl, bacc = _causal_switch(
                 src, my,
                 lambda _: stats(q, k, v, scale, causal=False,
@@ -195,25 +237,37 @@ def _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas, causal):
     m0, l0, a0 = _zero_partials(b, h, sq, d)
     (k, v, _, m, l, acc), _ = lax.scan(
         body, (k, v, kv_seg0, m0, l0, a0), jnp.arange(nsteps))
-    out = (acc / jnp.transpose(l, (0, 2, 1, 3))).astype(q.dtype)
-    lse = jnp.transpose((m + jnp.log(l))[..., 0], (0, 2, 1))  # [B,Sq,H]
+    # Dead rows (no live key on ANY ring step) end with m == NEG_INF —
+    # the jnp engine also keeps l = 0 there while the Pallas stats
+    # engine may carry garbage l/acc (exp(NEG_INF - NEG_INF) = 1), so
+    # the guard keys on m: emit exact zeros and a LARGE lse so the
+    # backward's p = exp(s - lse) is exactly 0 — the same dead-row
+    # contract as the flash kernels' finalizers (_dead_rows).
+    live = m > NEG_INF * 0.5                                  # [B,H,Sq,1]
+    l_t = jnp.transpose(l, (0, 2, 1, 3))
+    live_t = jnp.transpose(live, (0, 2, 1, 3))
+    out = jnp.where(live_t, acc / jnp.maximum(l_t, 1e-30), 0.0)
+    out = out.astype(q.dtype)
+    lse4 = jnp.where(live, m + jnp.log(jnp.maximum(l, 1e-30)), 1e30)
+    lse = jnp.transpose(lse4[..., 0], (0, 2, 1))              # [B,Sq,H]
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _ring_core(q, k, v, seg, axis_name, scale, use_pallas, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_core(q, k, v, seg, axis_name, scale, use_pallas, causal, window):
     out, _ = _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas,
-                            causal)
+                            causal, window=window)
     return out
 
 
-def _ring_core_fwd(q, k, v, seg, axis_name, scale, use_pallas, causal):
+def _ring_core_fwd(q, k, v, seg, axis_name, scale, use_pallas, causal,
+                   window):
     out, lse = _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas,
-                              causal)
+                              causal, window=window)
     return out, (q, k, v, seg, out, lse)
 
 
-def _ring_core_bwd(axis_name, scale, use_pallas, causal, res, do):
+def _ring_core_bwd(axis_name, scale, use_pallas, causal, window, res, do):
     from dml_cnn_cifar10_tpu.ops import flash_attention as fa
 
     q, k, v, seg, out, lse = res
@@ -227,22 +281,33 @@ def _ring_core_bwd(axis_name, scale, use_pallas, causal, res, do):
     # before the cross-step accumulation, matching the jnp twin); the
     # carry accumulates in f32 and casts once at the end.
     if use_pallas:
-        def block_bwd(k_, v_, causal_local, pair):
+        def block_bwd(k_, v_, causal_local, pair, kv_start=0):
             return fa.flash_attention_bwd(q, k_, v_, do, lse, delta,
                                           scale=scale, causal=causal_local,
                                           out_dtype=jnp.float32,
-                                          segment_ids=pair)
+                                          segment_ids=pair, window=window,
+                                          kv_start=kv_start)
     else:
-        def block_bwd(k_, v_, causal_local, pair):
+        def block_bwd(k_, v_, causal_local, pair, kv_start=0):
             return _block_bwd_jnp(q, k_, v_, do, lse, delta, scale,
-                                  causal=causal_local, segment_ids=pair)
+                                  causal=causal_local, segment_ids=pair,
+                                  window=window, kv_start=kv_start)
 
     def body(carry, t):
         k, v, kv_seg, dk, dv, dq = carry
         src = (my - t) % nsteps
         pair = None if seg is None else (seg, kv_seg)
 
-        if causal:
+        if window is not None:
+            sq_ = q.shape[1]
+            dq_c, dk_c, dv_c = _window_switch(
+                src, my, causal,
+                lambda _: block_bwd(k, v, causal, pair),
+                lambda _: block_bwd(k, v, False, pair, kv_start=-sq_),
+                lambda _: block_bwd(k, v, False, pair, kv_start=sq_),
+                lambda _: (jnp.zeros_like(dq), jnp.zeros_like(dk),
+                           jnp.zeros_like(dv)))
+        elif causal:
             dq_c, dk_c, dv_c = _causal_switch(
                 src, my,
                 lambda _: block_bwd(k, v, False, pair),
@@ -282,7 +347,8 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                          axis_name: str, scale: Optional[float] = None,
                          use_pallas: bool = False,
                          causal: bool = False,
-                         segment_ids: Optional[jax.Array] = None
+                         segment_ids: Optional[jax.Array] = None,
+                         window: Optional[int] = None
                          ) -> jax.Array:
     """Per-device body: runs under ``shard_map`` with Q/K/V sequence-sharded
     on ``axis_name``. Shapes [B, S_local, H, D] → [B, S_local, H, D].
@@ -294,11 +360,21 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     ``causal`` masks the global lower triangle and skips above-diagonal
     ring steps entirely. ``segment_ids`` is THIS shard's [B, S_local]
     slice of the packed-sequence ids; visiting K/V shards bring their
-    own ids around the ring."""
+    own ids around the ring. ``window`` is the sliding-window band
+    (global coordinates, same semantics as the flash kernels); it must
+    satisfy ``window <= S_local`` so the band reaches at most the
+    adjacent ring shard (see :func:`_window_switch`)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if window is not None and window > q.shape[1]:
+        raise ValueError(
+            f"ring window {window} exceeds the local shard length "
+            f"{q.shape[1]}; the ring dispatch only visits adjacent "
+            f"shards. Use fewer seq-axis devices (longer shards) or a "
+            f"smaller window.")
     return _ring_core(q, k, v, segment_ids, axis_name, float(scale),
-                      bool(use_pallas and q.shape[1] >= 128), bool(causal))
+                      bool(use_pallas and q.shape[1] >= 128), bool(causal),
+                      None if window is None else int(window))
 
 
 def sp_partition_spec(mesh: Mesh, axis_name: str, seq_len: int,
@@ -348,7 +424,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis_name: str = "seq",
                    use_pallas: bool = False,
                    causal: bool = False,
-                   segment_ids: Optional[jax.Array] = None) -> jax.Array:
+                   segment_ids: Optional[jax.Array] = None,
+                   window: Optional[int] = None) -> jax.Array:
     """Sequence-parallel attention over the mesh's ``seq`` axis.
 
     Global-view entrypoint: [B, S, H, D] arrays (sharded or not); S must be
@@ -361,7 +438,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     the ring.
     """
     kw = dict(axis_name=axis_name, scale=scale, use_pallas=use_pallas,
-              causal=causal)
+              causal=causal, window=window)
     if segment_ids is None:
         local = functools.partial(ring_attention_local, **kw)
         args = (q, k, v)

@@ -449,6 +449,7 @@ def make_train_chunk_resident(
     dataset_labels: jax.Array,
     state_sharding: Optional[TrainState] = None,
     data_cfg: Optional[DataConfig] = None,
+    index_stream: Optional[Tuple[int, int, int]] = None,
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, dict]]:
     """Chunked training against an HBM-resident dataset:
     ``(state, idx [K, B] int32) -> (new_state, metrics of the LAST step)``.
@@ -466,6 +467,14 @@ def make_train_chunk_resident(
     should be placed replicated on ``mesh`` (``jax.device_put`` with
     ``mesh_lib.replicated``) before building the step. Same math as
     ``make_train_chunk`` on the same indices (tests assert it).
+
+    ``index_stream=(seed, global_batch, K)`` goes one step further
+    (round-3 verdict #4): the shuffled indices are GENERATED ON DEVICE
+    inside the scan (``data/device_stream.py``'s stateless per-epoch
+    pseudo-permutation keyed on ``state.step``), so the chunk signature
+    becomes ``(state,) -> (new_state, metrics)`` — a training dispatch
+    moves NOTHING host→device. Exact resume is free: the stream position
+    is the step itself.
     """
     if data_cfg is None:
         # The resident input is ALWAYS raw uint8 from HBM; without a
@@ -474,16 +483,55 @@ def make_train_chunk_resident(
         raise ValueError(
             "make_train_chunk_resident requires data_cfg (the gathered "
             "dataset rows are raw uint8 and must be decoded on device)")
-    body = _chunk_body(
-        _fsdp_gather_wrap(
-            _forward_loss(model_def, model_cfg, mesh=mesh,
-                          label_smoothing=optim_cfg.label_smoothing),
-            mesh, model_cfg, state_sharding),
-        optim_cfg, data_cfg)
+    loss = _fsdp_gather_wrap(
+        _forward_loss(model_def, model_cfg, mesh=mesh,
+                      label_smoothing=optim_cfg.label_smoothing),
+        mesh, model_cfg, state_sharding)
 
     spatial = mesh_lib.spatial_enabled(model_def, mesh)
+    repl = mesh_lib.replicated(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
+
+    body = _chunk_body(loss, optim_cfg, data_cfg)
     gathered_sh = mesh_lib.batch_sharding(mesh, 5, leading_dims=1,
                                           spatial=spatial)
+
+    if index_stream is not None:
+        from dml_cnn_cifar10_tpu.data import device_stream
+
+        seed, global_batch, k = index_stream
+        n = dataset_images.shape[0]
+        idx_sh2 = mesh_lib.batch_sharding(mesh, 2, leading_dims=1)
+
+        def chunk_dev(ds_images, ds_labels, state: TrainState):
+            # The whole chunk's [K, B] indices in one vectorized call
+            # from state.step — then the identical whole-chunk gather +
+            # vectorized decode as the host-index path (a per-step
+            # in-scan gather measured ~10 % slower).
+            idx = device_stream.chunk_shuffle_indices(
+                seed, state.step, global_batch, k, n)
+            idx = lax.with_sharding_constraint(idx, idx_sh2)
+            images = ds_images[idx]
+            if spatial:
+                images = lax.with_sharding_constraint(images, gathered_sh)
+            return body(state, images, ds_labels[idx])
+
+        jitted_dev = jax.jit(
+            chunk_dev,
+            in_shardings=(repl, repl, state_sh),
+            out_shardings=(state_sh, repl),
+            donate_argnums=2,
+        )
+        fn = functools.partial(jitted_dev, dataset_images, dataset_labels)
+
+        def lower_dev(*abs_args):
+            from dml_cnn_cifar10_tpu.utils.profiling import abstractify
+            return jitted_dev.lower(*abstractify((dataset_images,
+                                                  dataset_labels)),
+                                    *abs_args)
+
+        fn.lower = lower_dev
+        return fn
 
     def chunk(dataset_images, dataset_labels, state: TrainState, idx):
         # Device-side gather: [K, B] indices into the HBM-resident arrays.
@@ -495,8 +543,6 @@ def make_train_chunk_resident(
             images = lax.with_sharding_constraint(images, gathered_sh)
         return body(state, images, dataset_labels[idx])
 
-    repl = mesh_lib.replicated(mesh)
-    state_sh = state_sharding if state_sharding is not None else repl
     idx_sh = mesh_lib.batch_sharding(mesh, 2, leading_dims=1)
     jitted = jax.jit(
         chunk,

@@ -46,7 +46,8 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                             scale: Optional[float] = None,
                             use_pallas: bool = False,
                             causal: bool = False,
-                            segment_ids: Optional[jax.Array] = None
+                            segment_ids: Optional[jax.Array] = None,
+                            window: Optional[int] = None
                             ) -> jax.Array:
     """Per-device body under ``shard_map``: Q/K/V sequence-sharded
     ``[B, S_local, H, D]`` → out ``[B, S_local, H, D]``.
@@ -61,7 +62,8 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     if n == 1:
         return attn.dispatch_attention(q, k, v, use_pallas=use_pallas,
                                        scale=scale, causal=causal,
-                                       segment_ids=segment_ids)
+                                       segment_ids=segment_ids,
+                                       window=window)
     if segment_ids is not None:
         # Per-position ids are tiny (~2 B/token): all-gather the
         # sequence-sharded ids so the post-all-to-all full-sequence
@@ -74,7 +76,8 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
         lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
         for t in (q, k, v))
     o = attn.dispatch_attention(q, k, v, use_pallas=use_pallas, scale=scale,
-                                causal=causal, segment_ids=segment_ids)
+                                causal=causal, segment_ids=segment_ids,
+                                window=window)
     # [B, S, H/n, D] -> [B, S/n, H, D]
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
@@ -85,7 +88,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                       axis_name: str = "seq",
                       use_pallas: bool = False,
                       causal: bool = False,
-                      segment_ids: Optional[jax.Array] = None) -> jax.Array:
+                      segment_ids: Optional[jax.Array] = None,
+                      window: Optional[int] = None) -> jax.Array:
     """Sequence-parallel attention via head/sequence all-to-all.
 
     Global-view entrypoint, same contract as
@@ -106,7 +110,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
             f"{nseq}; use ring attention for head counts the axis can't "
             f"split")
     kw = dict(axis_name=axis_name, scale=scale, use_pallas=use_pallas,
-              causal=causal)
+              causal=causal, window=window)
     if segment_ids is None:
         local = functools.partial(ulysses_attention_local, **kw)
         args = (q, k, v)

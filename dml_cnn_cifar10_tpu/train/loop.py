@@ -278,10 +278,19 @@ class Trainer:
                     return (shard + idx * num_shards).astype(np.int32)
                 return idx
 
+            # Device-generated index stream: the training dispatch takes
+            # ONLY the donated state — no host index generation, no H2D
+            # upload, and exact resume is free (the stream position is
+            # state.step). Requires the global row space: the full split
+            # is replicated in HBM, and the stateless stream emits GLOBAL
+            # rows directly (identical on every process by purity).
+            dev_stream = cfg.data.device_index_stream
             chunk_fn = step_lib.make_train_chunk_resident(
                 self.model_def, cfg.model, cfg.optim, self.mesh,
                 ds_images, ds_labels,
-                state_sharding=self.state_sharding, data_cfg=cfg.data)
+                state_sharding=self.state_sharding, data_cfg=cfg.data,
+                index_stream=((cfg.data.seed, cfg.batch_size, k)
+                              if dev_stream else None))
             idx_sh = mesh_lib.batch_sharding(self.mesh, 2, leading_dims=1)
             # Eval also goes resident: boundary train-accuracy is index-fed
             # from the in-HBM train split, test eval is one dispatch over
@@ -322,9 +331,15 @@ class Trainer:
                     self.model_def, cfg.model, self.mesh, t_images,
                     t_labels, cfg.data, state_sharding=self.state_sharding)
 
-            def produce():
-                local = train_it.next_index_chunk(k)
-                return (mesh_lib.place_local(idx_sh, to_global(local)),)
+            if dev_stream:
+                def produce():
+                    # The chunk generates its own indices in-graph; a
+                    # dispatch has no data arguments at all.
+                    return ()
+            else:
+                def produce():
+                    local = train_it.next_index_chunk(k)
+                    return (mesh_lib.place_local(idx_sh, to_global(local)),)
 
             prefetch = pipe.PrefetchIterator(
                 iter(produce, None), depth=cfg.data.prefetch, place=None)

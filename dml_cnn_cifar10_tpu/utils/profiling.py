@@ -108,16 +108,20 @@ def correct_stack_flops(f: float, depth: int, bf_counted: Optional[float],
     counted as 0. Given one block's standalone measurements —
     ``bf_counted`` (as the step runs it) and ``bf_true``
     (dense-equivalent, fully counted) — swap the counted contribution
-    for the true cost at full depth. ``f < 2·bf_counted`` discriminates
-    scan-once (the body appears ~once in ``f``) from an unrolled /
-    per-iteration count (it appears ~depth times). Returns the input
+    for the true cost at full depth. A scan-once count contains the body
+    ~once (``f ≈ overhead + bf_counted``); an unrolled / per-iteration
+    count contains it ~``depth`` times (``f ≥ depth·bf_counted``). The
+    midpoint ``(1+depth)/2 · bf_counted`` separates the two regimes even
+    when non-stack step FLOPs (embed/head/optimizer) exceed one block's
+    counted FLOPs — the old fixed ``2·bf_counted`` threshold mislabeled
+    such steps per-iteration (round-3 advisor finding). Returns the input
     unchanged with label ``probe_failed`` when the block numbers are
     unusable — the caller must then NOT publish the (known ~1/depth
     wrong) figure as honest.
     """
     if not (depth and depth > 1 and bf_counted and bf_true):
         return f, "probe_failed"
-    if f < 2 * bf_counted:
+    if f < (1 + depth) / 2 * bf_counted:
         return f - bf_counted + depth * bf_true, f"scan_once_x{depth}"
     return f + depth * (bf_true - bf_counted), "per_iteration"
 

@@ -1,0 +1,94 @@
+"""Device-side index stream (data/device_stream.py) — round-4 verdict #4.
+
+The stateless per-epoch pseudo-permutation must be a REAL permutation
+(every record exactly once per epoch), deterministic in (seed, step), and
+the resident chunk built on it must train identically whether resumed or
+not — exact-resume with zero sidecar state.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dml_cnn_cifar10_tpu.data import device_stream as ds
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 640, 1000, 49999, 50000])
+def test_epoch_is_exact_permutation(n):
+    b = 64
+    steps = (n + b - 1) // b + 1
+    f = jax.jit(lambda s: ds.epoch_shuffle_indices(3, s, b, n))
+    rows = np.concatenate([np.asarray(f(s)) for s in range(steps)])[:n]
+    assert rows.min() >= 0 and rows.max() < n
+    assert len(np.unique(rows)) == n
+
+
+def test_epochs_differ_and_seed_matters():
+    n, b = 1000, 50
+    f = jax.jit(lambda seed, s: ds.epoch_shuffle_indices(seed, s, b, n))
+    e0 = np.concatenate([np.asarray(f(7, s)) for s in range(n // b)])
+    e1 = np.concatenate([np.asarray(f(7, s))
+                         for s in range(n // b, 2 * n // b)])
+    other = np.concatenate([np.asarray(f(8, s)) for s in range(n // b)])
+    assert not np.array_equal(e0, e1)
+    assert not np.array_equal(e0, other)
+    # determinism
+    again = np.concatenate([np.asarray(f(7, s)) for s in range(n // b)])
+    np.testing.assert_array_equal(e0, again)
+
+
+def test_chunk_matches_per_step_stream():
+    """chunk_shuffle_indices(step0, k) must be exactly the k per-step
+    batches starting at step0 — the whole-chunk vectorization cannot
+    change the stream."""
+    n, b, k = 777, 32, 5
+    chunk = np.asarray(jax.jit(
+        lambda s: ds.chunk_shuffle_indices(11, s, b, k, n))(jnp.uint32(3)))
+    per_step = np.stack([
+        np.asarray(ds.epoch_shuffle_indices(11, 3 + i, b, n))
+        for i in range(k)])
+    np.testing.assert_array_equal(chunk, per_step)
+
+
+def test_resident_chunk_device_stream_resumes_exactly(data_cfg):
+    """Two dispatches of the device-stream resident chunk == one run of
+    the same four steps: the stream position is state.step, so a resumed
+    state continues the data order bit-exactly with NO sidecar."""
+    from dml_cnn_cifar10_tpu.config import ModelConfig, OptimConfig
+    from dml_cnn_cifar10_tpu.data import pipeline as pipe
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
+    from dml_cnn_cifar10_tpu.config import ParallelConfig
+
+    mesh = mesh_lib.build_mesh(ParallelConfig(), devices=jax.devices()[:2])
+    model_cfg = ModelConfig()
+    optim_cfg = OptimConfig()
+    model_def = get_model(model_cfg.name)
+    it = pipe.input_pipeline(data_cfg, 16, train=True)
+    repl = mesh_lib.replicated(mesh)
+    ds_images = jax.device_put(it.images, repl)
+    ds_labels = jax.device_put(it.labels.astype("int32"), repl)
+
+    def build(k):
+        return step_lib.make_train_chunk_resident(
+            model_def, model_cfg, optim_cfg, mesh, ds_images, ds_labels,
+            data_cfg=data_cfg, index_stream=(data_cfg.seed, 16, k))
+
+    def init():
+        return step_lib.init_train_state(
+            jax.random.key(0), model_def, model_cfg, data_cfg, optim_cfg,
+            mesh)
+
+    chunk2, chunk4 = build(2), build(4)
+    s_a = init()
+    s_a, _ = chunk2(s_a)
+    s_a, m_a = chunk2(s_a)         # "resumed" second dispatch
+    s_b = init()
+    s_b, m_b = chunk4(s_b)         # uninterrupted
+    assert int(jax.device_get(s_a.step)) == 4
+    np.testing.assert_allclose(float(m_a["loss"]), float(m_b["loss"]),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(s_a.params), jax.tree.leaves(s_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -199,6 +199,11 @@ def test_correct_stack_flops_cases():
     assert (f, lb) == (10.0 - 8.0 + 12 * 9.0, "scan_once_x12")
     f, lb = correct_stack_flops(100.0, 12, 8.0, 9.0)
     assert (f, lb) == (100.0 + 12 * 1.0, "per_iteration")
+    # Round-3 advisor case: scan-once step whose non-stack FLOPs exceed
+    # one block (f = overhead 20 + one body 8) must NOT flip to
+    # per_iteration under the depth-aware threshold.
+    f, lb = correct_stack_flops(28.0, 12, 8.0, 9.0)
+    assert lb == "scan_once_x12"
     for bad in [(0, 8.0, 9.0), (12, None, 9.0), (12, 8.0, None),
                 (1, 8.0, 9.0)]:
         f, lb = correct_stack_flops(10.0, *bad)

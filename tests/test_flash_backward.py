@@ -308,6 +308,88 @@ def test_flash_window_composes_with_segments():
     _assert_close(g, g_ref, atol=2e-5)
 
 
+@pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_sp_attention_window(sp_mode, causal):
+    """Sliding-window attention through sequence parallelism (round-4):
+    the ring only visits the diagonal and adjacent shards (W <= S_local,
+    static kv_start offsets in the block masks); Ulysses passes the
+    window to its full-sequence local kernel. S_local=128 clears the
+    ring's >=128 Pallas gate, so the PALLAS kv_start path really runs
+    (a 64-token shard silently fell back to the jnp engine — round-4
+    review finding); W=100 < S_local straddles every shard boundary.
+    Values and grads vs the global dense reference."""
+    from dml_cnn_cifar10_tpu.parallel import ring_attention as ring
+    from dml_cnn_cifar10_tpu.parallel import ulysses
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("data", "seq"))
+    q, k, v = _qkv((1, 512, 4, 16), seed=21)   # 4 heads: ulysses needs
+    W = 100                                    # heads % seq_axis == 0
+    sp_fn = ring.ring_attention if sp_mode == "ring" \
+        else ulysses.ulysses_attention
+    out = sp_fn(q, k, v, mesh, use_pallas=True, causal=causal, window=W)
+    ref = attn.xla_attention(q, k, v, causal=causal, window=W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-6)
+    g = _grads(lambda q, k, v: sp_fn(q, k, v, mesh, use_pallas=True,
+                                     causal=causal, window=W), q, k, v)
+    g_ref = _grads(lambda q, k, v: attn.xla_attention(
+        q, k, v, causal=causal, window=W), q, k, v)
+    _assert_close(g, g_ref, atol=5e-5)
+
+
+@pytest.mark.parametrize("kv_start", [-192, 0, 192])
+def test_flash_kv_start_unaligned_parity(kv_start):
+    """kv_start (ring neighbor offsets) with an UNALIGNED kv length
+    (192, not a block multiple): the padded-column bound must key on the
+    LOCAL column while the window band sees the SHIFTED global column —
+    conflating them attends zero-padding (kv_start<0) or masks the whole
+    shard (kv_start>0) (round-4 review finding, reproduced both ways)."""
+    q, k, v = _qkv((1, 192, 1, 16), seed=31)
+    W = 64
+    out, lse = fa.flash_attention_fwd_lse(q, k, v, window=W, causal=False,
+                                          kv_start=kv_start, block_q=128,
+                                          block_k=128)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (16 ** -0.5)
+    s = attn.mask_scores(s, 192, 192, window=W, kv_start=kv_start)
+    probs = jax.nn.softmax(s, axis=-1)
+    live = jnp.max(s, axis=-1, keepdims=True) > -5e29
+    probs = jnp.where(live, probs, 0.0)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-6)
+
+
+def test_ring_window_composes_with_segments():
+    """window x segment_ids through the ring: the packed local-attention
+    LM layout at sequence-parallel scale. Segment boundary (100) and the
+    W=40 band both straddle the 64-token shard boundaries."""
+    from dml_cnn_cifar10_tpu.parallel import ring_attention as ring
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("data", "seq"))
+    q, k, v = _qkv((2, 256, 2, 16), seed=22)
+    seg = jnp.concatenate([jnp.zeros((2, 100), jnp.int32),
+                           jnp.ones((2, 156), jnp.int32)], axis=1)
+    kw = dict(causal=True, window=40, segment_ids=seg)
+    out = ring.ring_attention(q, k, v, mesh, use_pallas=True, **kw)
+    ref = attn.xla_attention(q, k, v, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-6)
+    g = _grads(lambda q, k, v: ring.ring_attention(
+        q, k, v, mesh, use_pallas=True, **kw), q, k, v)
+    g_ref = _grads(lambda q, k, v: attn.xla_attention(q, k, v, **kw),
+                   q, k, v)
+    _assert_close(g, g_ref, atol=5e-5)
+
+
+def test_ring_window_rejects_oversized_window():
+    """W > S_local cannot be dispatched by the adjacent-shard ring switch
+    and must fail loudly, not return silently wrong attention."""
+    from dml_cnn_cifar10_tpu.parallel import ring_attention as ring
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("data", "seq"))
+    q, k, v = _qkv((1, 256, 2, 16), seed=23)
+    with pytest.raises(ValueError, match="exceeds the local shard"):
+        ring.ring_attention(q, k, v, mesh, window=65)
+
+
 def test_window_fully_dead_rows_are_finite_and_inert():
     """A cross-length window geometry can leave Q rows with NO keys at
     all (row - window + 1 >= kv_len). Those rows must emit zeros, not

@@ -178,3 +178,39 @@ def test_two_steps_no_structure_change():
         st, metrics = train(st, jnp.asarray(images), jnp.asarray(labels))
     assert int(st.step) == 2
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_s2d_stem_folded_kernel_equivalence():
+    """The space-to-depth stem (--resnet_s2d) computes the SAME function
+    as the 7x7/2 stem when the 7x7 kernel is folded into the 4x4x(4C)
+    parameterization (zero-pad to 8x8; ws[m,n,(a,b,c)] = w8[2m+a,2n+b,c]
+    with the XLA SAME pad lo=2 mapping to folded pad (1,2)) — the MLPerf
+    transform is a re-parameterization, not a different model
+    (BASELINE.md round-4)."""
+    from dml_cnn_cifar10_tpu.models import resnet
+
+    cfg7 = ModelConfig(name="resnet50", logit_relu=False)
+    cfgs = ModelConfig(name="resnet50", logit_relu=False, resnet_s2d=True)
+    data = DataConfig(crop_height=96, crop_width=96, num_classes=10)
+    k = jax.random.key(0)
+    p7 = resnet.init_params(k, cfg7, data, depth=50)
+    ps = resnet.init_params(k, cfgs, data, depth=50)
+    assert ps["stem"]["conv"].shape == (4, 4, 12, 64)
+
+    w7 = np.asarray(p7["stem"]["conv"])
+    w8 = np.zeros((8, 8, 3, 64), np.float32)
+    w8[:7, :7] = w7
+    ws = np.zeros((4, 4, 12, 64), np.float32)
+    for m in range(4):
+        for n in range(4):
+            for a in range(2):
+                for b in range(2):
+                    ws[m, n, a * 6 + b * 3: a * 6 + b * 3 + 3] = \
+                        w8[2 * m + a, 2 * n + b]
+    ps["stem"]["conv"] = jnp.asarray(ws)
+
+    x = jnp.asarray(np.random.default_rng(0).normal(0, 1, (2, 96, 96, 3)),
+                    jnp.float32)
+    o7, _ = resnet.apply(p7, resnet.init_state(p7), x, cfg7, train=True)
+    os_, _ = resnet.apply(ps, resnet.init_state(ps), x, cfgs, train=True)
+    np.testing.assert_allclose(np.asarray(os_), np.asarray(o7), atol=1e-4)

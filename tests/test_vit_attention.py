@@ -92,6 +92,49 @@ def test_flash_mixed_block_sizes():
                                atol=2e-5)
 
 
+def test_xla_attention_dead_rows_emit_zeros():
+    """A row with NO live key (here: disjoint q/kv segment ids) must emit
+    exact zeros — matching the flash kernels' _safe_l behavior — not a
+    uniform average of V (round-3 advisor finding)."""
+    rng = np.random.default_rng(11)
+    shape = (1, 8, 1, 16)
+    q = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    # Rows 0-3 live in segment 0; keys all live in segment 1 → rows 0-3
+    # are fully masked. Rows 4-7 share segment 1 and stay live.
+    q_seg = jnp.asarray([[0, 0, 0, 0, 1, 1, 1, 1]], jnp.int32)
+    kv_seg = jnp.ones((1, 8), jnp.int32)
+    out = attn.xla_attention(q, k, v, segment_ids=(q_seg, kv_seg))
+    np.testing.assert_array_equal(np.asarray(out[0, :4]), 0.0)
+    assert np.abs(np.asarray(out[0, 4:])).max() > 0
+
+
+def test_flash_dead_rows_match_xla_zeros():
+    """Same dead-row geometry through the flash kernel: segment-masked
+    dead rows must ALSO emit zeros (and a large lse so the backward can't
+    leak gradient through them) — the cross-engine contract."""
+    rng = np.random.default_rng(12)
+    s = 256
+    shape = (1, s, 1, 32)
+    q = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    # First half of queries in segment 0, ALL keys in segment 1:
+    # rows 0..127 have no live key anywhere.
+    seg_q = jnp.asarray(np.repeat([0, 1], s // 2)[None], jnp.int32)
+    seg_kv = jnp.ones((1, s), jnp.int32)
+    out, lse = fa.flash_attention_fwd_lse(q, k, v,
+                                          segment_ids=(seg_q, seg_kv),
+                                          interpret=True)
+    np.testing.assert_array_equal(np.asarray(out[0, :s // 2]), 0.0)
+    assert np.abs(np.asarray(out[0, s // 2:])).max() > 0
+    assert np.asarray(lse[0, :s // 2]).min() >= 1e29
+    ref = attn.xla_attention(q, k, v, segment_ids=(seg_q, seg_kv))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_flash_extreme_logits_stable():
     """Large score magnitudes: the running-max rescale must not overflow."""
     rng = np.random.default_rng(7)

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""vit_moe on the real chip — the expert-parallelism ladder row.
+
+Round-3 verdict #3: every other parallelism axis has a measured row;
+ep was a correctness checkbox. This benchmark (a) trains ``vit_moe``
+end to end on the chip and reports steady-state img/s + TF/s, (b)
+sweeps capacity factor × expert count and reports the dropped-token
+fraction — the routing-vs-capacity table that tells a user what
+``--moe_capacity_factor`` actually buys.
+
+TF/s uses the MoE step's ALGORITHMIC dense-equivalent flops from XLA
+cost analysis of the single step (the expert einsums are dense ops of
+static shape — no scan accounting involved; the ViT stack correction
+applies as usual via the block probe in real Trainer runs; here depth
+is small and unrolled... we report XLA's own count, honestly labeled).
+
+Usage: python tools/bench_moe.py [--experts 2 4] [--steps 300]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def bench_train(experts: int, steps: int, batch: int, capacity: float):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig,
+                                            OptimConfig, ParallelConfig)
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+    mesh = mesh_lib.build_mesh(ParallelConfig())
+    model_cfg = ModelConfig(name="vit_moe", pool="mean", logit_relu=False,
+                            moe_experts=experts,
+                            moe_capacity_factor=capacity,
+                            compute_dtype="bfloat16")
+    data_cfg = DataConfig(crop_height=32, crop_width=32,
+                          image_height=32, image_width=32)
+    optim_cfg = OptimConfig(optimizer="adamw", learning_rate=1e-3)
+    model_def = get_model("vit_moe")
+
+    sh = step_lib.train_state_shardings(mesh, model_def, model_cfg,
+                                        data_cfg, optim_cfg)
+    state = step_lib.init_train_state(jax.random.key(0), model_def,
+                                      model_cfg, data_cfg, optim_cfg, mesh,
+                                      state_sharding=sh)
+    train = step_lib.make_train_step(model_def, model_cfg, optim_cfg, mesh,
+                                     state_sharding=sh)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(0.5, 0.25, (batch, 32, 32, 3)),
+                         jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, batch), jnp.int32)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+
+    # K steps per dispatch via a plain python loop with end drain (the
+    # one-chip bench pattern; per-dispatch overhead amortizes over the
+    # queued pipeline).
+    state, metrics = train(state, im, lb)
+    float(jax.device_get(metrics["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = train(state, im, lb)
+    float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    img_s = steps * batch / dt
+
+    from dml_cnn_cifar10_tpu.utils.profiling import (abstractify,
+                                                     compiled_flops)
+    flops = compiled_flops(
+        train, (abstractify(state), abstractify(im), abstractify(lb)))
+    tf = (flops * (img_s / batch) / 1e12) if flops else None
+    return {
+        "experts": experts,
+        "capacity_factor": capacity,
+        "images_per_sec": round(img_s, 1),
+        "tflops_per_sec": round(tf, 2) if tf else None,
+        "mfu_vs_197": round(tf / 197.0, 4) if tf else None,
+    }
+
+
+def drop_table(experts_list, capacities, tokens=8192, dim=192):
+    """Dropped-token fraction of the STATIC-capacity router at a
+    realistic activation distribution (unit-normal tokens through a
+    fresh gate): fraction of top-1 assignments that overflow expert
+    queues. The capacity trade: factor f keeps per-expert queues at
+    f x (tokens/experts); overflow tokens pass through the residual
+    unchanged (ops/moe.py docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dml_cnn_cifar10_tpu.ops import moe as moe_ops
+
+    rows = []
+    for e in experts_list:
+        for cf in capacities:
+            key = jax.random.PRNGKey(e * 31 + 1)
+            params = moe_ops.init_moe_params(key, dim, 4 * dim, e)
+            x = jax.random.normal(jax.random.PRNGKey(7),
+                                  (8, tokens // 8, dim), jnp.float32)
+
+            # Rebuild the dispatch exactly as moe_mlp does and count
+            # kept slots vs total assignments.
+            import math
+            t = tokens
+            capacity = max(1, math.ceil(t / e * cf))
+            tok = x.reshape(t, dim)
+            logits = tok @ params["gate"]["kernel"]
+            probs = jax.nn.softmax(logits, axis=-1)
+            idx = jnp.argmax(probs, axis=-1)
+            oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+            position = (jnp.cumsum(oh, axis=0) - 1.0) * oh
+            keep = (oh > 0) & (position < capacity)
+            kept = float(jnp.sum(keep))
+            routed = float(jnp.mean(oh, axis=0).max())
+            rows.append({
+                "experts": e, "capacity_factor": cf,
+                "dropped_frac": round(1.0 - kept / t, 4),
+                "max_expert_load": round(routed, 4),
+            })
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--experts", type=int, nargs="+", default=[2, 4])
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--capacity", type=float, default=1.25)
+    ap.add_argument("--skip-train", action="store_true")
+    args = ap.parse_args()
+
+    if not args.skip_train:
+        for e in args.experts:
+            row = bench_train(e, args.steps, args.batch, args.capacity)
+            print("train:", row, flush=True)
+
+    print("\ndrop-rate vs capacity factor (fresh router, unit-normal "
+          "tokens):")
+    for row in drop_table(args.experts, [1.0, 1.25, 1.5, 2.0]):
+        print("  ", row)
+
+
+if __name__ == "__main__":
+    main()
