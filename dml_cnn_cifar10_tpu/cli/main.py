@@ -115,6 +115,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "4x4/1 conv on the 2x2-folded [112,112,12] input "
                         "instead of 7x7/2 on [224,224,3] - the MLPerf MXU-"
                         "occupancy trick; changes stem param shape")
+    p.add_argument("--resnet_norm", type=str, default="bn",
+                   choices=["bn", "nf"],
+                   help="ResNet normalization: bn (reference semantics, "
+                        "cross-replica BatchNorm) or nf (normalizer-free "
+                        "byte-reduction rung: weight standardization + "
+                        "SkipInit scalars, no stats passes; different "
+                        "training semantics)")
     p.add_argument("--attn_window", type=int, default=None,
                    help="sliding-window (local) attention width for the "
                         "ViT family: band |row-col| < W on every path "
@@ -138,10 +145,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pipeline-parallel mesh degree (stages; schedule "
                         "per --pipe_schedule)")
     p.add_argument("--pipe_schedule", type=str, default="1f1b",
-                   choices=["1f1b", "gpipe"],
+                   choices=["1f1b", "1f1b_ring", "gpipe"],
                    help="pipeline schedule: 1f1b (no bubble compute, "
-                        "O(P) backward memory) or gpipe (round-2 "
-                        "baseline)")
+                        "recompute backward — minimal memory, measured "
+                        "fastest), 1f1b_ring (2F+1B residual-ring "
+                        "backward, opt-in) or gpipe (round-2 baseline)")
     p.add_argument("--pipe_microbatches", type=int, default=0,
                    help="pipeline microbatches per step (0 = one per "
                         "stage). More microbatches shrink 1f1b's live "
@@ -160,14 +168,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "only index slices. The trainer auto-switches to "
                         "the NumPy pipeline for this path (the C++ "
                         "pool's bounded-shuffle stream has no index view)")
-    p.add_argument("--device_index_stream", type="bool", default=False,
+    p.add_argument("--device_index_stream", type="bool", default=True,
                    help="resident path only: generate the shuffled index "
                         "stream ON DEVICE inside the compiled chunk "
                         "(stateless per-epoch pseudo-permutation keyed on "
                         "the global step) — a training dispatch uploads "
-                        "nothing. Different (equally valid) permutation "
-                        "than the host stream; toggling changes data "
-                        "order")
+                        "nothing and exact resume needs no sidecar. "
+                        "Different (equally valid) permutation than the "
+                        "host stream; toggling changes data order. "
+                        "'false' restores the host numpy-PCG stream")
     p.add_argument("--use_native_loader", type="bool", default=True,
                    help="stream batches from the C++ bounded shuffle pool "
                         "(reference RandomShuffleQueue parity); false uses "
@@ -320,6 +329,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.model.attn_window = args.attn_window
     cfg.model.attn_causal = args.attn_causal
     cfg.model.resnet_s2d = args.resnet_s2d
+    cfg.model.resnet_norm = args.resnet_norm
     if args.pool is not None:
         cfg.model.pool = args.pool
     elif args.seq_axis > 1:

@@ -59,8 +59,12 @@ class DataConfig:
     # per-epoch pseudo-permutation keyed on the global step) — a training
     # dispatch then uploads nothing at all. The shuffle is a different
     # (equally valid) permutation than the host stream's numpy-PCG one,
-    # so toggling this flag changes the data order.
-    device_index_stream: bool = False
+    # so toggling this flag changes the data order. Default ON (round-4
+    # verdict #5: throughput parity with host indices, deletes the
+    # exact-resume sidecar, and ships no per-process index arrays at
+    # multi-host scale); --device_index_stream=false restores the host
+    # numpy-PCG stream.
+    device_index_stream: bool = True
     # Use the native C++ record loader when the shared library is available;
     # falls back to the pure-NumPy path otherwise.
     use_native_loader: bool = True
@@ -153,16 +157,28 @@ class ModelConfig:
     # quadruple it. Changes the stem param shape (checkpoints don't
     # interchange across this flag).
     resnet_s2d: bool = False
+    # ResNet normalization: "bn" (reference semantics — cross-replica
+    # BatchNorm) or "nf" (normalizer-free: scaled weight standardization
+    # + SkipInit residual scalars, models/resnet.py). The round-4
+    # roofline showed 76.5% of ResNet-50 step time bandwidth-bound with
+    # BN's stats reductions + normalize store/re-read a big share of the
+    # bytes; "nf" removes those passes entirely — the byte-reduction
+    # rung. Different semantics than the BN ladder rows (no running
+    # stats; checkpoints don't interchange across this flag).
+    resnet_norm: str = "bn"
     # GPipe microbatches per step under pipeline parallelism (0 = one per
     # stage). The bubble fraction is (M+P-1)/M: at the M=P default every
     # stage idles ~half the ticks; M = 4P costs 1/4 the bubble in
     # exchange for microbatches 1/4 the size. The global batch must be
     # divisible by data_axis * M.
     pipe_microbatches: int = 0
-    # Pipeline schedule: "1f1b" (default — bubbles skipped, backward
-    # memory O(P) via the interleaved recompute schedule) or "gpipe"
-    # (the round-2 baseline: always-on stage compute, autodiff through
-    # the scan; kept for comparison benches — parallel/pipeline.py).
+    # Pipeline schedule: "1f1b" (default — bubbles skipped, recompute
+    # backward: 3F+1B, minimal O(P·microbatch) memory; measured faster
+    # than the ring at every benched geometry), "1f1b_ring" (2F+1B
+    # residual-ring backward — opt-in; see parallel/pipeline.py's
+    # measured verdict), or "gpipe" (the round-2 baseline: always-on
+    # stage compute, autodiff through the scan; kept for comparison
+    # benches).
     pipe_schedule: str = "1f1b"
     # Mixture-of-Experts (model name "vit_moe"): every block's MLP becomes
     # a routed expert bank (ops/moe.py) — moe_top_k=1 Switch routing,

@@ -26,6 +26,15 @@ The host path (`data/pipeline.py:_next_indices`) keeps numpy-PCG
 permutations; the two streams are equally-valid shuffles but NOT
 bit-identical — switching ``--device_index_stream`` mid-run changes the
 data order (documented at the flag).
+
+Supported range: stream positions are uint32 (JAX's default int width on
+device — x64 is globally off), so the stream is exact for the first
+``2^32`` SAMPLES (step·batch + i < 2^32); past that the position wraps
+silently, restarting the epoch sequence. ~4.3 B samples is ~86 k CIFAR
+epochs — far past any real run here, but callers must enforce it:
+:func:`check_supported_range` raises at BUILD time from the planned
+``total_steps × batch`` (train/loop.py calls it when the stream is
+enabled; round-4 advisor).
 """
 
 from __future__ import annotations
@@ -89,6 +98,18 @@ def _positions_to_rows(seed: int, j0: jax.Array, count: int,
 
     out = jax.lax.while_loop(cond, walk, out)
     return out.astype(jnp.int32)
+
+
+def check_supported_range(total_steps: int, batch: int) -> None:
+    """Raise if a planned run would walk the stream past the uint32
+    position domain (the silent-wrap hazard — module docstring)."""
+    if total_steps * batch >= 1 << 32:
+        raise ValueError(
+            f"device index stream positions are uint32: total_steps="
+            f"{total_steps} x batch={batch} = {total_steps * batch} "
+            f"samples >= 2^32 would wrap the stream position and repeat "
+            f"the epoch sequence. Use --device_index_stream=false for "
+            f"runs this long.")
 
 
 def epoch_shuffle_indices(seed: int, step: jax.Array, batch: int,

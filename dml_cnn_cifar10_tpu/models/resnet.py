@@ -17,6 +17,15 @@ No reference counterpart (the reference model is the 5-layer CNN,
 - All convs are bias-free (BN's offset absorbs the bias); final BN of each
   residual branch is gamma-zero-initialized so blocks start as identity —
   standard large-batch trick, keeps the big-LR parity regime stable.
+- ``cfg.resnet_norm="nf"`` swaps every BN for scaled weight
+  standardization (per-kernel fan-in standardize + learnable gain —
+  weight bytes only) + per-conv biases + a SkipInit residual scalar
+  (init 0 — identity start, like the gamma-zero BN). The round-4
+  roofline measured 76.5% of the ResNet-50 step bandwidth-bound with
+  BN's stats/normalize passes among the top byte movers; nf removes
+  every activation-sized stats read/write. Different training semantics
+  (the NFNet line of work shows the class reaches BN-level accuracy
+  with care); benched in BASELINE.md as the byte-reduction rung.
 """
 
 from __future__ import annotations
@@ -78,6 +87,44 @@ def _init_bottleneck_block(key, cin: int, width: int, stride: int, dtype):
     return p, cout
 
 
+def _init_nf_basic_block(key, cin: int, width: int, stride: int, dtype):
+    ks = jax.random.split(key, 3)
+    p: Params = {
+        "conv1": _conv_init(ks[0], (3, 3, cin, width), dtype),
+        "g1": jnp.ones((width,), dtype), "c1": jnp.zeros((width,), dtype),
+        "conv2": _conv_init(ks[1], (3, 3, width, width), dtype),
+        "g2": jnp.ones((width,), dtype), "c2": jnp.zeros((width,), dtype),
+        # SkipInit: the residual branch enters at 0 — blocks start as
+        # identity, the NF analog of the gamma-zero BN init above.
+        "skip_gain": jnp.zeros((), dtype),
+    }
+    if stride != 1 or cin != width:
+        p["proj"] = _conv_init(ks[2], (1, 1, cin, width), dtype)
+        p["gp"] = jnp.ones((width,), dtype)
+        p["cp"] = jnp.zeros((width,), dtype)
+    return p, width
+
+
+def _init_nf_bottleneck_block(key, cin: int, width: int, stride: int,
+                              dtype):
+    cout = width * BOTTLENECK_EXPANSION
+    ks = jax.random.split(key, 4)
+    p: Params = {
+        "conv1": _conv_init(ks[0], (1, 1, cin, width), dtype),
+        "g1": jnp.ones((width,), dtype), "c1": jnp.zeros((width,), dtype),
+        "conv2": _conv_init(ks[1], (3, 3, width, width), dtype),
+        "g2": jnp.ones((width,), dtype), "c2": jnp.zeros((width,), dtype),
+        "conv3": _conv_init(ks[2], (1, 1, width, cout), dtype),
+        "g3": jnp.ones((cout,), dtype), "c3": jnp.zeros((cout,), dtype),
+        "skip_gain": jnp.zeros((), dtype),
+    }
+    if stride != 1 or cin != cout:
+        p["proj"] = _conv_init(ks[3], (1, 1, cin, cout), dtype)
+        p["gp"] = jnp.ones((cout,), dtype)
+        p["cp"] = jnp.zeros((cout,), dtype)
+    return p, cout
+
+
 def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig,
                 depth: int = 18) -> Params:
     if depth not in STAGES:
@@ -86,8 +133,16 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig,
     blocks, kind = STAGES[depth]
     dtype = jnp.dtype(cfg.dtype)
     imagenet_stem = min(data.crop_height, data.crop_width) > 64
-    init_block = (_init_bottleneck_block if kind == "bottleneck"
-                  else _init_basic_block)
+    nf = cfg.resnet_norm == "nf"
+    if cfg.resnet_norm not in ("bn", "nf"):
+        raise ValueError(
+            f"resnet_norm must be 'bn' or 'nf', got {cfg.resnet_norm!r}")
+    if nf:
+        init_block = (_init_nf_bottleneck_block if kind == "bottleneck"
+                      else _init_nf_basic_block)
+    else:
+        init_block = (_init_bottleneck_block if kind == "bottleneck"
+                      else _init_basic_block)
 
     keys = jax.random.split(key, 2 + sum(blocks))
     ki = iter(range(len(keys)))
@@ -103,7 +158,11 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig,
         stem_k = (7, 7) if imagenet_stem else (3, 3)
         stem_shape = (*stem_k, data.num_channels, 64)
     p["stem"] = {"conv": _conv_init(keys[next(ki)], stem_shape, dtype)}
-    p["stem"]["bn"] = L.bn_init(64, dtype)
+    if nf:
+        p["stem"]["g"] = jnp.ones((64,), dtype)
+        p["stem"]["c"] = jnp.zeros((64,), dtype)
+    else:
+        p["stem"]["bn"] = L.bn_init(64, dtype)
 
     cin = 64
     for si, (n, width) in enumerate(zip(blocks, STAGE_WIDTHS)):
@@ -181,6 +240,44 @@ def _bottleneck_block(x, p, s, stride, cfg, train, axis_name):
     return jax.nn.relu(x + h), ns
 
 
+def _ws_conv(w, gain, eps: float = 1e-4):
+    """Scaled weight standardization (NF-ResNet recipe): standardize the
+    kernel over its (kh, kw, cin) fan-in and scale by a learnable
+    per-output-channel gain. Touches only WEIGHT bytes — the activation
+    tensor never takes the extra stats read/write BatchNorm forces,
+    which is the whole point of the nf rung (round-4 roofline: 76.5% of
+    ResNet-50 step time bandwidth-bound)."""
+    mu = jnp.mean(w, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(w, axis=(0, 1, 2), keepdims=True)
+    fan_in = w.shape[0] * w.shape[1] * w.shape[2]
+    return (w - mu) * lax.rsqrt(var * fan_in + eps) * gain
+
+
+def _nf_basic_block(x, p, s, stride, cfg, train, axis_name):
+    del s, train, axis_name  # stateless — no running stats
+    h = jax.nn.relu(L.conv2d(x, _ws_conv(p["conv1"], p["g1"]),
+                             stride=stride) + p["c1"])
+    h = L.conv2d(h, _ws_conv(p["conv2"], p["g2"])) + p["c2"]
+    if "proj" in p:
+        x = L.conv2d(x, _ws_conv(p["proj"], p["gp"]),
+                     stride=stride) + p["cp"]
+    ns = {k: None for k in p}
+    return jax.nn.relu(x + p["skip_gain"] * h), ns
+
+
+def _nf_bottleneck_block(x, p, s, stride, cfg, train, axis_name):
+    del s, train, axis_name
+    h = jax.nn.relu(L.conv2d(x, _ws_conv(p["conv1"], p["g1"])) + p["c1"])
+    h = jax.nn.relu(L.conv2d(h, _ws_conv(p["conv2"], p["g2"]),
+                             stride=stride) + p["c2"])
+    h = L.conv2d(h, _ws_conv(p["conv3"], p["g3"])) + p["c3"]
+    if "proj" in p:
+        x = L.conv2d(x, _ws_conv(p["proj"], p["gp"]),
+                     stride=stride) + p["cp"]
+    ns = {k: None for k in p}
+    return jax.nn.relu(x + p["skip_gain"] * h), ns
+
+
 def apply(params: Params, state: State, images: jax.Array, cfg: ModelConfig,
           train: bool = True, axis_name: Optional[str] = None
           ) -> Tuple[jax.Array, State]:
@@ -192,8 +289,13 @@ def apply(params: Params, state: State, images: jax.Array, cfg: ModelConfig,
     stem_kh = p["stem"]["conv"].shape[0]
     imagenet_stem = stem_kh == 7
     s2d_stem = stem_kh == 4
-    block = (_bottleneck_block if "bn3" in p["stage1"][0]
-             else _basic_block)
+    nf = "g" in p["stem"]                      # static pytree property
+    if nf:
+        block = (_nf_bottleneck_block if "conv3" in p["stage1"][0]
+                 else _nf_basic_block)
+    else:
+        block = (_bottleneck_block if "bn3" in p["stage1"][0]
+                 else _basic_block)
     if cfg.remat:
         # Recompute each residual block's activations in the backward
         # pass — the same O(1)-in-depth activation-memory lever the ViT
@@ -210,6 +312,8 @@ def apply(params: Params, state: State, images: jax.Array, cfg: ModelConfig,
     # Mirror init_state's structure exactly: a treedef change between step 1
     # and step 2 would silently retrigger compilation.
     new_state: State = {"fc": {"kernel": None, "bias": None}}
+    stem_w = (_ws_conv(p["stem"]["conv"], p["stem"]["g"]) if nf
+              else p["stem"]["conv"])
     if s2d_stem:
         # Space-to-depth: [B,2h,2w,C] -> [B,h,w,4C] (2x2 phases into
         # channels), then the stride-1 4x4 conv with explicit padding
@@ -222,14 +326,18 @@ def apply(params: Params, state: State, images: jax.Array, cfg: ModelConfig,
         x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
             b_, hh // 2, ww // 2, 4 * c_)
         x = lax.conv_general_dilated(
-            x, p["stem"]["conv"], window_strides=(1, 1),
+            x, stem_w, window_strides=(1, 1),
             padding=((1, 2), (1, 2)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
     else:
-        x = L.conv2d(x, p["stem"]["conv"], stride=2 if imagenet_stem else 1)
-    x, stem_bn = _bn(x, p["stem"]["bn"], state["stem"]["bn"], cfg, train,
-                     axis_name)
-    new_state["stem"] = {"conv": None, "bn": stem_bn}
+        x = L.conv2d(x, stem_w, stride=2 if imagenet_stem else 1)
+    if nf:
+        x = x + p["stem"]["c"]
+        new_state["stem"] = {"conv": None, "g": None, "c": None}
+    else:
+        x, stem_bn = _bn(x, p["stem"]["bn"], state["stem"]["bn"], cfg,
+                         train, axis_name)
+        new_state["stem"] = {"conv": None, "bn": stem_bn}
     x = jax.nn.relu(x)
     if imagenet_stem or s2d_stem:
         x = L.max_pool(x, window=3, stride=2)

@@ -118,7 +118,8 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
 def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
            capacity_factor: float, mesh=None, sp_mode: str = "ring",
            moe_top_k: int = 1, causal: bool = False, window=None):
-    """One transformer block → ``(x, aux_loss)`` (aux 0.0 for dense MLP)."""
+    """One transformer block → ``(x, aux)`` — ``aux`` is the MoE router
+    stats dict (ops/moe.py) for MoE blocks, scalar 0.0 for dense MLPs."""
     b, s, dim = x.shape
     h = layer_norm(x, p["ln1"])
     qkv = L.dense(h, p["qkv"]["kernel"], p["qkv"]["bias"])
@@ -150,9 +151,9 @@ def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
                     p["proj"]["bias"])
     h = layer_norm(x, p["ln2"])
     if "moe" in p:
-        y, aux = moe_ops.moe_mlp(h, p["moe"], capacity_factor,
-                                 top_k=moe_top_k)
-        return x + y, aux
+        y, stats = moe_ops.moe_mlp(h, p["moe"], capacity_factor,
+                                   top_k=moe_top_k)
+        return x + y, stats
     h = jax.nn.gelu(L.dense(h, p["mlp1"]["kernel"], p["mlp1"]["bias"]))
     return x + L.dense(h, p["mlp2"]["kernel"], p["mlp2"]["bias"]), \
         jnp.zeros((), jnp.float32)
@@ -166,10 +167,13 @@ def apply(params: Params, images: jax.Array, cfg: ModelConfig,
 
 def apply_with_aux(params: Params, images: jax.Array, cfg: ModelConfig,
                    train: bool = True, mesh=None):
-    """NHWC images → ``(logits [B, num_classes], aux_loss scalar)``.
+    """NHWC images → ``(logits [B, num_classes], aux)``.
 
-    ``aux_loss`` is the summed MoE load-balance loss over blocks (0 for
-    dense MLPs). ``mesh`` with a ``seq`` axis >1 switches attention to the
+    For MoE stacks ``aux`` is the router-stats dict accumulated over
+    blocks: ``aux_loss`` summed (the caller scales it into the loss),
+    ``dropped_frac`` / ``expert_load`` depth-averaged — the numbers the
+    Trainer metrics stream publishes. For dense MLPs ``aux`` is the
+    scalar 0.0. ``mesh`` with a ``seq`` axis >1 switches attention to the
     ring (sequence-parallel) kernel and keeps token activations sharded
     [data, seq] between blocks; requires ``pool='mean'`` (no cls token) and
     a token count divisible by the ``seq`` axis.
@@ -251,12 +255,26 @@ def apply_with_aux(params: Params, images: jax.Array, cfg: ModelConfig,
             # forward of FLOPs, cheap on the MXU).
             block_fn = jax.checkpoint(block_fn)
 
+        if cfg.moe_experts:
+            # Zero-stats carry matching ops/moe.py's dict (the stacked
+            # block params are structurally uniform, so every scan tick
+            # adds the same pytree).
+            aux = {"aux_loss": aux,
+                   "dropped_frac": jnp.zeros((), jnp.float32),
+                   "expert_load": jnp.zeros((cfg.moe_experts,),
+                                            jnp.float32)}
+
         def body(carry, bp):
             h, aux_sum = carry
             h, block_aux = block_fn(h, bp)
-            return (h, aux_sum + block_aux), None
+            return (h, jax.tree.map(jnp.add, aux_sum, block_aux)), None
 
         (x, aux), _ = lax.scan(body, (x, aux), p["blocks"])
+        if cfg.moe_experts:
+            depth = jax.tree.leaves(p["blocks"])[0].shape[0]
+            aux = {"aux_loss": aux["aux_loss"],
+                   "dropped_frac": aux["dropped_frac"] / depth,
+                   "expert_load": aux["expert_load"] / depth}
     x = layer_norm(x, p["ln_f"])
     pooled = jnp.mean(x, axis=1) if cfg.pool == "mean" else x[:, 0]
     logits = L.dense(pooled, p["head"]["kernel"], p["head"]["bias"])

@@ -385,7 +385,13 @@ def _flash_stats_kernel(*refs, has_segments: bool = False,
     @pl.when(last)
     def _finalize():
         acc_ref[0] = acc_scr[:]
-        m_ref[0] = m_scr[:]
+        # Only m_scr[:, :1] is ever written (the per-row init); lanes
+        # 1..127 are launch-lifetime VMEM garbage — broadcast the col-0
+        # stat so the published tile has no uninitialized values (a NaN
+        # scanner or a future full-tile consumer would otherwise see
+        # garbage; round-4 advisor). l_scr's lanes 1..127 were zeroed by
+        # _zero_all and never touched again, so l publishes clean as-is.
+        m_ref[0] = jnp.broadcast_to(m_scr[:, :1], m_ref.shape[1:])
         l_ref[0] = l_scr[:]
 
 
@@ -582,6 +588,15 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret, causal,
         pltpu.VMEM((bq, 128), jnp.float32),   # l (col 0 used)
         pltpu.VMEM((bq, d), jnp.float32),     # acc
     ]
+    # LOAD-BEARING: every grid below (incl. the b*h axis) must execute
+    # SEQUENTIALLY on one core — _flash_update zeroes l/acc only at the
+    # very first tick of the launch and relies on the alpha =
+    # exp(NEG_INF − m) = 0 rescale to clear stale scratch between rows
+    # (0·NaN = NaN would break that for unzeroed scratch). That holds
+    # for Pallas-TPU's default 'arbitrary' dimension semantics; if
+    # dimension_semantics is ever added here, the b*h axis must NOT be
+    # marked 'parallel' unless _zero_all becomes per-row (round-4
+    # advisor).
     if folded:
         res = pl.pallas_call(
             functools.partial(kernel, **kw),

@@ -52,8 +52,8 @@ def init_moe_params(key: jax.Array, dim: int, hidden: int, num_experts: int,
 
 
 def moe_mlp(x: jax.Array, params: Params, capacity_factor: float,
-            top_k: int = 1) -> Tuple[jax.Array, jax.Array]:
-    """Top-k MoE MLP: ``[B,S,D] -> ([B,S,D], aux_loss scalar)``.
+            top_k: int = 1) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Top-k MoE MLP: ``[B,S,D] -> ([B,S,D], router stats dict)``.
 
     ``top_k=1`` is Switch routing (output scaled by the router prob p1);
     ``top_k=2`` is GShard routing (two experts per token, combine weights
@@ -62,6 +62,17 @@ def moe_mlp(x: jax.Array, params: Params, capacity_factor: float,
     expert parallelism. First-choice assignments take queue priority over
     second choices, so under capacity pressure a token loses its backup
     expert before anyone loses their primary.
+
+    The stats dict carries the router's health for the metrics stream
+    (round-4 verdict #1 — no capability without a number):
+
+    - ``aux_loss``  — load-balance loss (differentiable; the ONLY entry
+      gradients flow through — the caller scales it into the train loss);
+    - ``dropped_frac`` — fraction of the T*k expert assignments that
+      overflowed a capacity queue this batch (those tokens ride the
+      residual unchanged);
+    - ``expert_load`` — [E] fraction of first-choice assignments routed
+      to each expert (uniform = 1/E; a collapsed router shows a spike).
     """
     b, s, d = x.shape
     e = params["w1"].shape[0]
@@ -114,4 +125,12 @@ def moe_mlp(x: jax.Array, params: Params, capacity_factor: float,
     f = jnp.mean(ranks[0][0], axis=0)                          # [E]
     p = jnp.mean(probs, axis=0)                                # [E]
     aux = e * jnp.sum(f * p)
-    return y.reshape(b, s, d), aux
+    # sum(dispatch) counts kept (token, rank) assignments: each surviving
+    # assignment contributed exactly one 1.0 slot one-hot.
+    stats = {
+        "aux_loss": aux,
+        "dropped_frac": jax.lax.stop_gradient(
+            1.0 - jnp.sum(dispatch) / float(t * top_k)),
+        "expert_load": jax.lax.stop_gradient(f),
+    }
+    return y.reshape(b, s, d), stats

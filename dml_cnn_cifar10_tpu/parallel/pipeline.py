@@ -30,16 +30,36 @@ transfers with per-stage compute.
   ordinary differentiable op, so the step's grad-accum scan wraps it like
   any other model body.
 
-The recompute is the full-remat flavor of 1F1B, and its price is TWO
-extra forwards in the backward: the just-in-time re-forward that feeds
-the ring (each stage must regenerate its successor's input), plus the
-primal replay inside ``jax.vjp`` at the consuming tick (the two run in
-different scan ticks, so XLA cannot CSE them). Total: 3 forwards + 1
-backward of stage FLOPs, vs 2F+1B for remat'd GPipe — the premium buys
-the O(P·microbatch) residual footprint. Saving per-layer vjp residuals
-in the ring instead would trade the replay forward back for
-O(layers/stage) memory per live microbatch; a future optimization if
-profiling says the FLOPs matter more than the headroom.
+Two 1F1B backward flavors (``schedule="1f1b"`` keeps the full-remat
+default; ``"1f1b_ring"`` opts into the residual ring):
+
+- **Recompute (default)** — the ring stores only each stage's
+  microbatch INPUT; the consuming tick replays the primal inside
+  ``jax.vjp``. Total 3 forwards + 1 backward (the re-forward and the
+  replay run in different scan ticks, so XLA cannot CSE them), with
+  the minimal O(P·microbatch) activation footprint.
+- **Residual ring (round-4 verdict #3, built round 5)** — the
+  just-in-time re-forward runs under ``jax.vjp`` and the ring stores
+  the flattened VJP RESIDUALS (weight passthroughs filtered out by
+  tracer identity — they stay loop-invariant closures, never
+  duplicated per slot); the consuming tick applies the stored linear
+  backward. Total 2 forwards + 1 backward, memory 2P slots × the
+  per-microbatch activation-residual set (still flat in M).
+
+**Measured verdict (tools/bench_pp.py, 8-virtual-CPU substrate,
+round 5): the ring LOSES to recompute at every geometry tried** —
+dim 64: 180 vs 126 ms (M=P), 213 vs 173 (M=4P); dim 256 batch 64:
+3167 vs 2830 (M=P), 3385 vs 2733 (M=4P) — so recompute stays the
+default and the ring ships opt-in. Mechanism: a transformer block's
+residual set is ~10 activation-sized tensors per microbatch, so the
+ring's store+load traffic exceeds the replay's FLOP cost until the
+stage's arithmetic intensity is much higher (replay FLOPs grow
+O(dim²·tokens), ring bytes O(dim·tokens) — the crossover sits at
+dim ≈ thousands on real TPU ratios, and this substrate never reached
+it). The negative result is recorded here the same way the maxpool-bwd
+and block-512 rejections are (ops/layers.py, ops/flash_attention.py),
+so it isn't silently retried; geometry where the ring should win can
+be re-checked any time with ``bench_pp.py --dim``.
 
 Composition: ``pipe`` composes with ``data`` (batch stays sharded
 outside). Tensor/sequence axes inside a pipelined stack would need
@@ -57,7 +77,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-SCHEDULES = ("1f1b", "gpipe")
+SCHEDULES = ("1f1b", "1f1b_ring", "gpipe")
 
 
 def _validate(x, stacked_params, mesh, num_microbatches):
@@ -90,8 +110,11 @@ def pipeline_blocks(
     block_fn: ``(x_microbatch, one_layer_params) -> x_microbatch``.
 
     Returns the global ``[B, S, D]`` output (same sharding as ``x``).
-    ``schedule``: ``"1f1b"`` (no bubble compute, O(P) backward memory) or
-    ``"gpipe"`` (round-2 baseline, kept for comparison benches).
+    ``schedule``: ``"1f1b"`` (no bubble compute, recompute backward —
+    3F+1B, minimal O(P·microbatch) memory; the measured default),
+    ``"1f1b_ring"`` (residual-ring backward — 2F+1B, measured slower
+    here; see module docstring), or ``"gpipe"`` (round-2 baseline, kept
+    for comparison benches).
     """
     if schedule not in SCHEDULES:
         raise ValueError(f"unknown pipeline schedule {schedule!r}; "
@@ -104,7 +127,8 @@ def pipeline_blocks(
     nstages, m = _validate(x, stacked_params, mesh, num_microbatches)
     if schedule == "gpipe":
         return _gpipe(x, stacked_params, block_fn, mesh, nstages, m)
-    return _one_f_one_b(x, stacked_params, block_fn, mesh, nstages, m)
+    return _one_f_one_b(x, stacked_params, block_fn, mesh, nstages, m,
+                        residual_ring=(schedule == "1f1b_ring"))
 
 
 # ---------------------------------------------------------------------------
@@ -290,14 +314,143 @@ def _1f1b_backward_local(xl, pl, gl, *, stage, nstages, m):
     return lax.psum(dx, "pipe"), dpl
 
 
-def _one_f_one_b(x, stacked_params, block_fn, mesh, nstages, m):
+def _1f1b_ring_backward_local(xl, pl, gl, *, stage, nstages, m):
+    """The residual-ring combined re-forward + backward pipeline (2F+1B).
+
+    Same virtual 2P-stage schedule as ``_1f1b_backward_local``, but the
+    just-in-time re-forward runs under ``jax.vjp`` and the ring stores
+    the FLATTENED VJP RESIDUALS of each live microbatch; the consuming
+    tick rebuilds the vjp Partial from its ring slot and applies the
+    stored linear backward — no primal replay. Ring lifetime analysis is
+    unchanged (slot ``t mod 2P``, max residual lifetime ``2(P−s)−1 <
+    2P`` ticks), so reads always win the race.
+
+    Residual contents are whatever partial-eval saves for a generic
+    ``block_fn`` — per-layer matmul/attention inputs AND the stage
+    weights (needed for ``dx = g·Wᵀ``); the weights replicate into every
+    ring slot, which is the memory premium over the recompute flavor.
+    Memory stays flat in M (``tests/test_pp.py``).
+    """
+    stage_idx = lax.axis_index("pipe")
+    bl, s, d = xl.shape
+    mb = xl.reshape(m, bl // m, s, d)
+    gmb = gl.reshape(m, bl // m, s, d)
+    nring = 2 * nstages
+    perm_f = [(i, (i + 1) % nstages) for i in range(nstages)]
+    perm_b = [(i, (i - 1) % nstages) for i in range(nstages)]
+    zeros = jnp.zeros_like(mb[0])
+
+    # Residual pytree structure (treedef + leaf avals) from one trace of
+    # the stage vjp. Leaves that are PASSTHROUGH INPUTS (the stage
+    # weights — partial-eval forwards unmodified inputs into the
+    # residual set as the same traced value, so identity against pl's
+    # leaves detects them) are loop-invariant: they stay closed over
+    # instead of ring-stored, so the ring never duplicates weights —
+    # only the per-microbatch activation residuals ride it. The
+    # template's microbatch-dependent VALUES are never used (rings init
+    # from fresh zeros), so XLA dead-code-eliminates the trace.
+    pl_leaf_ids = {id(l) for l in jax.tree.leaves(pl)}
+    _, vjp0 = jax.vjp(stage, zeros, pl)
+    leaves0, res_tree = jax.tree.flatten(vjp0)
+    stored = tuple(id(l) not in pl_leaf_ids for l in leaves0)
+    ring0 = tuple(jnp.zeros((nring, *l.shape), l.dtype)
+                  for l, st in zip(leaves0, stored) if st)
+
+    def tick(carry, t):
+        f_in, b_in, rings, dx_buf, dpl = carry
+
+        # --- forward sub-tick: recompute microbatch mf = t - s under
+        # vjp, capturing residuals instead of the raw input.
+        mf = t - stage_idx
+        valid_f = (mf >= 0) & (mf < m)
+        feed = lax.dynamic_index_in_dim(
+            mb, jnp.clip(mf, 0, m - 1), keepdims=False)
+        h_in = jnp.where(stage_idx == 0, feed, f_in)
+
+        def run_fwd(h):
+            h_out, vjp_fn = jax.vjp(stage, h, pl)
+            ls = jax.tree.flatten(vjp_fn)[0]
+            return h_out, tuple(l for l, st in zip(ls, stored) if st)
+
+        def skip_fwd(h):
+            return (jnp.zeros_like(h),
+                    tuple(jnp.zeros(l.shape, l.dtype)
+                          for l, st in zip(leaves0, stored) if st))
+
+        h_out, new_leaves = lax.cond(valid_f, run_fwd, skip_fwd, h_in)
+        # UNCONDITIONAL ring write: slot t mod 2P's previous resident was
+        # consumed by tick t−1 at the latest (lifetime ≤ 2P−1), so a
+        # bubble tick writing zeros never clobbers live state — and
+        # skipping the cond lets XLA lower a true in-place
+        # dynamic-update-slice instead of double-buffering the rings
+        # through both cond branches.
+        rings = tuple(
+            lax.dynamic_update_index_in_dim(
+                r, nl, jnp.asarray(t % nring), axis=0)
+            for r, nl in zip(rings, new_leaves))
+
+        # --- backward sub-tick: microbatch mbb = t - (2P-1-s) applies
+        # its stored linear backward.
+        mbb = t - (2 * nstages - 1 - stage_idx)
+        valid_b = (mbb >= 0) & (mbb < m)
+        g_feed = lax.dynamic_index_in_dim(
+            gmb, jnp.clip(mbb, 0, m - 1), keepdims=False)
+        g_in = jnp.where(stage_idx == nstages - 1, g_feed, b_in)
+        slot = jnp.clip(jnp.asarray((mbb + stage_idx) % nring), 0,
+                        nring - 1)
+        leaves_at = tuple(
+            lax.dynamic_index_in_dim(r, slot, keepdims=False)
+            for r in rings)
+
+        def run_bwd(args):
+            leaves, g = args
+            # Re-interleave ring-stored activation residuals with the
+            # loop-invariant weight residuals (closed over from the
+            # template trace — identical arrays every microbatch).
+            it = iter(leaves)
+            full = [next(it) if st else l0
+                    for l0, st in zip(leaves0, stored)]
+            vjp_fn = jax.tree.unflatten(res_tree, full)
+            return vjp_fn(g)
+
+        def skip_bwd(args):
+            return (jnp.zeros_like(zeros),
+                    jax.tree.map(jnp.zeros_like, pl))
+
+        dh, dp = lax.cond(valid_b, run_bwd, skip_bwd, (leaves_at, g_in))
+        dpl = jax.tree.map(jnp.add, dpl, dp)
+        dx_buf = lax.cond(
+            valid_b & (stage_idx == 0),
+            lambda b: lax.dynamic_update_index_in_dim(
+                b, dh, jnp.clip(mbb, 0, m - 1), axis=0),
+            lambda b: b, dx_buf)
+
+        f_in = lax.ppermute(h_out, "pipe", perm_f)
+        b_in = lax.ppermute(dh, "pipe", perm_b)
+        return (f_in, b_in, rings, dx_buf, dpl), None
+
+    dpl0 = jax.tree.map(jnp.zeros_like, pl)
+    (_, _, _, dx_buf, dpl), _ = lax.scan(
+        tick, (zeros, zeros, ring0, jnp.zeros_like(mb), dpl0),
+        jnp.arange(m + 2 * nstages - 1))
+    dx = dx_buf.reshape(bl, s, d)
+    dx = jnp.where(stage_idx == 0, dx, 0)
+    # Same psum rationale as the recompute flavor (see below).
+    dpl = lax.psum(dpl, "data")
+    return lax.psum(dx, "pipe"), dpl
+
+
+def _one_f_one_b(x, stacked_params, block_fn, mesh, nstages, m,
+                 residual_ring: bool = False):
     stage = _stage_fn(block_fn)
     spec_x, spec_p = _specs(mesh, x, stacked_params)
 
     fwd_local = functools.partial(_1f1b_forward_local, stage=stage,
                                   nstages=nstages, m=m)
-    bwd_local = functools.partial(_1f1b_backward_local, stage=stage,
-                                  nstages=nstages, m=m)
+    bwd_local = functools.partial(
+        _1f1b_ring_backward_local if residual_ring
+        else _1f1b_backward_local,
+        stage=stage, nstages=nstages, m=m)
 
     fwd_sm = jax.shard_map(fwd_local, mesh=mesh, in_specs=(spec_x, spec_p),
                            out_specs=spec_x, check_vma=False)

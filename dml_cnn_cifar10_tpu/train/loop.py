@@ -285,6 +285,12 @@ class Trainer:
             # is replicated in HBM, and the stateless stream emits GLOBAL
             # rows directly (identical on every process by purity).
             dev_stream = cfg.data.device_index_stream
+            if dev_stream:
+                # uint32 position domain — refuse runs that would wrap
+                # (data/device_stream.py module docstring).
+                from dml_cnn_cifar10_tpu.data import device_stream
+                device_stream.check_supported_range(cfg.total_steps,
+                                                    cfg.batch_size)
             chunk_fn = step_lib.make_train_chunk_resident(
                 self.model_def, cfg.model, cfg.optim, self.mesh,
                 ds_images, ds_labels,
@@ -533,14 +539,36 @@ class Trainer:
                             acc_arr = self.eval_step(
                                 state, *self._placed(next(acc_it)))["accuracy"]
                         consumed["acc"] += 1
-                        pair = jax.device_get(
-                            jnp.stack([metrics["loss"],
-                                       jnp.asarray(acc_arr, jnp.float32)]))
+                        # Router health for MoE models (ops/moe.py stats
+                        # via parallel/step.py) rides the SAME fused
+                        # fetch as loss/accuracy: everything concatenates
+                        # into one 1-D f32 array -> one device->host
+                        # round trip per boundary (the ~100 ms-RTT
+                        # tunnel makes a second fetch a real cost).
+                        moe_keys = sorted(mk for mk in metrics
+                                          if mk.startswith("moe_"))
+                        parts = [jnp.reshape(metrics["loss"], (1,)),
+                                 jnp.reshape(
+                                     jnp.asarray(acc_arr, jnp.float32),
+                                     (1,))]
+                        parts += [jnp.reshape(metrics[mk], (-1,)).astype(
+                                      jnp.float32) for mk in moe_keys]
+                        fused = jax.device_get(jnp.concatenate(parts))
                         rate = meter.rate(global_step)
                         drained = True
-                        loss, acc = float(pair[0]), float(pair[1])
+                        loss, acc = float(fused[0]), float(fused[1])
                         train_loss.append(loss)
                         perf = {}
+                        off = 2
+                        for mk in moe_keys:
+                            nleaf = int(np.prod(metrics[mk].shape)) \
+                                if metrics[mk].shape else 1
+                            mv = fused[off:off + nleaf]
+                            off += nleaf
+                            perf[mk] = (round(float(mv[0]), 5)
+                                        if nleaf == 1
+                                        else [round(float(x), 5)
+                                              for x in mv])
                         flops_probe = flops_cell.get("flops")
                         if flops_probe and rate > 0:
                             # steps/sec x flops/step. XLA cost analysis

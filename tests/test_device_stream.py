@@ -24,6 +24,16 @@ def test_epoch_is_exact_permutation(n):
     assert len(np.unique(rows)) == n
 
 
+def test_range_guard_rejects_wrapping_runs():
+    """The uint32 position domain is enforced at build time (round-4
+    advisor): total_steps x batch >= 2^32 must raise, anything under
+    must pass."""
+    ds.check_supported_range(20000, 512)              # CIFAR-scale: fine
+    ds.check_supported_range((1 << 32) // 512 - 1, 512)
+    with pytest.raises(ValueError, match="uint32"):
+        ds.check_supported_range((1 << 32) // 512, 512)
+
+
 def test_epochs_differ_and_seed_matters():
     n, b = 1000, 50
     f = jax.jit(lambda seed, s: ds.epoch_shuffle_indices(seed, s, b, n))

@@ -35,6 +35,7 @@ from dml_cnn_cifar10_tpu.train.loop import Trainer
 total_steps = int(sys.argv[8]) if len(sys.argv) > 8 else 8
 ckpt_format = sys.argv[9] if len(sys.argv) > 9 else "msgpack"
 resident = bool(int(sys.argv[10])) if len(sys.argv) > 10 else True
+dev_stream = bool(int(sys.argv[11])) if len(sys.argv) > 11 else False
 hosts = [f"localhost:{port}"] * n_procs  # coordinator = hosts[0]
 multihost.initialize_from_hosts(hosts, task_index)
 assert jax.process_count() == n_procs
@@ -45,7 +46,8 @@ cfg = TrainConfig(
     steps_per_dispatch=steps_per_dispatch,
     data=DataConfig(dataset="synthetic", data_dir=data_dir,
                     synthetic_train_records=256, synthetic_test_records=64,
-                    normalize="scale", use_native_loader=False),
+                    normalize="scale", use_native_loader=False,
+                    device_index_stream=dev_stream),
 )
 cfg.model.logit_relu = False
 cfg.optim.learning_rate = 0.05
@@ -57,6 +59,17 @@ trainer = Trainer(cfg, task_index=task_index)
 res = trainer.fit()
 nonaddr = any(not x.is_fully_addressable
               for x in jax.tree.leaves(res.state.params))
+# Multi-host safety of the device stream rests on purity: every process
+# must compute the IDENTICAL index sequence. Recompute the first chunks
+# locally and publish a digest for the cross-process assert.
+idx_digest = None
+if dev_stream:
+    import numpy as np
+    from dml_cnn_cifar10_tpu.data import device_stream
+    idx = np.asarray(jax.device_get(device_stream.chunk_shuffle_indices(
+        cfg.data.seed, 0, cfg.batch_size, total_steps, 256)))
+    idx_digest = int(np.int64(np.sum(idx * (np.arange(idx.size).reshape(
+        idx.shape) + 1))))
 from dml_cnn_cifar10_tpu.parallel import multihost as mh
 print("RESULT " + json.dumps({
     "task": task_index,
@@ -66,6 +79,7 @@ print("RESULT " + json.dumps({
     "test_accuracy": res.test_accuracy[-1],
     "is_chief": mh.is_chief(),
     "fsdp_nonaddressable": nonaddr,
+    "idx_digest": idx_digest,
 }))
 """
 
@@ -129,7 +143,8 @@ def test_two_process_exact_resume(tmp_path, data_cfg):
 
 def _run_n_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False,
                      total_steps=8, final_step=8,
-                     ckpt_format="msgpack", resident=True, n=2):
+                     ckpt_format="msgpack", resident=True, n=2,
+                     dev_stream=False):
     port = _free_port()
     data_dir = str(tmp_path / "data")
     log_dir = str(tmp_path / "logs")
@@ -151,7 +166,7 @@ def _run_n_process(tmp_path, data_cfg, steps_per_dispatch, fsdp=False,
             [sys.executable, str(script), str(i), str(n), str(port),
              data_dir, log_dir, str(steps_per_dispatch),
              str(int(fsdp)), str(total_steps), ckpt_format,
-             str(int(resident))],
+             str(int(resident)), str(int(dev_stream))],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=REPO)
         for i in range(n)
@@ -225,6 +240,25 @@ def test_two_process_resident_matches_hostfed(tmp_path, data_cfg):
                            steps_per_dispatch=4, resident=True)
     assert res[0]["losses"] == hostfed[0]["losses"]
     assert res[0]["test_accuracy"] == hostfed[0]["test_accuracy"]
+
+
+@pytest.mark.slow
+def test_two_process_device_index_stream(tmp_path, data_cfg):
+    """Round-4 verdict #5: the device index stream's multi-host story IS
+    the point (no per-process index shipping) — prove it across REAL
+    process boundaries. Both processes must (a) compute bit-identical
+    index streams (purity — the digest is recomputed per process from
+    the stateless stream), and (b) train in lockstep to identical
+    replicated losses, with the training dispatch taking ONLY the
+    donated state."""
+    results = _run_n_process(tmp_path, data_cfg, steps_per_dispatch=4,
+                               resident=True, dev_stream=True)
+    digests = [r["idx_digest"] for r in results]
+    assert digests[0] is not None
+    assert digests[0] == digests[1], digests
+    # (b) is covered by _run_n_process's replicated-loss asserts; the
+    # extra teeth here: the run completed all steps on the device stream.
+    assert all(r["final_step"] == 8 for r in results)
 
 
 @pytest.mark.slow

@@ -214,3 +214,63 @@ def test_s2d_stem_folded_kernel_equivalence():
     o7, _ = resnet.apply(p7, resnet.init_state(p7), x, cfg7, train=True)
     os_, _ = resnet.apply(ps, resnet.init_state(ps), x, cfgs, train=True)
     np.testing.assert_allclose(np.asarray(os_), np.asarray(o7), atol=1e-4)
+
+
+def test_nf_resnet_init_structure_and_identity_start():
+    """--resnet_norm=nf: no BN anywhere (state is all-None), weight-
+    standardized convs + SkipInit zero scalar make every residual block
+    start as identity + projection — the NF analog of gamma-zero BN."""
+    cfg = ModelConfig(name="resnet18", logit_relu=False, resnet_norm="nf")
+    data = DataConfig()
+    params = resnet.init_params(jax.random.key(0), cfg, data, depth=18)
+    state = resnet.init_state(params)
+    assert all(leaf is None for leaf in jax.tree.leaves(
+        state, is_leaf=lambda x: x is None))
+    blk = params["stage1"][0]
+    assert "bn1" not in blk and "skip_gain" in blk
+    assert float(blk["skip_gain"]) == 0.0
+    # Identity start: a non-projection block must pass relu(x) through.
+    x = jnp.abs(jax.random.normal(jax.random.key(1), (2, 8, 8, 64))) + 0.1
+    out, ns = resnet._nf_basic_block(x, blk, None, 1, cfg, True, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+    assert set(ns) == set(blk)
+
+
+@pytest.mark.slow
+def test_nf_resnet_trains_and_state_is_stateless():
+    """The nf rung trains (loss decreases over a few steps) with the
+    standard step machinery; model_state carries no running stats."""
+    from dml_cnn_cifar10_tpu.parallel import shardings
+
+    data = DataConfig(normalize="scale")
+    cfg = ModelConfig(name="resnet18", logit_relu=False, resnet_norm="nf")
+    mesh = mesh_lib.build_mesh(ParallelConfig(data_axis=8))
+    model_def = get_model("resnet18")
+    optim = OptimConfig(learning_rate=0.05)
+    sh = step_lib.train_state_shardings(mesh, model_def, cfg, data, optim)
+    state = step_lib.init_train_state(jax.random.key(0), model_def, cfg,
+                                      data, optim, mesh, state_sharding=sh)
+    train = step_lib.make_train_step(model_def, cfg, optim, mesh,
+                                     state_sharding=sh)
+    rng = np.random.default_rng(0)
+    images, labels = _batch(rng, n=32)
+    im, lb = mesh_lib.shard_batch(mesh, images, labels)
+    losses = []
+    for _ in range(6):
+        state, m = train(state, im, lb)
+        losses.append(float(jax.device_get(m["loss"])))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    assert not jax.tree.leaves(state.model_state)  # truly stateless
+
+
+def test_nf_weight_standardization_properties():
+    """_ws_conv output has zero mean and 1/fan_in variance per output
+    channel (times gain^2) — the scaled-WS contract."""
+    w = jax.random.normal(jax.random.key(0), (3, 3, 16, 32)) * 2.0 + 0.5
+    g = jnp.full((32,), 1.5)
+    ws = resnet._ws_conv(w, g)
+    mu = np.asarray(jnp.mean(ws, axis=(0, 1, 2)))
+    np.testing.assert_allclose(mu, 0.0, atol=1e-6)
+    var = np.asarray(jnp.var(ws, axis=(0, 1, 2)))
+    fan_in = 3 * 3 * 16
+    np.testing.assert_allclose(var, 1.5**2 / fan_in, rtol=1e-3)

@@ -80,10 +80,15 @@ def main():
     p.add_argument("--steps", type=int, default=8)
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--depth", type=int, default=8)
+    p.add_argument("--dim", type=int, default=64,
+                   help="vit_dim — the residual-ring-vs-recompute verdict "
+                        "scales with it (ring IO is O(dim) per token, "
+                        "recompute FLOPs O(dim^2))")
     args = p.parse_args()
 
     base = dict(name="vit_tiny", pool="mean", logit_relu=False,
-                vit_depth=args.depth, vit_dim=64, vit_heads=2, patch_size=4,
+                vit_depth=args.depth, vit_dim=args.dim, vit_heads=2,
+                patch_size=4,
                 use_pallas_attention=False)
     dp2pp4 = ParallelConfig(data_axis=2, pipe_axis=4)
     layouts = [
@@ -92,16 +97,21 @@ def main():
          ParallelConfig(data_axis=4, pipe_axis=2), ModelConfig(**base)),
         ("dp=2 x pp=4 gpipe (M=P)", dp2pp4,
          ModelConfig(**base, pipe_schedule="gpipe")),
-        ("dp=2 x pp=4 1f1b (M=P)", dp2pp4, ModelConfig(**base)),
+        ("dp=2 x pp=4 1f1b-rec (M=P)", dp2pp4, ModelConfig(**base)),
+        ("dp=2 x pp=4 1f1b-ring (M=P)", dp2pp4,
+         ModelConfig(**base, pipe_schedule="1f1b_ring")),
         ("dp=2 x pp=4 gpipe (M=4P)", dp2pp4,
          ModelConfig(**base, pipe_schedule="gpipe", pipe_microbatches=16)),
-        ("dp=2 x pp=4 1f1b (M=4P)", dp2pp4,
+        ("dp=2 x pp=4 1f1b-rec (M=4P)", dp2pp4,
          ModelConfig(**base, pipe_microbatches=16)),
+        ("dp=2 x pp=4 1f1b-ring (M=4P)", dp2pp4,
+         ModelConfig(**base, pipe_schedule="1f1b_ring",
+                     pipe_microbatches=16)),
     ]
     rows = [time_layout(n, pc, mc, args.batch, args.steps)
             for n, pc, mc in layouts]
     ref = rows[0][1]
-    print(f"\nViT depth={args.depth} dim=64 global batch={args.batch}, "
+    print(f"\nViT depth={args.depth} dim={args.dim} global batch={args.batch}, "
           f"{args.steps} timed steps, 8 virtual CPU devices\n")
     print("| layout | step ms | images/sec | temp MiB | vs dp=8 | "
           "final loss |")
