@@ -79,6 +79,22 @@ def _resolve(q, scale, block_q, block_k, interpret):
     # head_dim <= 64 (the ladder's geometry; bigger heads double the
     # block buffers and the fwd acc scratch, re-approaching the VMEM
     # ceiling 2048 hit). 128 still wins below S=2048.
+    # Round-5 negative results on the W=1024-causal gap (7.48x measured
+    # vs the 8x round-3 target; 8.24x is the block-1024 granularity
+    # ceiling), trace-timed fwd+bwd at S=16384 [B=4,H=8,D=64] bf16 vs
+    # 15.39 ms for symmetric 1024 — do NOT retry without new geometry:
+    # - asymmetric folds: bq=512/bk=1024 16.40 ms, bq=1024/bk=512
+    #   19.86 ms, bq=bk=512 16.34 ms. The band-union FLOPs are identical
+    #   at every one of these granularities (the 1024-wide band spans
+    #   the same columns regardless of how blocks tile it), so finer
+    #   blocks only add grid ticks and narrower MXU dots.
+    # - in-tile K-half gating (two 512-wide sub-dots per 1024 tile, each
+    #   under pl.when on its half's band-liveness): 18.95 ms (+23%).
+    #   At W=block geometry the band crosses BOTH halves of nearly every
+    #   live block, so the split skips almost no work and pays the
+    #   doubled mask/softmax-update chain on every tick.
+    # 7.48x stands as the honest number: 91% of what block granularity
+    # admits, and every finer-granularity route measured is a loss.
     d = q.shape[-1]
     auto_block = (1024 if d <= 64 else 512) if s >= 2048 else 128
     block_q = auto_block if block_q is None else block_q
