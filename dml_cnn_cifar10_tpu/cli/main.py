@@ -161,6 +161,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "the model axis (expert parallelism)")
     p.add_argument("--moe_top_k", type=int, default=1,
                    help="experts per token: 1 = Switch, 2 = GShard")
+    p.add_argument("--moe_dispatch", type=str, default="einsum",
+                   choices=["einsum", "scatter"],
+                   help="MoE dispatch/combine: einsum ([T,E,C] one-hot "
+                        "contractions, the ep-proven all-MXU path) or "
+                        "scatter ((expert,slot) scatter/gather — O(T*D) "
+                        "instead of O(T^2*f*D); fastest at long T on "
+                        "one replica). Same semantics either way")
     p.add_argument("--resident_data", type="bool", default=True,
                    help="with --steps_per_dispatch >1, keep the uint8 "
                         "dataset in HBM and gather on device; multi-host "
@@ -365,6 +372,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     if args.model == "vit_moe" and args.moe_experts == 0:
         cfg.model.moe_experts = 8
     cfg.model.moe_top_k = args.moe_top_k
+    cfg.model.moe_dispatch = args.moe_dispatch
     cfg.model.remat = args.remat
     cfg.parallel.explicit_collectives = args.explicit_collectives
     cfg.parallel.fsdp = args.fsdp

@@ -185,6 +185,14 @@ class ModelConfig:
     # 2 GShard — with experts sharded over the ``model`` mesh axis
     # (expert parallelism).
     moe_experts: int = 0                  # 0 = dense MLP
+    # MoE dispatch/combine formulation (ops/moe.py): "einsum" ([T,E,C]
+    # one-hot contractions — the all-MXU, ep-proven path whose dispatch
+    # GSPMD compiles into the expert all-to-all) or "scatter"
+    # ((expert, slot)-indexed scatter/gather — O(T·D) instead of the
+    # einsum pair's O(T²·f·D); measured 2.28x vit_moe step throughput
+    # at 16k tokens on one chip, BASELINE.md round 5). Identical
+    # semantics, pinned bit-comparable by tests.
+    moe_dispatch: str = "einsum"
     moe_top_k: int = 1                    # 1 = Switch, 2 = GShard routing
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01            # load-balance loss weight

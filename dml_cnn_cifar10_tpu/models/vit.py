@@ -117,7 +117,8 @@ def init_params(key: jax.Array, cfg: ModelConfig, data: DataConfig) -> Params:
 
 def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
            capacity_factor: float, mesh=None, sp_mode: str = "ring",
-           moe_top_k: int = 1, causal: bool = False, window=None):
+           moe_top_k: int = 1, causal: bool = False, window=None,
+           moe_dispatch: str = "einsum"):
     """One transformer block → ``(x, aux)`` — ``aux`` is the MoE router
     stats dict (ops/moe.py) for MoE blocks, scalar 0.0 for dense MLPs."""
     b, s, dim = x.shape
@@ -152,7 +153,8 @@ def _block(x: jax.Array, p: Params, heads: int, use_pallas: bool,
     h = layer_norm(x, p["ln2"])
     if "moe" in p:
         y, stats = moe_ops.moe_mlp(h, p["moe"], capacity_factor,
-                                   top_k=moe_top_k)
+                                   top_k=moe_top_k,
+                                   dispatch=moe_dispatch)
         return x + y, stats
     h = jax.nn.gelu(L.dense(h, p["mlp1"]["kernel"], p["mlp1"]["bias"]))
     return x + L.dense(h, p["mlp2"]["kernel"], p["mlp2"]["bias"]), \
@@ -246,7 +248,8 @@ def apply_with_aux(params: Params, images: jax.Array, cfg: ModelConfig,
                           cfg.moe_capacity_factor, mesh=attn_mesh,
                           sp_mode=cfg.sp_mode,
                           moe_top_k=cfg.moe_top_k,
-                          causal=cfg.attn_causal, window=cfg.attn_window)
+                          causal=cfg.attn_causal, window=cfg.attn_window,
+                          moe_dispatch=cfg.moe_dispatch)
 
         if cfg.remat:
             # Recompute block activations in backward: scan(checkpoint)
@@ -331,7 +334,8 @@ def block_flops_probe(model_cfg: ModelConfig, data_cfg: DataConfig,
         def block_fn(x, bp):
             return _block(x, bp, model_cfg.vit_heads, use_pallas,
                           model_cfg.moe_capacity_factor,
-                          moe_top_k=model_cfg.moe_top_k)[0]
+                          moe_top_k=model_cfg.moe_top_k,
+                          moe_dispatch=model_cfg.moe_dispatch)[0]
 
         if model_cfg.remat:
             block_fn = jax.checkpoint(block_fn)

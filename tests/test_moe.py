@@ -263,6 +263,53 @@ def test_top2_vit_moe_trains(rng):
     assert np.isfinite(float(m["loss"]))
 
 
+# ---- scatter dispatch (round 5) ----
+
+def test_scatter_dispatch_matches_einsum():
+    """The O(T·D) scatter/gather dispatch must be bit-comparable to the
+    einsum formulation — output, stats, AND gradients — across top-k
+    and capacity regimes (ample, exact, starved)."""
+    params = _moe_params()
+    x = jax.random.normal(jax.random.key(1), (2, 16, 8))
+    for topk in (1, 2):
+        for cf in (4.0, 1.0, 0.25):
+            y1, s1 = moe.moe_mlp(x, params, cf, top_k=topk,
+                                 dispatch="einsum")
+            y2, s2 = moe.moe_mlp(x, params, cf, top_k=topk,
+                                 dispatch="scatter")
+            np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                       rtol=1e-5, atol=1e-6)
+            assert float(s1["dropped_frac"]) == pytest.approx(
+                float(s2["dropped_frac"]), abs=1e-6)
+            g1 = jax.grad(lambda p: float(0) + jnp.sum(moe.moe_mlp(
+                x, p, cf, top_k=topk, dispatch="einsum")[0] ** 2))(params)
+            g2 = jax.grad(lambda p: float(0) + jnp.sum(moe.moe_mlp(
+                x, p, cf, top_k=topk, dispatch="scatter")[0] ** 2))(params)
+            for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5)
+
+
+def test_moe_rejects_bad_dispatch():
+    params = _moe_params()
+    with pytest.raises(ValueError, match="dispatch"):
+        moe.moe_mlp(jnp.zeros((1, 2, 8)), params, 1.0, dispatch="nope")
+
+
+@pytest.mark.slow
+def test_ep_train_matches_dp_scatter_dispatch(rng):
+    """Expert parallelism composes with the scatter dispatch: experts
+    sharded over the model axis give the same losses as dp-only."""
+    import dataclasses
+    cfg = dataclasses.replace(VIT_MOE, moe_dispatch="scatter")
+    images = rng.normal(0.5, 0.25, (16, 24, 24, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+    _, loss_dp = _run(cfg, _mesh(8), images, labels)
+    st_ep, loss_ep = _run(cfg, _mesh(2, 4), images, labels)
+    np.testing.assert_allclose(loss_dp, loss_ep, rtol=2e-5, atol=2e-6)
+    assert shardings.assert_some_leaf_sharded(st_ep.params)
+
+
 # ---- router stats (round-4 verdict #1) ----
 
 def test_moe_stats_match_hand_count():

@@ -28,7 +28,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def bench_train(experts: int, steps: int, batch: int, capacity: float):
+def bench_train(experts: int, steps: int, batch: int, capacity: float,
+                dispatch: str = "einsum"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -48,7 +49,8 @@ def bench_train(experts: int, steps: int, batch: int, capacity: float):
     model_cfg = ModelConfig(name="vit_moe", pool="mean", logit_relu=False,
                             moe_experts=experts,
                             moe_capacity_factor=capacity,
-                            compute_dtype="bfloat16", remat=True)
+                            compute_dtype="bfloat16", remat=True,
+                            moe_dispatch=dispatch)
     data_cfg = DataConfig(crop_height=32, crop_width=32,
                           image_height=32, image_width=32)
     optim_cfg = OptimConfig(optimizer="adamw", learning_rate=1e-3)
@@ -86,6 +88,7 @@ def bench_train(experts: int, steps: int, batch: int, capacity: float):
     tf = (flops * (img_s / batch) / 1e12) if flops else None
     return {
         "experts": experts,
+        "dispatch": dispatch,
         "capacity_factor": capacity,
         "images_per_sec": round(img_s, 1),
         "tflops_per_sec": round(tf, 2) if tf else None,
@@ -136,12 +139,16 @@ def main():
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--capacity", type=float, default=1.25)
     ap.add_argument("--skip-train", action="store_true")
+    ap.add_argument("--dispatch", type=str, nargs="+",
+                    default=["einsum", "scatter"])
     args = ap.parse_args()
 
     if not args.skip_train:
         for e in args.experts:
-            row = bench_train(e, args.steps, args.batch, args.capacity)
-            print("train:", row, flush=True)
+            for disp in args.dispatch:
+                row = bench_train(e, args.steps, args.batch, args.capacity,
+                                  dispatch=disp)
+                print("train:", row, flush=True)
 
     print("\ndrop-rate vs capacity factor (fresh router, unit-normal "
           "tokens):")
