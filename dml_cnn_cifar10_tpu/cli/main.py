@@ -262,6 +262,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-chip peak TFLOP/s; enables the MFU metric "
                         "in the jsonl stream")
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--telemetry", type="bool", default=False,
+                   help="run-health telemetry: host-loop span tracing, "
+                        "goodput fractions, and HBM snapshots emitted "
+                        "into the metrics JSONL at the existing "
+                        "boundaries (zero extra device fetches; see "
+                        "docs/OBSERVABILITY.md)")
+    p.add_argument("--trace_events_path", type=str, default=None,
+                   help="write the host-loop spans as a Chrome "
+                        "trace-event JSON file (Perfetto-loadable next "
+                        "to the --profile_dir XLA trace); needs "
+                        "--telemetry true")
+    p.add_argument("--health_metrics", type="bool", default=False,
+                   help="compile global grad-norm / param-norm / "
+                        "update-ratio scalars into the train step; they "
+                        "ride the fused boundary fetch into the train "
+                        "JSONL records (no extra round trips)")
     p.add_argument("--tensorboard_dir", type=str, default=None,
                    help="write TensorBoard event files (chief only; the "
                         "reference's MTS wrote summaries to --log_dir)")
@@ -282,6 +298,9 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         checkpoint_every_secs=args.checkpoint_every_secs,
         log_dir=args.log_dir,
         metrics_jsonl=args.metrics_jsonl,
+        telemetry=args.telemetry,
+        trace_events_path=args.trace_events_path,
+        health_metrics=args.health_metrics,
         peak_tflops=args.peak_tflops,
         preempt_sync_every=args.preempt_sync_every,
         check_numerics=args.check_numerics,

@@ -354,6 +354,21 @@ class TrainConfig:
     # reference does.
     check_numerics: bool = False
     metrics_jsonl: Optional[str] = None   # structured metrics sink
+    # Run-health telemetry (utils/telemetry.py): host-loop span tracing
+    # (compile, data wait, dispatch, drain, eval, checkpoint, preemption
+    # sync), cumulative goodput fractions, and HBM snapshots — all riding
+    # the JSONL stream at the existing metrics boundaries, zero extra
+    # device fetches. Off by default: the span context managers then
+    # reduce to a shared no-op.
+    telemetry: bool = False
+    # Chrome trace-event file of the host-loop spans (Perfetto-loadable
+    # next to the XLA trace from profile_dir). Needs telemetry=True;
+    # non-chief processes write <path>.task<N>.
+    trace_events_path: Optional[str] = None
+    # Training-health scalars compiled INTO the step (parallel/step.py):
+    # global grad norm, param norm, update ratio — they ride the fused
+    # boundary fetch (no extra round trips) into the train JSONL records.
+    health_metrics: bool = False
     # Per-chip peak TFLOP/s for the MFU metric (e.g. ~49 fp32 / 197 bf16
     # on v5e). None logs achieved TFLOP/s only.
     peak_tflops: Optional[float] = None

@@ -198,7 +198,33 @@ def _fsdp_gather_wrap(loss_fn, mesh: Optional[Mesh], model_cfg: ModelConfig,
     return gathered
 
 
-def _step_body(loss_fn, optim_cfg: OptimConfig):
+def _global_norm(tree) -> jax.Array:
+    """L2 norm over every leaf of a pytree (f32 accumulation so bf16
+    params/grads don't overflow the sum of squares)."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
+def _health_stats(params, new_params, grads) -> dict:
+    """Training-health scalars, compiled into the step so they ride the
+    loop's single fused boundary fetch: global grad norm (exploding /
+    vanishing gradients), param norm (weight growth / decay balance), and
+    update ratio ||Δθ||/||θ|| (the effective step size — healthy runs sit
+    around 1e-3; ~1 means the optimizer is overwriting the weights)."""
+    pnorm = _global_norm(params)
+    unorm = _global_norm(jax.tree.map(
+        lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+        new_params, params))
+    return {"health_grad_norm": _global_norm(grads),
+            "health_param_norm": pnorm,
+            "health_update_ratio": unorm / (pnorm + 1e-12)}
+
+
+def _step_body(loss_fn, optim_cfg: OptimConfig,
+               health_metrics: bool = False):
     """``(state, images, labels) -> (new_state, metrics)`` — the shared
     grad/update/metrics math of ``make_train_step`` and
     ``make_train_chunk`` (one source of truth for both).
@@ -265,6 +291,8 @@ def _step_body(loss_fn, optim_cfg: OptimConfig):
             metrics = jax.tree.map(lambda v: v / accum, msum)
         new_params, new_opt = optim_lib.sgd_update(grads, state.opt,
                                                    state.params, optim_cfg)
+        if health_metrics:
+            metrics.update(_health_stats(state.params, new_params, grads))
         if staleness >= 2:
             # The slot just consumed receives the freshly updated params
             # (the worker pushes its apply and re-fetches).
@@ -289,6 +317,7 @@ def make_train_step(
     mesh: Optional[Mesh] = None,
     explicit_collectives: bool = False,
     state_sharding: Optional[TrainState] = None,
+    health_metrics: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array],
               Tuple[TrainState, dict]]:
     """Build the jitted train step:
@@ -315,7 +344,7 @@ def make_train_step(
                 "async_staleness needs the GSPMD (default) step, not "
                 "explicit_collectives")
         return _make_explicit_train_step(model_def, model_cfg, optim_cfg,
-                                         mesh)
+                                         mesh, health_metrics=health_metrics)
 
     if (optim_cfg.async_staleness >= 2 and mesh is not None
             and mesh.shape.get("pipe", 1) > 1):
@@ -332,7 +361,7 @@ def make_train_step(
         _forward_loss(model_def, model_cfg, mesh=mesh,
                       label_smoothing=optim_cfg.label_smoothing),
         mesh, model_cfg, state_sharding)
-    step = _step_body(loss_fn, optim_cfg)
+    step = _step_body(loss_fn, optim_cfg, health_metrics=health_metrics)
 
     if mesh is None:
         return jax.jit(step, donate_argnums=0)
@@ -353,7 +382,8 @@ def make_train_step(
 
 
 def _chunk_body(loss_fn, optim_cfg: OptimConfig,
-                data_cfg: Optional[DataConfig]):
+                data_cfg: Optional[DataConfig],
+                health_metrics: bool = False):
     """``(state, images [K,B,...], labels [K,B]) -> (state, last-step
     metrics)`` — the shared scan-over-K-steps math of ``make_train_chunk``
     and ``make_train_chunk_resident`` (one source of truth).
@@ -365,7 +395,8 @@ def _chunk_body(loss_fn, optim_cfg: OptimConfig,
     data seed so every chunk draws fresh crops/flips, deterministically
     per (seed, step).
     """
-    one_step = _step_body(loss_fn, optim_cfg)
+    one_step = _step_body(loss_fn, optim_cfg,
+                          health_metrics=health_metrics)
     if data_cfg is not None:
         from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
 
@@ -420,6 +451,7 @@ def make_train_chunk(
     mesh: Optional[Mesh] = None,
     state_sharding: Optional[TrainState] = None,
     data_cfg: Optional[DataConfig] = None,
+    health_metrics: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array],
               Tuple[TrainState, dict]]:
     """K training steps per dispatch: ``(state, images [K,B,...], labels
@@ -441,7 +473,7 @@ def make_train_chunk(
             _forward_loss(model_def, model_cfg, mesh=mesh,
                           label_smoothing=optim_cfg.label_smoothing),
             mesh, model_cfg, state_sharding),
-        optim_cfg, data_cfg)
+        optim_cfg, data_cfg, health_metrics=health_metrics)
 
     if mesh is None:
         return jax.jit(chunk, donate_argnums=0)
@@ -468,6 +500,7 @@ def make_train_chunk_resident(
     state_sharding: Optional[TrainState] = None,
     data_cfg: Optional[DataConfig] = None,
     index_stream: Optional[Tuple[int, int, int]] = None,
+    health_metrics: bool = False,
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, dict]]:
     """Chunked training against an HBM-resident dataset:
     ``(state, idx [K, B] int32) -> (new_state, metrics of the LAST step)``.
@@ -510,7 +543,8 @@ def make_train_chunk_resident(
     repl = mesh_lib.replicated(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
 
-    body = _chunk_body(loss, optim_cfg, data_cfg)
+    body = _chunk_body(loss, optim_cfg, data_cfg,
+                       health_metrics=health_metrics)
     gathered_sh = mesh_lib.batch_sharding(mesh, 5, leading_dims=1,
                                           spatial=spatial)
 
@@ -747,7 +781,8 @@ def _eval_data_cfg(data_cfg: DataConfig) -> DataConfig:
     return data_cfg.without_augmentation()
 
 
-def _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh: Mesh):
+def _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh: Mesh,
+                              health_metrics: bool = False):
     """shard_map form: per-device forward/backward on the local batch shard,
     explicit ``lax.psum`` of gradients — the literal translation of
     "workers compute grads, aggregation applies them" minus the
@@ -769,6 +804,12 @@ def _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh: Mesh):
         stats = lax.pmean(stats, "data")
         new_params, new_opt = optim_lib.sgd_update(grads, state.opt,
                                                    state.params, optim_cfg)
+        # Health scalars come AFTER the pmean: the reduced grads/params
+        # are replicated, so the norms match the GSPMD step's and satisfy
+        # the out_specs=P() replication contract.
+        if health_metrics:
+            stats = {**stats, **_health_stats(state.params, new_params,
+                                              grads)}
         if model_def.has_state:
             new_model_state = lax.pmean(new_model_state, "data")
         if "ema_mstate" in state.opt:

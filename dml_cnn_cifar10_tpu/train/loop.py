@@ -33,6 +33,7 @@ from dml_cnn_cifar10_tpu.data import pipeline as pipe
 from dml_cnn_cifar10_tpu.models.registry import get_model
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
+from dml_cnn_cifar10_tpu.utils import telemetry as telemetry_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
 from dml_cnn_cifar10_tpu.utils.preemption import PreemptionGuard
 from dml_cnn_cifar10_tpu.utils.profiling import (DrainMeter, abstractify,
@@ -73,7 +74,8 @@ class Trainer:
         self.train_step = step_lib.make_train_step(
             self.model_def, cfg.model, cfg.optim, self.mesh,
             explicit_collectives=cfg.parallel.explicit_collectives,
-            state_sharding=self.state_sharding)
+            state_sharding=self.state_sharding,
+            health_metrics=cfg.health_metrics)
         self.steps_per_dispatch = max(1, cfg.steps_per_dispatch)
         if self.steps_per_dispatch > 1:
             k = self.steps_per_dispatch
@@ -91,7 +93,8 @@ class Trainer:
                     "step, not explicit_collectives")
             self.train_chunk = step_lib.make_train_chunk(
                 self.model_def, cfg.model, cfg.optim, self.mesh,
-                state_sharding=self.state_sharding, data_cfg=cfg.data)
+                state_sharding=self.state_sharding, data_cfg=cfg.data,
+                health_metrics=cfg.health_metrics)
         self.eval_step = step_lib.make_eval_step(
             self.model_def, cfg.model, self.mesh,
             state_sharding=self.state_sharding)
@@ -296,7 +299,8 @@ class Trainer:
                 ds_images, ds_labels,
                 state_sharding=self.state_sharding, data_cfg=cfg.data,
                 index_stream=((cfg.data.seed, cfg.batch_size, k)
-                              if dev_stream else None))
+                              if dev_stream else None),
+                health_metrics=cfg.health_metrics)
             idx_sh = mesh_lib.batch_sharding(self.mesh, 2, leading_dims=1)
             # Eval also goes resident: boundary train-accuracy is index-fed
             # from the in-HBM train split, test eval is one dispatch over
@@ -369,6 +373,12 @@ class Trainer:
                 train_it, depth=cfg.data.prefetch, place=self._placed)
             step_fn = self.train_step
 
+        # Host-loop telemetry (utils/telemetry.py): span tracing, goodput
+        # accounting, HBM snapshots — all emitted at the existing metrics
+        # boundaries with zero extra device fetches. Disabled spans reduce
+        # to a shared no-op context manager.
+        tracer = telemetry_lib.SpanTracer(enabled=cfg.telemetry)
+        self._tracer = tracer  # exposed for tests/diagnostics
         ckpt_mgr = ckpt_lib.CheckpointManager(
             cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints,
             async_save=cfg.async_checkpoint,
@@ -382,8 +392,13 @@ class Trainer:
             fetched (one round trip, only when a save is actually due)
             and a poisoned state halts instead of overwriting the last
             good checkpoint."""
-            if (cfg.check_numerics and last_metrics is not None
-                    and ckpt_mgr.due(step, force)):
+            if not ckpt_mgr.due(step, force):
+                # Early out BEFORE opening the checkpoint span: due() is
+                # the manager's own save predicate, so a skipped boundary
+                # records no span and the telemetry stream only carries
+                # checkpoints that actually spent wall-clock.
+                return False
+            if cfg.check_numerics and last_metrics is not None:
                 loss = float(jax.device_get(last_metrics["loss"]))
                 if not np.isfinite(loss):
                     _numerics_halt(loss, step)
@@ -397,8 +412,9 @@ class Trainer:
                 "acc": base_counts["acc"] + consumed["acc"],
                 "test": base_counts["test"] + consumed["test"],
             } if exact_ok else None
-            return ckpt_mgr.maybe_save(state, step, force=force,
-                                       data_state=data_state)
+            with tracer.span("checkpoint", cat="checkpoint"):
+                return ckpt_mgr.maybe_save(state, step, force=force,
+                                           data_state=data_state)
 
         def _numerics_halt(loss, step):
             self.logger.log("numerics_halt", step=step)
@@ -437,10 +453,19 @@ class Trainer:
             with PreemptionGuard() as preempt, profile_trace(cfg.profile_dir):
                 while global_step < total_steps and not stop:
                     drained = False
-                    batch = next(prefetch)
+                    first = probe_thread is None
+                    with tracer.span("data_wait", cat="data"):
+                        batch = next(prefetch)
                     if step_abs is None:
                         step_abs = abstractify((state, *batch))
-                    state, metrics = step_fn(state, *batch)
+                    # First call traces + compiles before it enqueues
+                    # (goodput cat "compile"); steady-state dispatches are
+                    # async enqueue — traced but uncategorized, i.e. part
+                    # of the productive-train remainder.
+                    with tracer.span("compile_first_dispatch" if first
+                                     else "dispatch",
+                                     cat="compile" if first else None):
+                        state, metrics = step_fn(state, *batch)
 
                     if probe_thread is None:
                         # First dispatch returned ⇒ trace+compile are done
@@ -540,27 +565,36 @@ class Trainer:
                                 state, *self._placed(next(acc_it)))["accuracy"]
                         consumed["acc"] += 1
                         # Router health for MoE models (ops/moe.py stats
-                        # via parallel/step.py) rides the SAME fused
-                        # fetch as loss/accuracy: everything concatenates
-                        # into one 1-D f32 array -> one device->host
-                        # round trip per boundary (the ~100 ms-RTT
-                        # tunnel makes a second fetch a real cost).
-                        moe_keys = sorted(mk for mk in metrics
-                                          if mk.startswith("moe_"))
+                        # via parallel/step.py) and the optional
+                        # training-health scalars (grad/param norms,
+                        # update ratio — health_metrics=True) ride the
+                        # SAME fused fetch as loss/accuracy: everything
+                        # concatenates into one 1-D f32 array -> one
+                        # device->host round trip per boundary (the
+                        # ~100 ms-RTT tunnel makes a second fetch a real
+                        # cost).
+                        fused_keys = sorted(
+                            mk for mk in metrics
+                            if mk.startswith(("moe_", "health_")))
                         parts = [jnp.reshape(metrics["loss"], (1,)),
                                  jnp.reshape(
                                      jnp.asarray(acc_arr, jnp.float32),
                                      (1,))]
                         parts += [jnp.reshape(metrics[mk], (-1,)).astype(
-                                      jnp.float32) for mk in moe_keys]
-                        fused = jax.device_get(jnp.concatenate(parts))
+                                      jnp.float32) for mk in fused_keys]
+                        # The fused fetch is a true drain: the host blocks
+                        # on device compute, so the span is device-busy
+                        # time — traced, but counted as productive.
+                        with tracer.span("boundary_drain"):
+                            fused = jax.device_get(
+                                jnp.concatenate(parts))
                         rate = meter.rate(global_step)
                         drained = True
                         loss, acc = float(fused[0]), float(fused[1])
                         train_loss.append(loss)
                         perf = {}
                         off = 2
-                        for mk in moe_keys:
+                        for mk in fused_keys:
                             nleaf = int(np.prod(metrics[mk].shape)) \
                                 if metrics[mk].shape else 1
                             mv = fused[off:off + nleaf]
@@ -590,11 +624,12 @@ class Trainer:
                             if cfg.peak_tflops:
                                 perf["mfu"] = round(
                                     tf / cfg.peak_tflops, 4)
-                            if "assume" in flops_cell:
-                                # Logged once: which scan-accounting case
-                                # the cross-check found on this backend.
-                                perf["flops_scan"] = flops_cell.pop(
-                                    "assume")
+                        if "assume" in flops_cell:
+                            # Logged once, OUTSIDE the rate guard (like
+                            # flops_stack below): a 0-rate boundary must
+                            # defer the TFLOP/s figure, not silently
+                            # swallow the scan-accounting label.
+                            perf["flops_scan"] = flops_cell.pop("assume")
                         if "stack" in flops_cell:
                             # Logged once, OUTSIDE the flops>0 guard: the
                             # layer-stack accounting case
@@ -609,13 +644,16 @@ class Trainer:
                                         images_per_sec=rate,
                                         lr=_current_lr(cfg, global_step),
                                         **perf)
+                        telemetry_lib.flush_boundary(tracer, self.logger,
+                                                     global_step)
                         if cfg.check_numerics and not np.isfinite(loss):
                             # Loss is a replicated metric, so every
                             # process raises on the same boundary — no
                             # peer hangs.
                             _numerics_halt(loss, global_step)
                     if (i + k) % cfg.eval_every == 0:
-                        ta = self.evaluate(state, test_it)
+                        with tracer.span("eval", cat="eval"):
+                            ta = self.evaluate(state, test_it)
                         if not cfg.eval_full_test_set:
                             # Full sweeps are sequential slices (no
                             # stream draws); single-batch eval consumes
@@ -649,9 +687,10 @@ class Trainer:
                         # One DCN allgather carries both flags: no process may
                         # leave the loop OR enter the collective checkpoint
                         # fetch alone.
-                        flags = multihost_utils.process_allgather(
-                            np.asarray([preempt.requested,
-                                        ckpt_mgr.time_due()]))
+                        with tracer.span("preempt_allgather", cat="sync"):
+                            flags = multihost_utils.process_allgather(
+                                np.asarray([preempt.requested,
+                                            ckpt_mgr.time_due()]))
                         stop = bool(np.asarray(flags)[..., 0].any())
                         if bool(np.asarray(flags)[..., 1].any()):
                             if guarded_save(state, global_step, force=True):
@@ -687,6 +726,12 @@ class Trainer:
                                     signum=preempt.signum)
                 self.logger.log("done", step=global_step,
                                 images_per_sec=avg_rate)
+                # Run-end telemetry: the spans finished since the last
+                # boundary (final eval/checkpoint included) plus the
+                # cumulative goodput breakdown, marked final so
+                # tools/telemetry_report.py can anchor on it.
+                telemetry_lib.flush_boundary(tracer, self.logger,
+                                             global_step, final=True)
         finally:
             # Crash paths clean up too: the async checkpoint writer must
             # drain (surfacing any background write error alongside the
@@ -696,6 +741,14 @@ class Trainer:
             # matter.
             ckpt_mgr.close()
             prefetch.close()
+            # The Chrome trace exports from the finally block so a
+            # crashed/preempted run still leaves its host-loop timeline —
+            # exactly the runs worth opening in Perfetto.
+            if tracer.enabled and cfg.trace_events_path:
+                path = cfg.trace_events_path
+                if self.task_index:
+                    path += f".task{self.task_index}"
+                tracer.export_chrome_trace(path, pid=self.task_index)
             self.logger.flush()
         # Release the fit-scoped resident closures — their partials pin
         # the train/test splits in HBM.

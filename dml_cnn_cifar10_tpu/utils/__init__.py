@@ -1,4 +1,5 @@
-"""Cross-cutting utilities: structured logging, profiling, timing."""
+"""Cross-cutting utilities: structured logging, profiling, telemetry."""
 
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger  # noqa: F401
-from dml_cnn_cifar10_tpu.utils.profiling import StepTimer, profile_trace  # noqa: F401
+from dml_cnn_cifar10_tpu.utils.profiling import DrainMeter, profile_trace  # noqa: F401
+from dml_cnn_cifar10_tpu.utils.telemetry import SpanTracer, hbm_stats  # noqa: F401

@@ -54,7 +54,10 @@ class MetricsLogger:
         if self._tb is not None and "step" in fields:
             step = fields["step"]
             for k, v in fields.items():
+                # bool is an int subclass: without the exclusion, flag
+                # fields (e.g. hbm available) land as 0/1 scalar charts.
                 if k != "step" and isinstance(v, (int, float)) \
+                        and not isinstance(v, bool) \
                         and _finite(v) is not None:
                     self._tb.add_scalar(f"{kind}/{k}", v, step)
 

@@ -1,8 +1,13 @@
-"""Profiling hooks: step timing + XLA trace capture.
+"""Profiling hooks: throughput metering, FLOPs probes, XLA trace capture.
 
-The reference has no profiling at all (SURVEY §5). Here: a cheap steady-state
-step timer (excludes compile) feeding images/sec into the metrics stream, and
-an optional ``jax.profiler`` trace for TensorBoard/Perfetto.
+The reference has no profiling at all (SURVEY §5). Here: the drain-anchored
+throughput meter feeding images/sec into the metrics stream, the XLA
+cost-analysis FLOPs probes behind the TFLOP/s / MFU metrics, and an optional
+``jax.profiler`` trace for TensorBoard/Perfetto. Host-loop phase timing
+lives in ``utils/telemetry.py`` (``SpanTracer``), which subsumed the old
+``StepTimer`` (a rolling host-interval step timer the trainer never used —
+host intervals measure enqueue rate, not execution, exactly the hazard
+``DrainMeter`` exists to avoid).
 """
 
 from __future__ import annotations
@@ -10,35 +15,6 @@ from __future__ import annotations
 import contextlib
 import time
 from typing import Optional
-
-
-class StepTimer:
-    """Rolling step-time/throughput meter. ``skip`` initial steps are
-    excluded so the first-compile stall doesn't pollute the numbers."""
-
-    def __init__(self, batch_size: int, skip: int = 2):
-        self.batch_size = batch_size
-        self.skip = skip
-        self._count = 0
-        self._elapsed = 0.0
-        self._last: Optional[float] = None
-
-    def tick(self) -> None:
-        now = time.perf_counter()
-        if self._last is not None:
-            self.skip -= 1
-            if self.skip < 0:
-                self._elapsed += now - self._last
-                self._count += 1
-        self._last = now
-
-    @property
-    def steps_per_sec(self) -> float:
-        return self._count / self._elapsed if self._elapsed else 0.0
-
-    @property
-    def images_per_sec(self) -> float:
-        return self.steps_per_sec * self.batch_size
 
 
 class DrainMeter:

@@ -1,0 +1,224 @@
+"""Run-health telemetry: host-loop span tracing, goodput accounting, and
+device/HBM health snapshots.
+
+The metrics stream (``utils/logging.py``) records WHAT happened at each
+boundary; this layer records WHERE THE WALL-CLOCK WENT and WHETHER THE RUN
+IS HEALTHY — the two questions a long multi-host job must answer without a
+profiler attached. Three coordinated pieces:
+
+- :class:`SpanTracer`: a ring-buffered context-manager tracer the driver
+  wraps around its host-loop phases (compile/first-dispatch, data wait,
+  dispatch enqueue, boundary drain, eval, checkpoint, preemption
+  allgather). Near-zero overhead when disabled — ``span()`` returns a
+  shared no-op context manager, no allocation, no clock read. Finished
+  spans export two ways: JSONL ``span`` records through the existing
+  ``MetricsLogger`` (:func:`flush_boundary`) and a Chrome trace-event file
+  (:meth:`SpanTracer.export_chrome_trace`) loadable in Perfetto alongside
+  the XLA trace from ``--profile_dir``.
+- Goodput accounting: top-level spans carry a category
+  (``compile`` / ``data`` / ``eval`` / ``checkpoint`` / ``sync``);
+  :meth:`SpanTracer.goodput` reports the fraction of wall-clock since the
+  tracer epoch spent in each, with productive training as the remainder —
+  so the categories sum to 1.0 by construction. Host-loop caveat: on the
+  async-dispatch paths a host-side data wait can overlap device compute,
+  so ``data_frac`` is an upper bound on true device starvation.
+- :func:`hbm_stats`: per-process device-memory snapshot via
+  ``device.memory_stats()`` (sum of bytes in use / peak / limit over local
+  devices) — a host-side runtime call, NOT a device fetch, so logging it
+  at boundaries adds no round trip. Backends without memory stats (CPU)
+  report ``available=False`` rather than omitting the record.
+
+Training-health scalars (grad norm, param norm, update ratio) are NOT
+computed here — they are compiled into the step (``parallel/step.py``,
+``health_metrics=True``) and ride the loop's single fused boundary fetch,
+honoring the ~100 ms-RTT tunnel constraint documented in ``train/loop.py``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+from typing import Optional
+
+# Category order pins the goodput report layout (train first, then the
+# overheads in rough size order for a typical run).
+GOODPUT_CATEGORIES = ("compile", "data", "eval", "checkpoint", "sync")
+
+
+class _NullSpan:
+    """Shared no-op context manager — the disabled-tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "cat", "t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, cat: Optional[str]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        self._tracer._depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        tr = self._tracer
+        tr._depth -= 1
+        tr._record(self.name, self.cat, self.t0, t1 - self.t0, tr._depth)
+        return False
+
+
+class SpanTracer:
+    """Ring-buffered host-loop span tracer + goodput aggregator.
+
+    ``with tracer.span("eval", cat="eval"): ...`` records one finished
+    span. Only DEPTH-0 spans with a category count toward goodput —
+    nested sub-spans are trace detail, not wall-clock attribution (a
+    category on a nested span would double-count its parent's time).
+    The ring keeps the most recent ``max_spans`` finished spans for the
+    Chrome export; ``drain()`` hands out (and forgets) the spans finished
+    since the last drain so boundary flushes are incremental. Overflow is
+    counted (``dropped``), never silent.
+    """
+
+    def __init__(self, enabled: bool = True, max_spans: int = 65536):
+        self.enabled = enabled
+        self.max_spans = max_spans
+        self.dropped = 0
+        self._depth = 0
+        # (name, cat, start_s, dur_s, depth) tuples; _ring feeds the
+        # Chrome export, _pending feeds the incremental JSONL flush.
+        self._ring = collections.deque(maxlen=max_spans)
+        self._pending = collections.deque(maxlen=max_spans)
+        self._cat_secs = dict.fromkeys(GOODPUT_CATEGORIES, 0.0)
+        self._epoch = time.perf_counter()
+        self._wall_epoch = time.time()
+
+    def start(self) -> None:
+        """Reset the goodput epoch (call at loop entry, pre-compile)."""
+        self._epoch = time.perf_counter()
+        self._wall_epoch = time.time()
+
+    def span(self, name: str, cat: Optional[str] = None):
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat)
+
+    def _record(self, name, cat, t0, dur, depth) -> None:
+        if len(self._ring) == self.max_spans \
+                or len(self._pending) == self.max_spans:
+            self.dropped += 1
+        rec = (name, cat, t0 - self._epoch, dur, depth)
+        self._ring.append(rec)
+        self._pending.append(rec)
+        if depth == 0 and cat is not None:
+            self._cat_secs[cat] = self._cat_secs.get(cat, 0.0) + dur
+
+    def drain(self) -> list:
+        """Spans finished since the last drain (and forget them)."""
+        out = list(self._pending)
+        self._pending.clear()
+        return out
+
+    def goodput(self, now: Optional[float] = None) -> dict:
+        """Cumulative goodput breakdown since the epoch.
+
+        ``{total_s, train_frac, <cat>_frac...}`` — ``train_frac`` is the
+        unattributed remainder (dispatch enqueue, boundary drain, host
+        logging all count as productive: during them the device is
+        executing training steps), so the fractions sum to 1.0 exactly.
+        """
+        total = max((now if now is not None else time.perf_counter())
+                    - self._epoch, 1e-9)
+        out = {"total_s": round(total, 4)}
+        attributed = 0.0
+        for cat in sorted(self._cat_secs):
+            secs = min(self._cat_secs[cat], total - attributed)
+            attributed += secs
+            out[f"{cat}_frac"] = round(secs / total, 6)
+        out["train_frac"] = round((total - attributed) / total, 6)
+        return out
+
+    def export_chrome_trace(self, path: str, pid: int = 0) -> None:
+        """Write the retained spans as a Chrome trace-event JSON file.
+
+        Load in Perfetto (ui.perfetto.dev) or chrome://tracing — ``ts``
+        is microseconds since the tracer epoch, so the host-loop lane
+        lines up with an XLA trace captured over the same run.
+        """
+        events = [{"name": name, "ph": "X",
+                   "ts": round(start * 1e6, 1),
+                   "dur": round(dur * 1e6, 1),
+                   "pid": pid, "tid": depth,
+                   **({"cat": cat} if cat else {})}
+                  for name, cat, start, dur, depth in self._ring]
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"epoch_unix_s": round(self._wall_epoch, 3),
+                             "dropped_spans": self.dropped}}
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+
+
+def hbm_stats() -> dict:
+    """Per-process device-memory snapshot, summed over local devices.
+
+    A host-side runtime query (no device round trip). Fields are 0 with
+    ``available=False`` on backends whose ``memory_stats()`` is missing
+    or empty (CPU), so the ``hbm`` record is emitted unconditionally and
+    downstream tooling need not special-case the backend.
+    """
+    import jax
+
+    in_use = peak = limit = 0
+    ndev = 0
+    for d in jax.local_devices():
+        try:
+            s = d.memory_stats()
+        except Exception:
+            s = None
+        if not s:
+            continue
+        ndev += 1
+        in_use += int(s.get("bytes_in_use", 0))
+        peak += int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+        limit += int(s.get("bytes_limit", 0))
+    return {"available": ndev > 0, "devices": ndev,
+            "bytes_in_use": in_use, "peak_bytes": peak,
+            "bytes_limit": limit}
+
+
+def flush_boundary(tracer: SpanTracer, logger, step: int,
+                   final: bool = False) -> None:
+    """Emit the boundary telemetry records through ``MetricsLogger``:
+    every span finished since the last flush, the cumulative goodput
+    breakdown, and an HBM snapshot. Pure host work — zero device fetches
+    (the ~100 ms-RTT tunnel rule)."""
+    if not tracer.enabled:
+        return
+    for name, cat, start, dur, depth in tracer.drain():
+        logger.log("span", step=step, name=name,
+                   start_s=round(start, 4), dur_s=round(dur, 4),
+                   depth=depth, **({"cat": cat} if cat else {}))
+    gp = tracer.goodput()
+    if tracer.dropped:
+        gp["dropped_spans"] = tracer.dropped
+    if final:
+        gp["final"] = 1
+    logger.log("goodput", step=step, **gp)
+    logger.log("hbm", step=step, **hbm_stats())

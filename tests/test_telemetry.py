@@ -1,0 +1,289 @@
+"""Run-health telemetry layer (utils/telemetry.py + parallel/step.py
+health metrics + the JSONL schema lint): span nesting/export, goodput
+accounting, health-metric fusion into the fused boundary fetch, and the
+zero-extra-device-fetches contract when telemetry is off."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dml_cnn_cifar10_tpu.utils.telemetry import (GOODPUT_CATEGORIES,
+                                                 SpanTracer,
+                                                 flush_boundary, hbm_stats)
+from tests.conftest import tiny_train_cfg
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_span_nesting_and_drain(monkeypatch):
+    clock = _FakeClock()
+    monkeypatch.setattr(time, "perf_counter", clock)
+    tr = SpanTracer(enabled=True)
+    tr.start()
+    with tr.span("outer", cat="eval"):
+        clock.t += 1.0
+        with tr.span("inner"):
+            clock.t += 0.5
+        clock.t += 0.5
+    spans = tr.drain()
+    # Inner finishes first; depth recorded at entry.
+    assert [(s[0], s[4]) for s in spans] == [("inner", 1), ("outer", 0)]
+    name, cat, start, dur, depth = spans[1]
+    assert cat == "eval" and start == 0.0 and dur == pytest.approx(2.0)
+    # drain() forgets — a second drain is empty; the ring retains.
+    assert tr.drain() == []
+    assert len(tr._ring) == 2
+
+
+def test_disabled_tracer_is_noop():
+    tr = SpanTracer(enabled=False)
+    # The fast path returns one shared no-op context manager: no
+    # allocation, no clock read, nothing recorded.
+    cm = tr.span("anything", cat="eval")
+    assert tr.span("other") is cm
+    with cm:
+        pass
+    assert tr.drain() == []
+
+
+def test_goodput_fractions_sum_to_one(monkeypatch):
+    """Synthetic timeline: categorized spans attribute their seconds,
+    productive training is the remainder, fractions sum to 1.0."""
+    clock = _FakeClock()
+    monkeypatch.setattr(time, "perf_counter", clock)
+    tr = SpanTracer(enabled=True)
+    tr.start()
+    for cat, dur in (("compile", 2.0), ("data", 1.0), ("eval", 0.5),
+                     ("checkpoint", 0.4), ("sync", 0.1)):
+        with tr.span(cat, cat=cat):
+            clock.t += dur
+    clock.t = 10.0
+    gp = tr.goodput()
+    assert gp["total_s"] == pytest.approx(10.0)
+    assert gp["compile_frac"] == pytest.approx(0.2)
+    assert gp["data_frac"] == pytest.approx(0.1)
+    assert gp["eval_frac"] == pytest.approx(0.05)
+    assert gp["checkpoint_frac"] == pytest.approx(0.04)
+    assert gp["sync_frac"] == pytest.approx(0.01)
+    assert gp["train_frac"] == pytest.approx(0.6)
+    total_frac = gp["train_frac"] + sum(
+        gp[f"{c}_frac"] for c in GOODPUT_CATEGORIES)
+    assert total_frac == pytest.approx(1.0, abs=1e-5)
+    # Nested spans with a category must NOT double-count their parent.
+    with tr.span("eval", cat="eval"):
+        with tr.span("inner", cat="eval"):
+            clock.t += 1.0
+    assert tr._cat_secs["eval"] == pytest.approx(0.5 + 1.0)
+
+
+def test_chrome_trace_export_and_ring_overflow(tmp_path):
+    tr = SpanTracer(enabled=True, max_spans=4)
+    for i in range(6):
+        with tr.span(f"s{i}", cat="data"):
+            pass
+    assert tr.dropped == 2 and len(tr._ring) == 4
+    path = str(tmp_path / "trace.json")
+    tr.export_chrome_trace(path, pid=3)
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert [e["name"] for e in events] == ["s2", "s3", "s4", "s5"]
+    for e in events:
+        assert e["ph"] == "X" and e["pid"] == 3
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+    assert doc["otherData"]["dropped_spans"] == 2
+
+
+def test_hbm_stats_shape():
+    """Emitted unconditionally: on backends without memory stats (CPU)
+    the record still carries the full schema with available=False."""
+    s = hbm_stats()
+    assert set(s) == {"available", "devices", "bytes_in_use",
+                      "peak_bytes", "bytes_limit"}
+    assert isinstance(s["available"], bool)
+
+
+def test_flush_boundary_logs_span_goodput_hbm(tmp_path):
+    from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+
+    path = str(tmp_path / "m.jsonl")
+    logger = MetricsLogger(path)
+    tr = SpanTracer(enabled=True)
+    with tr.span("eval", cat="eval"):
+        pass
+    flush_boundary(tr, logger, step=7, final=True)
+    logger.close()
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    kinds = [r["kind"] for r in recs]
+    assert kinds == ["span", "goodput", "hbm"]
+    assert recs[0]["name"] == "eval" and recs[0]["step"] == 7
+    assert recs[1]["final"] == 1
+    # Disabled tracer: flush is a no-op (no records, no fetches).
+    flush_boundary(SpanTracer(enabled=False), logger, step=8)
+
+
+def test_health_stats_in_step_metrics(data_cfg):
+    """health_metrics=True compiles the scalars into the step's metrics
+    dict (the fused-fetch payload); off means the keys don't exist."""
+    import jax
+
+    from dml_cnn_cifar10_tpu.config import ModelConfig, OptimConfig
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+    model_cfg = ModelConfig(logit_relu=False)
+    optim_cfg = OptimConfig(learning_rate=0.05)
+    model_def = get_model("cnn")
+    state = step_lib.init_train_state(
+        jax.random.key(0), model_def, model_cfg, data_cfg, optim_cfg)
+    images = np.random.default_rng(0).normal(
+        size=(8, data_cfg.crop_height, data_cfg.crop_width, 3)
+    ).astype(np.float32)
+    labels = np.arange(8, dtype=np.int32) % 10
+
+    plain = step_lib.make_train_step(model_def, model_cfg, optim_cfg)
+    _, metrics = plain(state, images, labels)
+    assert not any(k.startswith("health_") for k in metrics)
+
+    state2 = step_lib.init_train_state(
+        jax.random.key(0), model_def, model_cfg, data_cfg, optim_cfg)
+    healthy = step_lib.make_train_step(model_def, model_cfg, optim_cfg,
+                                       health_metrics=True)
+    _, metrics = healthy(state2, images, labels)
+    gn = float(metrics["health_grad_norm"])
+    pn = float(metrics["health_param_norm"])
+    ur = float(metrics["health_update_ratio"])
+    assert gn > 0 and pn > 0 and 0 < ur < 1
+    # SGD: ||Δθ|| = lr·||g|| exactly, so the ratio is checkable.
+    assert ur == pytest.approx(optim_cfg.learning_rate * gn / pn,
+                               rel=1e-4)
+
+
+def test_telemetry_run_and_fetch_parity(data_cfg, tmp_path, monkeypatch):
+    """One telemetry-off and one telemetry+health-on run of the real
+    Trainer: (a) telemetry must add ZERO jax.device_get calls (spans,
+    goodput, and hbm are host-side; health rides the fused fetch);
+    (b) the on-run emits span/goodput/hbm records whose goodput
+    categories sum to within 2% of the recorded wall-clock, a valid
+    Chrome trace, health keys in the train records, a schema-clean JSONL
+    stream, and a telemetry_report summary."""
+    import jax
+
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+
+    counts = {"n": 0}
+    real_get = jax.device_get
+
+    def counting_get(x):
+        counts["n"] += 1
+        return real_get(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+
+    def run(sub, telemetry, health, trace=None):
+        cfg = tiny_train_cfg(data_cfg, str(tmp_path / sub), total_steps=20,
+                             output_every=5, eval_every=10,
+                             checkpoint_every=10)
+        cfg.telemetry = telemetry
+        cfg.health_metrics = health
+        cfg.metrics_jsonl = os.path.join(str(tmp_path / sub), "m.jsonl")
+        cfg.trace_events_path = trace
+        counts["n"] = 0
+        t0 = time.perf_counter()
+        result = Trainer(cfg).fit()
+        wall = time.perf_counter() - t0
+        assert result.final_step == 20
+        return counts["n"], cfg, wall
+
+    fetches_off, _, _ = run("off", telemetry=False, health=False)
+    trace_path = str(tmp_path / "on" / "host_trace.json")
+    fetches_on, cfg, wall = run("on", telemetry=True, health=True,
+                                trace=trace_path)
+    assert fetches_on == fetches_off, \
+        "telemetry/health must not add device fetches"
+
+    with open(cfg.metrics_jsonl) as f:
+        recs = [json.loads(line) for line in f]
+    by_kind = {}
+    for r in recs:
+        by_kind.setdefault(r["kind"], []).append(r)
+    assert {"train", "eval", "span", "goodput", "hbm"} <= set(by_kind)
+
+    # Health scalars fused into every train record.
+    for r in by_kind["train"]:
+        assert {"health_grad_norm", "health_param_norm",
+                "health_update_ratio"} <= set(r)
+        assert np.isfinite(r["health_grad_norm"])
+
+    # Span phases cover the loop; depth-0 categories feed goodput.
+    names = {r["name"] for r in by_kind["span"]}
+    assert {"data_wait", "compile_first_dispatch", "dispatch",
+            "boundary_drain", "eval", "checkpoint"} <= names
+
+    # Final goodput record: categories + train remainder sum to the
+    # wall-clock total (within 2%), and the tracer's total is within
+    # the fit() call's measured wall time.
+    final = [r for r in by_kind["goodput"] if r.get("final")]
+    assert final, "run end must flush a final goodput record"
+    gp = final[-1]
+    cat_s = sum(gp[f"{c}_frac"] for c in GOODPUT_CATEGORIES) \
+        + gp["train_frac"]
+    assert cat_s == pytest.approx(1.0, abs=0.02)
+    assert 0 < gp["total_s"] <= wall * 1.02
+    assert gp["compile_frac"] > 0      # first dispatch compiled
+
+    # hbm records carry the full schema even on CPU.
+    assert by_kind["hbm"][-1]["available"] in (True, False)
+
+    # Chrome trace-event file: valid JSON, Perfetto-loadable shape.
+    with open(trace_path) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"] and all(e["ph"] == "X"
+                                      for e in doc["traceEvents"])
+
+    # The stream passes the documented-schema lint (wired into tier 1).
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []
+
+    # And the report CLI summarizes it.
+    from tools import telemetry_report
+    out = telemetry_report.summarize(cfg.metrics_jsonl)
+    assert "goodput over" in out and "train" in out
+    assert "grad norm" in out
+    assert telemetry_report.main([cfg.metrics_jsonl]) == 0
+
+
+def test_check_jsonl_schema_catches_violations(tmp_path):
+    from tools import check_jsonl_schema as lint
+
+    good = {"kind": "eval", "t": 1.0, "task": 0, "step": 10,
+            "test_accuracy": 0.5}
+    assert lint.check_lines([json.dumps(good)]) == []
+    # NaN token → non-strict JSON.
+    errs = lint.check_lines(['{"kind": "eval", "t": NaN, "task": 0, '
+                             '"step": 1, "test_accuracy": 0.1}'])
+    assert errs and "strict JSON" in errs[0]
+    # Missing required key for the kind.
+    errs = lint.check_lines(['{"kind": "eval", "t": 1.0, "task": 0, '
+                             '"step": 1}'])
+    assert errs and "test_accuracy" in errs[0]
+    # Unknown kind must be registered (schema drift guard).
+    errs = lint.check_lines(['{"kind": "mystery", "t": 1.0, "task": 0}'])
+    assert errs and "unknown kind" in errs[0]
+    # Garbage line.
+    assert lint.check_lines(["not json"])
+    # File-level entry point.
+    p = tmp_path / "m.jsonl"
+    p.write_text(json.dumps(good) + "\n")
+    assert lint.check_file(str(p)) == []
+    assert lint.main([str(p)]) == 0

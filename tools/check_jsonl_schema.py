@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Lint a metrics JSONL stream against the documented schema.
+
+The JSONL stream (``--metrics_jsonl``) is the contract every downstream
+consumer — ``tools/telemetry_report.py``, ``tools/convergence_report.py``,
+ad-hoc pandas — parses. This lint enforces the contract documented in
+``docs/OBSERVABILITY.md``: every line is strict JSON (no NaN/Infinity
+tokens — the writer maps non-finite floats to null), every record carries
+the base keys, and each known ``kind`` carries its required keys. Unknown
+kinds are errors: a new record kind must be added to ``KIND_KEYS`` here
+AND to the schema table in the doc, which is exactly the drift this lint
+exists to catch.
+
+Usage: ``python tools/check_jsonl_schema.py run.jsonl [more.jsonl ...]``
+(exit 1 on any violation). ``tests/test_telemetry.py`` runs it over a
+real training run's stream as part of the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Iterable, List
+
+# Keys every record must carry (utils/logging.py writes them).
+BASE_KEYS = ("kind", "t", "task")
+
+# Required keys per record kind. Values may be null (the writer maps
+# NaN/Inf to null) but the KEY must be present.
+KIND_KEYS = {
+    "train": ("step", "loss", "train_accuracy", "images_per_sec", "lr"),
+    "eval": ("step", "test_accuracy"),
+    "span": ("step", "name", "start_s", "dur_s", "depth"),
+    "goodput": ("step", "total_s", "train_frac", "compile_frac",
+                "data_frac", "eval_frac", "checkpoint_frac", "sync_frac"),
+    "hbm": ("step", "available", "devices", "bytes_in_use", "peak_bytes",
+            "bytes_limit"),
+    "done": ("step", "images_per_sec"),
+    "preempt": ("step", "signum"),
+    "numerics_halt": ("step",),
+}
+
+
+def _reject_constant(name: str):
+    raise ValueError(f"non-strict JSON constant {name}")
+
+
+def check_lines(lines: Iterable[str], source: str = "<stream>"
+                ) -> List[str]:
+    """Validate JSONL lines; returns a list of human-readable errors."""
+    errors = []
+    for ln, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        where = f"{source}:{ln}"
+        try:
+            rec = json.loads(line, parse_constant=_reject_constant)
+        except ValueError as e:
+            errors.append(f"{where}: invalid strict JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"{where}: record is not a JSON object")
+            continue
+        missing = [k for k in BASE_KEYS if k not in rec]
+        if missing:
+            errors.append(f"{where}: missing base keys {missing}")
+        kind = rec.get("kind")
+        if kind not in KIND_KEYS:
+            errors.append(
+                f"{where}: unknown kind {kind!r} (add it to "
+                f"tools/check_jsonl_schema.py and docs/OBSERVABILITY.md)")
+            continue
+        missing = [k for k in KIND_KEYS[kind] if k not in rec]
+        if missing:
+            errors.append(f"{where}: kind {kind!r} missing keys {missing}")
+        for k, v in rec.items():
+            # json.loads only yields inf/nan via the constants rejected
+            # above, but a float check keeps the rule explicit.
+            if isinstance(v, float) and v != v:
+                errors.append(f"{where}: key {k!r} is NaN")
+    return errors
+
+
+def check_file(path: str) -> List[str]:
+    with open(path) as f:
+        return check_lines(f, source=path)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__.strip().splitlines()[0])
+        print("usage: check_jsonl_schema.py FILE.jsonl [...]")
+        return 2
+    failed = False
+    for path in argv:
+        errs = check_file(path)
+        for e in errs:
+            print(e)
+        if errs:
+            failed = True
+        else:
+            print(f"{path}: OK")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,12 @@ def render() -> str:
         "edit by hand (`python tools/gen_cli_doc.py` regenerates;",
         "`tests/test_cli_doc.py` enforces freshness).",
         "",
+        "The observability flags (`--metrics_jsonl`, `--telemetry`,",
+        "`--trace_events_path`, `--health_metrics`, `--tensorboard_dir`,",
+        "`--profile_dir`) are documented in depth in",
+        "[OBSERVABILITY.md](OBSERVABILITY.md) (JSONL schema, goodput",
+        "accounting, Perfetto workflow).",
+        "",
         "| Flag | Default | Description |",
         "|---|---|---|",
     ]

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Summarize a run's metrics JSONL into a goodput / run-health table.
+
+Reads the stream written by ``--metrics_jsonl`` (schema:
+``docs/OBSERVABILITY.md``) and answers "where did the wall-clock go?" and
+"was this run healthy?" without loading a trace UI:
+
+- goodput breakdown from the final ``goodput`` record (falling back to
+  re-aggregating ``span`` records when a run died before the final
+  flush),
+- throughput from the ``train`` / ``done`` records (the drain-anchored
+  figures BENCH_*.json quotes — see docs/OBSERVABILITY.md for how the
+  two relate),
+- training health (grad/param norm, update ratio) when the run compiled
+  them in (``--health_metrics``),
+- HBM peak from the ``hbm`` snapshots.
+
+Usage: ``python tools/telemetry_report.py run.jsonl [more.jsonl ...]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dml_cnn_cifar10_tpu.utils.telemetry import GOODPUT_CATEGORIES  # noqa: E402
+
+
+def load_records(path: str) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _last(records: List[dict], kind: str) -> Optional[dict]:
+    for rec in reversed(records):
+        if rec.get("kind") == kind:
+            return rec
+    return None
+
+
+def _goodput_from_spans(records: List[dict]) -> Optional[dict]:
+    """Rebuild the cumulative breakdown from raw span records — the
+    fallback when a run died before its final goodput flush. Wall-clock
+    total comes from the last record's ``t`` offset."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    if not spans:
+        return None
+    total = max((r.get("t") or 0.0) for r in records)
+    if total <= 0:
+        return None
+    secs = dict.fromkeys(GOODPUT_CATEGORIES, 0.0)
+    for s in spans:
+        cat = s.get("cat")
+        if cat in secs and s.get("depth") == 0:
+            secs[cat] += s.get("dur_s") or 0.0
+    out = {"total_s": total}
+    for cat, v in secs.items():
+        out[f"{cat}_frac"] = v / total
+    out["train_frac"] = max(0.0, 1.0 - sum(secs.values()) / total)
+    return out
+
+
+def _fmt_bytes(n: Optional[int]) -> str:
+    if not n:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024:
+            return f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} PiB"
+
+
+def summarize(path: str) -> str:
+    records = load_records(path)
+    lines = [f"== {path} =="]
+    if not records:
+        return "\n".join(lines + ["  (no records)"])
+
+    done = _last(records, "done")
+    trains = [r for r in records if r.get("kind") == "train"]
+    if done or trains:
+        step = (done or trains[-1]).get("step")
+        lines.append(f"  steps: {step}")
+    if done and done.get("images_per_sec"):
+        lines.append(
+            f"  run-average throughput: {done['images_per_sec']:.1f} "
+            f"images/sec (drain-anchored, post-compile)")
+
+    gp = _last(records, "goodput") or _goodput_from_spans(records)
+    if gp:
+        total = gp.get("total_s") or 0.0
+        lines.append(f"  goodput over {total:.2f} s wall-clock:")
+        cats = ["train"] + list(GOODPUT_CATEGORIES)
+        for cat in cats:
+            frac = gp.get(f"{cat}_frac")
+            if frac is None:
+                continue
+            lines.append(f"    {cat:<11} {100 * frac:6.2f} %"
+                         f"  {frac * total:8.2f} s")
+        covered = sum(gp.get(f"{c}_frac") or 0.0 for c in cats)
+        lines.append(f"    {'(sum)':<11} {100 * covered:6.2f} %")
+        if gp.get("dropped_spans"):
+            lines.append(f"    [{gp['dropped_spans']} spans dropped by "
+                         f"the ring buffer]")
+    else:
+        lines.append("  no goodput/span records (run without --telemetry)")
+
+    health = [r for r in trains if "health_grad_norm" in r]
+    if health:
+        first, last = health[0], health[-1]
+        gmax = max((r.get("health_grad_norm") or 0.0) for r in health)
+        lines.append("  training health (first -> last boundary):")
+        for key, label in (("health_grad_norm", "grad norm"),
+                           ("health_param_norm", "param norm"),
+                           ("health_update_ratio", "update ratio")):
+            lines.append(f"    {label:<13} {first.get(key)} -> "
+                         f"{last.get(key)}")
+        lines.append(f"    max grad norm {gmax}")
+    hbm = _last(records, "hbm")
+    if hbm:
+        if hbm.get("available"):
+            lines.append(
+                f"  HBM ({hbm.get('devices')} local devices): "
+                f"{_fmt_bytes(hbm.get('bytes_in_use'))} in use, "
+                f"peak {_fmt_bytes(hbm.get('peak_bytes'))}, "
+                f"limit {_fmt_bytes(hbm.get('bytes_limit'))}")
+        else:
+            lines.append("  HBM: backend reports no memory stats")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("usage: telemetry_report.py run.jsonl [more.jsonl ...]")
+        return 2
+    for path in argv:
+        print(summarize(path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
