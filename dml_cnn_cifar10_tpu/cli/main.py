@@ -83,13 +83,39 @@ def build_parser() -> argparse.ArgumentParser:
                         "step cadence (the reference's MTS saved every "
                         "600 s by default)")
     p.add_argument("--mode", type=str, default="train",
-                   choices=["train", "eval", "export"],
+                   choices=["train", "eval", "export", "serve"],
                    help="train; eval = restore latest checkpoint and sweep "
                         "the full test split; export = restore and write a "
-                        "self-contained jax.export serving artifact")
+                        "self-contained jax.export serving artifact; serve "
+                        "= run the micro-batching inference engine over "
+                        "the artifact (or latest checkpoint) behind an "
+                        "HTTP endpoint (docs/SERVING.md)")
     p.add_argument("--export_path", type=str, default=None,
                    help="output file for --mode export "
                         "(default <log_dir>/model.jaxexport)")
+    p.add_argument("--serve_artifact", type=str, default=None,
+                   help="artifact to serve (--mode serve); default "
+                        "<log_dir>/model.jaxexport when present, else "
+                        "the latest checkpoint is restored and served "
+                        "live")
+    p.add_argument("--serve_buckets", type=str, default="1,8,32,128",
+                   help="comma-separated pre-compiled batch sizes; a "
+                        "request batch pads up to the smallest bucket "
+                        "that fits (avoids per-shape recompiles)")
+    p.add_argument("--serve_queue_depth", type=int, default=256,
+                   help="admission control: submits beyond this queue "
+                        "depth are shed immediately (HTTP 503) instead "
+                        "of growing an unbounded backlog")
+    p.add_argument("--serve_batch_window_ms", type=float, default=2.0,
+                   help="max extra latency the batcher may wait to "
+                        "coalesce a fuller batch")
+    p.add_argument("--serve_deadline_ms", type=float, default=None,
+                   help="per-request deadline; requests queued past it "
+                        "are shed at dispatch (default: none)")
+    p.add_argument("--serve_port", type=int, default=8000,
+                   help="HTTP port for --mode serve (0 = ephemeral)")
+    p.add_argument("--serve_metrics_every_s", type=float, default=5.0,
+                   help="cadence of `serve` JSONL window records")
     p.add_argument("--learning_rate", type=float, default=0.1)
     p.add_argument("--fidelity", type=str, default="faithful",
                    choices=["faithful", "fixed"],
@@ -398,6 +424,19 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     if args.fsdp and args.explicit_collectives:
         raise SystemExit("--fsdp needs the GSPMD (default) step, not "
                          "--explicit_collectives")
+    try:
+        cfg.serve.buckets = tuple(
+            int(b) for b in args.serve_buckets.split(",") if b.strip())
+    except ValueError:
+        raise SystemExit(
+            f"--serve_buckets must be comma-separated ints, got "
+            f"{args.serve_buckets!r}")
+    cfg.serve.max_queue_depth = args.serve_queue_depth
+    cfg.serve.batch_window_ms = args.serve_batch_window_ms
+    cfg.serve.deadline_ms = args.serve_deadline_ms
+    cfg.serve.port = args.serve_port
+    cfg.serve.artifact_path = args.serve_artifact
+    cfg.serve.metrics_every_s = args.serve_metrics_every_s
     return cfg
 
 
@@ -480,6 +519,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"[cli] exported step-{step} forward ({len(blob)} bytes, "
                   f"tpu+cpu, symbolic batch) to {path}")
         return 0
+
+    if args.mode == "serve":
+        from dml_cnn_cifar10_tpu.serve.server import main_serve
+        return main_serve(cfg, task_index=args.task_index)
 
     result = Trainer(cfg, task_index=args.task_index).fit()
     print(f"[cli] done at step {result.final_step}; "

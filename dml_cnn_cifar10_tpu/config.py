@@ -191,7 +191,9 @@ class ModelConfig:
     # ((expert, slot)-indexed scatter/gather — O(T·D) instead of the
     # einsum pair's O(T²·f·D); measured 2.28x vit_moe step throughput
     # at 16k tokens on one chip, BASELINE.md round 5). Identical
-    # semantics, pinned bit-comparable by tests.
+    # semantics, numerically equivalent (pinned to ~1e-5 by
+    # test_scatter_dispatch_matches_einsum — reduction orders differ,
+    # so outputs are close, not bit-identical).
     moe_dispatch: str = "einsum"
     moe_top_k: int = 1                    # 1 = Switch, 2 = GShard routing
     moe_capacity_factor: float = 1.25
@@ -291,6 +293,43 @@ class ParallelConfig:
 
 
 @dataclasses.dataclass
+class ServeConfig:
+    """Serving runtime (``--mode serve``, ``serve/`` package).
+
+    No reference counterpart at all — the reference's only output is a
+    checkpoint directory (``cifar10cnn.py:222``). These knobs shape the
+    dynamic micro-batcher documented in ``docs/SERVING.md``.
+    """
+
+    # Pre-compiled batch sizes. Each bucket jit-compiles once at warmup;
+    # a request batch pads up to the smallest bucket that fits. More
+    # buckets = tighter padding waste, more compiles and executable
+    # cache; powers-of-~4 cover the range well.
+    buckets: Tuple[int, ...] = (1, 8, 32, 128)
+    # Admission control: submits beyond this queue depth are rejected
+    # immediately (ShedError) instead of growing an unbounded backlog —
+    # bounded worst-case queue wait, shed load instead of collapsing.
+    max_queue_depth: int = 256
+    # Max extra latency the batcher may add waiting to fill a batch:
+    # the head request of a batch waits at most this long before
+    # dispatch. Under saturation batches fill instantly and the window
+    # never engages.
+    batch_window_ms: float = 2.0
+    # Per-request deadline: requests still queued past it are shed at
+    # dispatch time (the client already gave up — don't spend device
+    # lanes on them). None = no deadline.
+    deadline_ms: Optional[float] = None
+    # HTTP port for --mode serve (0 = ephemeral, the chosen port is
+    # printed at startup).
+    port: int = 8000
+    # Explicit artifact to serve. None = <log_dir>/model.jaxexport when
+    # present, else restore the latest checkpoint and serve live params.
+    artifact_path: Optional[str] = None
+    # Cadence of `serve` JSONL window records while the server runs.
+    metrics_every_s: float = 5.0
+
+
+@dataclasses.dataclass
 class TrainConfig:
     """Training driver. Reference: ``cifar10cnn.py:11-14,219-242``."""
 
@@ -382,6 +421,7 @@ class TrainConfig:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
 
 def reference_config(**overrides) -> TrainConfig:

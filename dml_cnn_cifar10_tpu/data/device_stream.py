@@ -27,10 +27,12 @@ permutations; the two streams are equally-valid shuffles but NOT
 bit-identical — switching ``--device_index_stream`` mid-run changes the
 data order (documented at the flag).
 
-Supported range: stream positions are uint32 (JAX's default int width on
-device — x64 is globally off), so the stream is exact for the first
-``2^32`` SAMPLES (step·batch + i < 2^32); past that the position wraps
-silently, restarting the epoch sequence. ~4.3 B samples is ~86 k CIFAR
+Supported range: stream positions are computed in uint32 because the
+Feistel/mix arithmetic requires it — the lowbias32 round function and
+the cycle-walk domain are defined over exactly 2^32 (the multiply/xor
+constants and shift widths are 32-bit), so the stream is exact for the
+first ``2^32`` SAMPLES (step·batch + i < 2^32); past that the position
+wraps silently, restarting the epoch sequence. ~4.3 B samples is ~86 k CIFAR
 epochs — far past any real run here, but callers must enforce it:
 :func:`check_supported_range` raises at BUILD time from the planned
 ``total_steps × batch`` (train/loop.py calls it when the stream is

@@ -93,10 +93,25 @@ def save_exported(path: str, blob: bytes) -> None:
     os.replace(tmp, path)
 
 
+def deserialize_exported(blob: bytes):
+    """The raw :class:`jax.export.Exported` — callable plus avals. The
+    serving engine reads the input contract back out of the artifact
+    itself (:func:`artifact_image_shape`) instead of requiring the
+    original ``DataConfig`` at deploy time."""
+    return jax_export.deserialize(blob)
+
+
+def artifact_image_shape(exported) -> tuple:
+    """Per-request ``(H, W, C)`` from the artifact's input aval (the
+    leading batch dim is symbolic and excluded)."""
+    shape = exported.in_avals[0].shape
+    return tuple(int(d) for d in shape[1:])
+
+
 def load_exported_bytes(blob: bytes):
     """Deserialize an exported artifact; returns the jit-callable
     ``fn(images_u8) -> logits``."""
-    return jax.jit(jax_export.deserialize(blob).call)
+    return jax.jit(deserialize_exported(blob).call)
 
 
 def load_exported(path: str):

@@ -370,6 +370,23 @@ def _1f1b_ring_backward_local(xl, pl, gl, *, stage, nstages, m):
         def run_fwd(h):
             h_out, vjp_fn = jax.vjp(stage, h, pl)
             ls = jax.tree.flatten(vjp_fn)[0]
+            # The ring layout was sized from the TEMPLATE trace's leaves
+            # (leaves0) and the consuming tick re-interleaves by
+            # position — all on the undocumented assumption that every
+            # per-tick vjp trace produces residual leaves in the same
+            # order with the same avals. Partial-eval gives no such
+            # contract across jax versions, so verify it at trace time
+            # instead of silently corrupting gradients on mismatch.
+            if len(ls) != len(leaves0) or any(
+                    l.shape != l0.shape or l.dtype != l0.dtype
+                    for l, l0 in zip(ls, leaves0)):
+                raise AssertionError(
+                    "1f1b_ring: per-tick vjp residual leaves diverge "
+                    "from the template trace (positional shape/dtype "
+                    "mismatch) — the ring buffers no longer line up "
+                    "with the stored-leaf mask; got "
+                    f"{[(l.shape, str(l.dtype)) for l in ls]} vs "
+                    f"{[(l.shape, str(l.dtype)) for l in leaves0]}")
             return h_out, tuple(l for l, st in zip(ls, stored) if st)
 
         def skip_fwd(h):

@@ -1,0 +1,15 @@
+"""TPU-native serving: dynamic micro-batching inference over the
+exported StableHLO artifact (or live params).
+
+The training half of the repo ends at a checkpoint directory and an
+``export.py`` artifact; this package is the missing deployment half —
+the runtime that turns single-image requests into padded device batches
+at a small set of pre-compiled bucket sizes, with admission control,
+deadline shedding, and latency/throughput accounting on the existing
+JSONL telemetry stream. See ``docs/SERVING.md``.
+"""
+
+from dml_cnn_cifar10_tpu.serve.batcher import (MicroBatcher,  # noqa: F401
+                                               ShedError)
+from dml_cnn_cifar10_tpu.serve.engine import ServingEngine  # noqa: F401
+from dml_cnn_cifar10_tpu.serve.metrics import ServeMetrics  # noqa: F401

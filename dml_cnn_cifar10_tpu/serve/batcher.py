@@ -1,0 +1,222 @@
+"""Dynamic micro-batcher: single-image requests → padded bucket batches.
+
+The serving problem on an accelerator is the mismatch between the
+request arrival unit (one image) and the efficient execution unit (a
+large batch): dispatching batch-1 forwards wastes the MXU, but waiting
+to fill a big batch wastes latency. The classic answer — TF-Serving's
+dynamic batching, here rebuilt JAX-native — is a short coalescing
+window over a thread-safe queue:
+
+- Clients :meth:`MicroBatcher.submit` one image and get a
+  ``concurrent.futures.Future`` of its logits row.
+- A single worker thread dequeues a batch: it takes the first waiting
+  request, then keeps collecting until either the largest bucket is
+  full or ``batch_window_s`` has elapsed — so under load batches are
+  full (no added latency), and when idle a lone request waits at most
+  one window.
+- The batch is padded up to the SMALLEST PRE-COMPILED BUCKET that fits
+  (e.g. 1/8/32/128). Buckets exist because the engine jit-compiles per
+  concrete shape: without quantization every new fill level would eat a
+  fresh XLA compile mid-traffic. Pad lanes are zeros; rows are computed
+  independently by the eval forward, and only the first ``n_real`` rows
+  are scattered back to futures, so padding can never leak into a real
+  response (pinned by ``tests/test_serve.py``).
+
+Overload policy is shed, don't collapse: admission control bounds the
+queue (``submit`` raises :class:`ShedError` when it is full — the
+client gets an immediate reject instead of unbounded latency), and each
+request may carry a deadline — requests whose deadline passed while
+queued fail with :class:`ShedError` at dispatch time rather than
+occupying device lanes nobody is waiting for.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dml_cnn_cifar10_tpu.serve.engine import ServingEngine
+from dml_cnn_cifar10_tpu.serve.metrics import ServeMetrics
+
+
+class ShedError(RuntimeError):
+    """Request shed by admission control (``queue_full``), deadline
+    expiry (``deadline``), or server shutdown (``shutdown``)."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"request shed: {reason}")
+        self.reason = reason
+
+
+class _Request:
+    __slots__ = ("image", "future", "t_enqueue", "deadline")
+
+    def __init__(self, image, future, t_enqueue, deadline):
+        self.image = image
+        self.future = future
+        self.t_enqueue = t_enqueue
+        self.deadline = deadline
+
+
+class MicroBatcher:
+    """Thread-safe coalescing request queue in front of a
+    :class:`ServingEngine`.
+
+    ``buckets`` must be ascending positive batch sizes; the largest is
+    the max batch per dispatch. ``batch_window_s`` is the maximum extra
+    latency coalescing may add to the request at the head of a batch.
+    ``default_deadline_s`` (None = no deadline) applies to submits that
+    don't carry their own.
+    """
+
+    def __init__(self, engine: ServingEngine,
+                 buckets: Sequence[int] = (1, 8, 32, 128),
+                 max_queue_depth: int = 256,
+                 batch_window_s: float = 0.002,
+                 default_deadline_s: Optional[float] = None,
+                 metrics: Optional[ServeMetrics] = None,
+                 warmup: bool = True):
+        bs = [int(b) for b in buckets]
+        if not bs or any(b <= 0 for b in bs) or sorted(set(bs)) != bs:
+            raise ValueError(
+                f"buckets must be ascending positive ints, got {buckets}")
+        self.engine = engine
+        self.buckets = tuple(bs)
+        self.batch_window_s = float(batch_window_s)
+        self.default_deadline_s = default_deadline_s
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self._q: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=int(max_queue_depth))
+        self._stop = threading.Event()
+        if warmup:
+            self.compile_secs = engine.warmup(self.buckets)
+        else:
+            self.compile_secs = {}
+        self._worker = threading.Thread(target=self._run,
+                                        name="microbatcher", daemon=True)
+        self._worker.start()
+
+    # --- client side ---
+
+    def submit(self, image: np.ndarray,
+               deadline_s: Optional[float] = None) -> Future:
+        """Enqueue one ``uint8 [H, W, C]`` image; returns a Future of
+        its ``[K]`` logits row. Raises :class:`ShedError` immediately
+        when the queue is at depth (admission control) or the server is
+        stopping."""
+        image = np.asarray(image)
+        if image.shape != self.engine.image_shape \
+                or image.dtype != np.uint8:
+            raise ValueError(
+                f"expected uint8 image of shape {self.engine.image_shape}, "
+                f"got {image.dtype} {image.shape}")
+        if self._stop.is_set():
+            raise ShedError("shutdown")
+        now = time.perf_counter()
+        dl = deadline_s if deadline_s is not None else self.default_deadline_s
+        req = _Request(image, Future(), now,
+                       None if dl is None else now + dl)
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self.metrics.record_shed("queue_full")
+            raise ShedError("queue_full") from None
+        self.metrics.record_submit()
+        return req.future
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admitting; by default let the worker drain what is
+        already queued, otherwise fail queued requests with
+        ``ShedError("shutdown")``."""
+        self._stop.set()
+        if not drain:
+            while True:
+                try:
+                    req = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                req.future.set_exception(ShedError("shutdown"))
+        self._worker.join()
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- worker side ---
+
+    def _pick_bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _collect(self):
+        """One batch's worth of requests: first request (blocking poll),
+        then coalesce until the largest bucket fills or the window
+        closes."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        t_close = time.perf_counter() + self.batch_window_s
+        while len(batch) < self.buckets[-1]:
+            remaining = t_close - time.perf_counter()
+            if remaining <= 0:
+                # Past the window, still take whatever is already queued
+                # (free fill, no extra wait).
+                try:
+                    batch.append(self._q.get_nowait())
+                    continue
+                except queue.Empty:
+                    break
+            try:
+                batch.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _dispatch(self, batch) -> None:
+        t_start = time.perf_counter()
+        live = []
+        for r in batch:
+            if r.deadline is not None and t_start > r.deadline:
+                self.metrics.record_shed("deadline")
+                r.future.set_exception(ShedError("deadline"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        bucket = self._pick_bucket(len(live))
+        padded = np.zeros((bucket, *self.engine.image_shape), np.uint8)
+        for i, r in enumerate(live):
+            padded[i] = r.image
+        try:
+            logits, device_s = self.engine.forward_timed(padded)
+        except Exception as e:                    # pragma: no cover
+            # A device failure must not strand clients on futures that
+            # never resolve.
+            for r in live:
+                r.future.set_exception(e)
+            return
+        self.metrics.record_batch(bucket, len(live), device_s)
+        t_done = time.perf_counter()
+        for i, r in enumerate(live):
+            self.metrics.record_done(t_done - r.t_enqueue,
+                                     t_start - r.t_enqueue)
+            r.future.set_result(np.array(logits[i]))
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch:
+                self._dispatch(batch)
+            elif self._stop.is_set():
+                return

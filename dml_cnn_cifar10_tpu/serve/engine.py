@@ -1,0 +1,96 @@
+"""The device side of serving: one uint8-in/logits-out callable plus
+bucket pre-compilation.
+
+Two construction paths, one call contract:
+
+- :meth:`ServingEngine.from_artifact` — deserialize the ``export.py``
+  StableHLO artifact (weights embedded, symbolic batch dim, raw-uint8
+  input with the eval decode compiled in). The input image geometry is
+  read back out of the artifact's own avals, so a server needs no
+  ``DataConfig`` to validate requests against it.
+- :meth:`ServingEngine.from_params` — wrap live params through
+  :func:`~dml_cnn_cifar10_tpu.export.make_serving_fn` (identical
+  semantics to what export would serialize; the no-artifact dev loop).
+
+Either way the callable is jitted, so each distinct batch size compiles
+exactly once. That is why the batcher quantizes to a fixed bucket set
+(:meth:`warmup` pre-compiles them all before traffic): an unquantized
+batcher would recompile on every new fill level and the first request at
+each level would eat a multi-second compile in its latency.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+class ServingEngine:
+    """Uint8 image batches in, numpy logits out, with device timing.
+
+    ``fn`` maps ``uint8 [B, H, W, C] -> logits [B, K]``; ``image_shape``
+    is the per-request ``(H, W, C)`` contract the batcher validates and
+    pads against.
+    """
+
+    def __init__(self, fn, image_shape: Tuple[int, int, int],
+                 source: str = "live"):
+        self._fn = fn
+        self.image_shape = tuple(int(d) for d in image_shape)
+        self.source = source
+
+    @classmethod
+    def from_artifact(cls, path: Optional[str] = None,
+                      blob: Optional[bytes] = None) -> "ServingEngine":
+        """Engine over a serialized ``export.py`` artifact (file path or
+        raw bytes). Self-contained: weights, decode, and input geometry
+        all come from the artifact."""
+        import jax
+
+        from dml_cnn_cifar10_tpu import export as export_lib
+
+        if (path is None) == (blob is None):
+            raise ValueError("pass exactly one of path= or blob=")
+        if path is not None:
+            with open(path, "rb") as f:
+                blob = f.read()
+        exported = export_lib.deserialize_exported(blob)
+        shape = export_lib.artifact_image_shape(exported)
+        return cls(jax.jit(exported.call), shape,
+                   source=path or "<artifact bytes>")
+
+    @classmethod
+    def from_params(cls, model_def, model_cfg, data_cfg, params: Any,
+                    model_state: Any = None) -> "ServingEngine":
+        """Engine over live params — the same eval forward export.py
+        would serialize, without the serialize/deserialize round trip."""
+        import jax
+
+        from dml_cnn_cifar10_tpu.export import make_serving_fn
+
+        fn = jax.jit(make_serving_fn(model_def, model_cfg, data_cfg,
+                                     params, model_state))
+        return cls(fn, (data_cfg.image_height, data_cfg.image_width,
+                        data_cfg.num_channels))
+
+    def warmup(self, buckets) -> dict:
+        """Compile every bucket size before admitting traffic (zeros
+        input); returns ``{bucket: compile_seconds}`` for the serve log."""
+        out = {}
+        for b in sorted(set(int(b) for b in buckets)):
+            t0 = time.perf_counter()
+            self.forward_timed(np.zeros((b, *self.image_shape), np.uint8))
+            out[b] = round(time.perf_counter() - t0, 3)
+        return out
+
+    def forward_timed(self, batch_u8: np.ndarray):
+        """``(logits ndarray [B, K], device_seconds)`` — the fetch blocks
+        until the device result is ready, so the timing covers dispatch +
+        execution + transfer (what a request actually waits for)."""
+        import jax
+
+        t0 = time.perf_counter()
+        logits = np.asarray(jax.device_get(self._fn(batch_u8)))
+        return logits, time.perf_counter() - t0

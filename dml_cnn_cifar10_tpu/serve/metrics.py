@@ -1,0 +1,138 @@
+"""Serving telemetry: latency percentiles, batch-fill, and shed
+accounting on the existing JSONL stream.
+
+The training side answers "where did the wall-clock go?" with goodput
+fractions; the serving side's analogue questions are "what did a request
+wait for?" (queue vs device) and "is the batcher earning its keep?"
+(batch-fill fraction) and "is admission control shedding instead of
+collapsing?" (shed counts). One :class:`ServeMetrics` instance is shared
+by the batcher's worker thread and every client thread, so all mutation
+is under one lock; :meth:`emit` writes ``serve`` window records and a
+final ``serve_done`` cumulative record through the same
+``MetricsLogger`` the trainer uses — ``tools/check_jsonl_schema.py``
+lints them and ``tools/telemetry_report.py`` summarizes them alongside
+training runs (schema: ``docs/SERVING.md``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from dml_cnn_cifar10_tpu.utils.telemetry import latency_summary, percentile
+
+
+class _Window:
+    """One accumulation window's raw samples (no derived stats)."""
+
+    __slots__ = ("submitted", "completed", "shed_queue", "shed_deadline",
+                 "latencies", "queue_waits", "device_secs", "fills",
+                 "batches", "t0")
+
+    def __init__(self):
+        self.submitted = 0
+        self.completed = 0
+        self.shed_queue = 0
+        self.shed_deadline = 0
+        self.latencies = []       # submit -> result, seconds
+        self.queue_waits = []     # submit -> dispatch start, seconds
+        self.device_secs = []     # per batch
+        self.fills = []           # real_rows / bucket per batch
+        self.batches = 0
+        self.t0 = time.perf_counter()
+
+
+class ServeMetrics:
+    """Thread-safe serving counters with windowed + cumulative views."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._win = _Window()
+        self._total = _Window()
+
+    # --- recording (called from client + worker threads) ---
+
+    def record_submit(self) -> None:
+        with self._lock:
+            self._win.submitted += 1
+            self._total.submitted += 1
+
+    def record_shed(self, reason: str) -> None:
+        field = "shed_queue" if reason == "queue_full" else "shed_deadline"
+        with self._lock:
+            for w in (self._win, self._total):
+                setattr(w, field, getattr(w, field) + 1)
+
+    def record_batch(self, bucket: int, n_real: int,
+                     device_s: float) -> None:
+        with self._lock:
+            for w in (self._win, self._total):
+                w.batches += 1
+                w.device_secs.append(device_s)
+                w.fills.append(n_real / bucket)
+
+    def record_done(self, latency_s: float, queue_wait_s: float) -> None:
+        with self._lock:
+            for w in (self._win, self._total):
+                w.completed += 1
+                w.latencies.append(latency_s)
+                w.queue_waits.append(queue_wait_s)
+
+    # --- reporting ---
+
+    @staticmethod
+    def _snapshot(w: _Window, now: float) -> dict:
+        span = max(now - w.t0, 1e-9)
+        lat = latency_summary(w.latencies)
+        qw50 = percentile(w.queue_waits, 50)
+        dev50 = percentile(w.device_secs, 50)
+        return {
+            "requests": w.submitted,
+            "completed": w.completed,
+            "shed_queue": w.shed_queue,
+            "shed_deadline": w.shed_deadline,
+            "qps": round(w.completed / span, 2),
+            "p50_ms": lat["p50_ms"],
+            "p95_ms": lat["p95_ms"],
+            "p99_ms": lat["p99_ms"],
+            "max_ms": lat["max_ms"],
+            "queue_wait_p50_ms":
+                None if qw50 is None else round(qw50 * 1e3, 3),
+            "device_p50_ms":
+                None if dev50 is None else round(dev50 * 1e3, 3),
+            "batches": w.batches,
+            "batch_fill":
+                round(sum(w.fills) / len(w.fills), 4) if w.fills else None,
+            "window_s": round(span, 3),
+        }
+
+    def window(self, reset: bool = True) -> dict:
+        """Stats since the last window reset (the periodic serve record)."""
+        with self._lock:
+            out = self._snapshot(self._win, time.perf_counter())
+            if reset:
+                self._win = _Window()
+        return out
+
+    def cumulative(self) -> dict:
+        """Run-lifetime stats (the ``serve_done`` / report payload)."""
+        with self._lock:
+            out = self._snapshot(self._total, time.perf_counter())
+        total = (out["completed"] + out["shed_queue"]
+                 + out["shed_deadline"])
+        out["shed_fraction"] = round(
+            (out["shed_queue"] + out["shed_deadline"]) / total, 4) \
+            if total else 0.0
+        return out
+
+    def emit(self, logger, final: bool = False) -> None:
+        """Write one ``serve`` window record (and, when ``final``, the
+        cumulative ``serve_done``) through ``MetricsLogger``."""
+        if logger is None:
+            return
+        logger.log("serve", **self.window(reset=True))
+        if final:
+            done = self.cumulative()
+            done["total_s"] = done.pop("window_s")
+            logger.log("serve_done", **done)

@@ -1,0 +1,173 @@
+"""The ``--mode serve`` runtime: engine + batcher behind a stdlib HTTP
+front end, with periodic telemetry flushes.
+
+Deliberately minimal transport — ``http.server.ThreadingHTTPServer`` is
+in the standard library, one thread per connection, and every request
+thread just parks on a batcher future (the real concurrency limit is
+the bucket size, not the thread count). The endpoints:
+
+- ``POST /predict`` — body is one raw image: exactly ``H*W*C`` bytes of
+  uint8 (the CIFAR on-disk pixel layout, row-major HWC). Response JSON:
+  ``{"class": argmax, "logits": [...]}``. 503 with a reason on shed.
+- ``GET /stats`` — cumulative :class:`ServeMetrics` snapshot as JSON.
+- ``GET /healthz`` — liveness + the engine's input contract.
+
+Artifact resolution for :func:`main_serve`: an explicit
+``serve.artifact_path`` must exist (fail loudly — a typo'd path
+silently falling back to fresh weights would serve garbage); otherwise
+the default export location ``<log_dir>/model.jaxexport`` is used when
+present, else the latest checkpoint is restored and served live.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from dml_cnn_cifar10_tpu.serve.batcher import MicroBatcher, ShedError
+from dml_cnn_cifar10_tpu.serve.engine import ServingEngine
+from dml_cnn_cifar10_tpu.serve.metrics import ServeMetrics
+
+
+def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics):
+    image_bytes = 1
+    for d in batcher.engine.image_shape:
+        image_bytes *= d
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # access log -> metrics, not stderr
+            pass
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"ok": True,
+                                  "image_shape": batcher.engine.image_shape,
+                                  "buckets": batcher.buckets})
+            elif self.path == "/stats":
+                self._reply(200, metrics.cumulative())
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            import numpy as np
+            if self.path != "/predict":
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            if len(body) != image_bytes:
+                self._reply(400, {
+                    "error": f"expected {image_bytes} raw uint8 bytes "
+                             f"(HWC {batcher.engine.image_shape}), "
+                             f"got {len(body)}"})
+                return
+            image = np.frombuffer(body, np.uint8).reshape(
+                batcher.engine.image_shape)
+            try:
+                logits = batcher.submit(image).result()
+            except ShedError as e:
+                self._reply(503, {"shed": e.reason})
+                return
+            self._reply(200, {"class": int(logits.argmax()),
+                              "logits": [float(v) for v in logits]})
+
+    return Handler
+
+
+class _MetricsFlusher(threading.Thread):
+    """Periodic ``serve`` window records while the server runs."""
+
+    def __init__(self, metrics: ServeMetrics, logger, every_s: float):
+        super().__init__(name="serve-metrics", daemon=True)
+        self._metrics = metrics
+        self._logger = logger
+        self._every = every_s
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.wait(self._every):
+            self._metrics.emit(self._logger)
+
+    def stop(self):
+        self._stop.set()
+
+
+def resolve_engine(cfg, task_index: int = 0) -> ServingEngine:
+    """Artifact if configured/present, else live params from the latest
+    checkpoint (the same EMA-preferring selection as ``--mode export``)."""
+    serve_cfg = cfg.serve
+    if serve_cfg.artifact_path:
+        if not os.path.exists(serve_cfg.artifact_path):
+            raise SystemExit(
+                f"--serve_artifact {serve_cfg.artifact_path} does not "
+                f"exist (refusing to fall back to fresh weights)")
+        return ServingEngine.from_artifact(serve_cfg.artifact_path)
+    default_artifact = os.path.join(cfg.log_dir, "model.jaxexport")
+    if os.path.exists(default_artifact):
+        return ServingEngine.from_artifact(default_artifact)
+
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+    trainer = Trainer(cfg, task_index=task_index)
+    state = trainer.init_or_restore()
+    params = state.opt.get("ema", state.params)
+    mstate = state.opt.get("ema_mstate", state.model_state) \
+        if trainer.model_def.has_state else None
+    return ServingEngine.from_params(trainer.model_def, cfg.model,
+                                     cfg.data, params, mstate)
+
+
+def main_serve(cfg, task_index: int = 0,
+               ready_event: Optional[threading.Event] = None) -> int:
+    """Blocking serve loop (Ctrl-C to stop). ``ready_event`` is set once
+    the HTTP socket is listening and all buckets are compiled — the
+    hook tests and ``tools/loadgen.py --target`` use it to avoid racing
+    the warmup."""
+    from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+
+    serve_cfg = cfg.serve
+    engine = resolve_engine(cfg, task_index)
+    metrics = ServeMetrics()
+    logger = MetricsLogger(jsonl_path=cfg.metrics_jsonl,
+                           task_index=task_index)
+    batcher = MicroBatcher(
+        engine, buckets=serve_cfg.buckets,
+        max_queue_depth=serve_cfg.max_queue_depth,
+        batch_window_s=serve_cfg.batch_window_ms / 1e3,
+        default_deadline_s=None if serve_cfg.deadline_ms is None
+        else serve_cfg.deadline_ms / 1e3,
+        metrics=metrics)
+    print(f"[serve] engine={engine.source} image_shape="
+          f"{engine.image_shape} buckets={batcher.buckets} "
+          f"compile_s={batcher.compile_secs}")
+
+    server = ThreadingHTTPServer(("", serve_cfg.port),
+                                 _make_handler(batcher, metrics))
+    flusher = _MetricsFlusher(metrics, logger, serve_cfg.metrics_every_s)
+    flusher.start()
+    print(f"[serve] listening on :{server.server_address[1]} "
+          f"(POST /predict, GET /stats, GET /healthz)")
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        flusher.stop()
+        batcher.close()
+        metrics.emit(logger, final=True)
+        logger.flush()
+        logger.close()
+    return 0

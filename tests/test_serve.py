@@ -1,0 +1,277 @@
+"""Serving subsystem: dynamic micro-batching engine (``serve/``).
+
+Covers the batcher's contract from three angles: pure queueing behavior
+against a stub engine (bucket selection, coalescing, padding isolation,
+deadline/queue shedding — no jax in the loop), exactness against the
+real jitted forward on CPU (serve == direct, padding stripped), and the
+``tools/loadgen.py`` closed-loop smoke that exercises the whole stack
+including the JSONL ``serve`` schema.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.serve import (MicroBatcher, ServeMetrics,
+                                       ServingEngine, ShedError)
+
+
+class StubEngine:
+    """Deterministic fake device: logits row i = [sum(image i), lane i].
+
+    Row values depend ONLY on that row's image (plus its lane index, to
+    catch scatter misalignment), so any cross-lane leak or misrouting
+    shows up as a wrong sum. Records every dispatched batch shape.
+    """
+
+    image_shape = (2, 2, 1)
+
+    def __init__(self, forward_s: float = 0.0, gate: threading.Event = None):
+        self.batch_sizes = []
+        self.forward_s = forward_s
+        self.gate = gate
+
+    def warmup(self, buckets):
+        return {}
+
+    def forward_timed(self, batch):
+        if self.gate is not None:
+            self.gate.wait(timeout=10)
+        if self.forward_s:
+            time.sleep(self.forward_s)
+        self.batch_sizes.append(batch.shape[0])
+        logits = np.stack(
+            [np.array([float(batch[i].sum()), float(i)], np.float32)
+             for i in range(batch.shape[0])])
+        return logits, self.forward_s
+
+
+def _images(n, shape=(2, 2, 1), seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, (n, *shape), dtype=np.uint8)
+
+
+def test_bucket_selection_and_padding_isolation():
+    """Requests coalesce into the smallest fitting bucket; every result
+    is a function of its own image only (padded lanes never leak)."""
+    eng = StubEngine()
+    with MicroBatcher(eng, buckets=(1, 4, 16), batch_window_s=0.2,
+                      warmup=False) as b:
+        imgs = _images(6)
+        futs = [b.submit(im) for im in imgs]
+        res = [f.result(timeout=10) for f in futs]
+    # 6 requests submitted well inside one 200 ms window -> one batch,
+    # padded up to the smallest bucket that fits (16, not 4).
+    assert eng.batch_sizes == [16]
+    for i, (im, r) in enumerate(zip(imgs, res)):
+        assert r[0] == float(im.sum())    # own image's payload
+        assert r[1] == float(i)           # own lane (order preserved)
+    snap = b.metrics.cumulative()
+    assert snap["completed"] == 6
+    assert snap["batches"] == 1
+    assert snap["batch_fill"] == pytest.approx(6 / 16)
+
+
+def test_oversized_burst_splits_at_max_bucket():
+    eng = StubEngine()
+    with MicroBatcher(eng, buckets=(1, 4), batch_window_s=0.2,
+                      warmup=False) as b:
+        futs = [b.submit(im) for im in _images(6, seed=1)]
+        for f in futs:
+            f.result(timeout=10)
+    # Max bucket is 4: a 6-burst is two dispatches (4 real + 2 real
+    # padded to 4) — every device shape is a pre-compiled bucket, never
+    # a fresh size-6 compile.
+    assert eng.batch_sizes == [4, 4]
+
+
+def test_bad_submit_and_bad_buckets_rejected():
+    eng = StubEngine()
+    with MicroBatcher(eng, buckets=(1,), warmup=False) as b:
+        with pytest.raises(ValueError, match="shape"):
+            b.submit(np.zeros((3, 3, 1), np.uint8))
+        with pytest.raises(ValueError, match="shape"):
+            b.submit(np.zeros((2, 2, 1), np.int32))
+    with pytest.raises(ValueError, match="buckets"):
+        MicroBatcher(eng, buckets=(4, 1), warmup=False)
+    with pytest.raises(ValueError, match="buckets"):
+        MicroBatcher(eng, buckets=(), warmup=False)
+
+
+def test_queue_full_sheds_at_admission():
+    """Bounded queue: with the worker wedged in a dispatch and the
+    queue at depth, submit fails immediately — load is shed at the
+    door, not buffered into unbounded latency."""
+    gate = threading.Event()
+    eng = StubEngine(gate=gate)
+    metrics = ServeMetrics()
+    b = MicroBatcher(eng, buckets=(1,), max_queue_depth=1,
+                     batch_window_s=0.0, metrics=metrics, warmup=False)
+    try:
+        f1 = b.submit(_images(1)[0])          # dequeued, wedged on gate
+        time.sleep(0.1)                       # let the worker pick it up
+        b.submit(_images(1)[0])               # fills the 1-deep queue
+        with pytest.raises(ShedError) as exc:
+            b.submit(_images(1)[0])
+        assert exc.value.reason == "queue_full"
+        assert metrics.cumulative()["shed_queue"] == 1
+    finally:
+        gate.set()
+        b.close()
+    assert f1.result(timeout=10) is not None
+
+
+def test_deadline_expired_requests_shed_at_dispatch():
+    """A request whose deadline passes while queued fails with
+    ShedError instead of occupying device lanes."""
+    gate = threading.Event()
+    eng = StubEngine(gate=gate)
+    metrics = ServeMetrics()
+    b = MicroBatcher(eng, buckets=(1,), max_queue_depth=8,
+                     batch_window_s=0.0, metrics=metrics, warmup=False)
+    try:
+        b.submit(_images(1)[0])               # wedges the worker
+        time.sleep(0.05)
+        doomed = b.submit(_images(1)[0], deadline_s=0.01)
+        time.sleep(0.05)                      # deadline passes in queue
+    finally:
+        gate.set()
+        b.close()
+    with pytest.raises(ShedError, match="deadline"):
+        doomed.result(timeout=10)
+    snap = metrics.cumulative()
+    assert snap["shed_deadline"] == 1
+    assert snap["completed"] == 1             # the wedged one finished
+
+
+@pytest.fixture(scope="module")
+def cnn_engine():
+    model_def = get_model("cnn")
+    model_cfg = ModelConfig(logit_relu=False)
+    data_cfg = DataConfig(normalize="scale")
+    params = model_def.init(jax.random.key(0), model_cfg, data_cfg)
+    return ServingEngine.from_params(model_def, model_cfg, data_cfg,
+                                     params)
+
+
+def test_serve_equals_direct_forward(cnn_engine, rng):
+    """Acceptance: batcher output is EXACTLY the direct jitted forward
+    on the same inputs — same bucket, padding stripped."""
+    imgs = rng.integers(0, 256, (5, 32, 32, 3)).astype(np.uint8)
+    with MicroBatcher(cnn_engine, buckets=(1, 8),
+                      batch_window_s=0.25) as b:
+        futs = [b.submit(im) for im in imgs]
+        served = [f.result(timeout=60) for f in futs]
+    assert b.metrics.cumulative()["batches"] == 1  # coalesced: bucket 8
+
+    padded = np.zeros((8, 32, 32, 3), np.uint8)
+    padded[:5] = imgs
+    direct, _ = cnn_engine.forward_timed(padded)
+    for i in range(5):
+        assert np.array_equal(served[i], direct[i])
+
+
+def test_padding_content_cannot_leak(cnn_engine, rng):
+    """Same real rows, different pad garbage -> same real outputs (rows
+    are independent through the eval forward)."""
+    imgs = rng.integers(0, 256, (3, 32, 32, 3)).astype(np.uint8)
+    zeros_pad = np.zeros((8, 32, 32, 3), np.uint8)
+    zeros_pad[:3] = imgs
+    full_pad = np.full((8, 32, 32, 3), 255, np.uint8)
+    full_pad[:3] = imgs
+    a, _ = cnn_engine.forward_timed(zeros_pad)
+    c, _ = cnn_engine.forward_timed(full_pad)
+    np.testing.assert_allclose(a[:3], c[:3], rtol=1e-6, atol=1e-6)
+
+
+def test_serve_from_artifact_matches_live(cnn_engine, rng):
+    """The artifact path of the engine serves the same numbers as the
+    live-params path, through the batcher."""
+    from dml_cnn_cifar10_tpu import export as export_lib
+
+    model_def = get_model("cnn")
+    model_cfg = ModelConfig(logit_relu=False)
+    data_cfg = DataConfig(normalize="scale")
+    params = model_def.init(jax.random.key(0), model_cfg, data_cfg)
+    blob = export_lib.export_forward(model_def, model_cfg, data_cfg,
+                                     params, platforms=["cpu"])
+    art = ServingEngine.from_artifact(blob=blob)
+    assert art.image_shape == (32, 32, 3)
+
+    img = rng.integers(0, 256, (32, 32, 3)).astype(np.uint8)
+    with MicroBatcher(art, buckets=(1,)) as b:
+        got = b.submit(img).result(timeout=60)
+    want, _ = cnn_engine.forward_timed(img[None])
+    np.testing.assert_allclose(got, want[0], rtol=1e-5, atol=1e-6)
+
+
+def test_serve_metrics_jsonl_schema(tmp_path):
+    """serve / serve_done records pass the tier-1 schema lint."""
+    from tools import check_jsonl_schema
+
+    from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+
+    eng = StubEngine()
+    metrics = ServeMetrics()
+    with MicroBatcher(eng, buckets=(1, 4), batch_window_s=0.05,
+                      metrics=metrics, warmup=False) as b:
+        for f in [b.submit(im) for im in _images(3, seed=2)]:
+            f.result(timeout=10)
+    path = str(tmp_path / "serve.jsonl")
+    logger = MetricsLogger(jsonl_path=path)
+    metrics.emit(logger)            # window record mid-run
+    metrics.emit(logger, final=True)
+    logger.close()
+    assert check_jsonl_schema.check_file(path) == []
+    kinds = [json.loads(l)["kind"] for l in open(path)]
+    assert kinds == ["serve", "serve", "serve_done"]
+
+
+def test_cli_serve_flags_plumb_into_config():
+    from dml_cnn_cifar10_tpu.cli.main import build_parser, config_from_args
+
+    args, _ = build_parser().parse_known_args([
+        "--mode", "serve", "--serve_buckets", "2,16",
+        "--serve_queue_depth", "7", "--serve_batch_window_ms", "3.5",
+        "--serve_deadline_ms", "40", "--serve_port", "0",
+        "--serve_artifact", "/x/model.jaxexport"])
+    cfg = config_from_args(args)
+    assert cfg.serve.buckets == (2, 16)
+    assert cfg.serve.max_queue_depth == 7
+    assert cfg.serve.batch_window_ms == 3.5
+    assert cfg.serve.deadline_ms == 40
+    assert cfg.serve.port == 0
+    assert cfg.serve.artifact_path == "/x/model.jaxexport"
+
+
+def test_loadgen_closed_loop_smoke(tmp_path):
+    """Acceptance: a closed-loop loadgen run on the CPU engine writes a
+    report with latency percentiles and shed fraction (~2 s)."""
+    import tools.loadgen as loadgen
+
+    report_path = str(tmp_path / "report.json")
+    jsonl_path = str(tmp_path / "serve.jsonl")
+    assert loadgen.main([
+        "--mode", "closed", "--concurrency", "2",
+        "--duration_s", "1.0", "--buckets", "1,8",
+        "--report", report_path, "--metrics_jsonl", jsonl_path]) == 0
+
+    with open(report_path) as f:
+        report = json.load(f)
+    assert report["completed"] > 0
+    assert report["requests"] == report["completed"] + report["shed"]
+    assert 0.0 <= report["shed_fraction"] <= 1.0
+    assert report["achieved_qps"] > 0
+    for q in ("p50", "p95", "p99"):
+        assert report["latency_ms"][q] > 0
+    assert 0.0 < report["batch_fill"] <= 1.0
+
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(jsonl_path) == []

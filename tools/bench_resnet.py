@@ -103,7 +103,14 @@ def measure(norm: str, batch: int, k: int, chunks: int, reps: int,
     if flops:
         tflops = flops * (med / batch) / 1e12
         row["tflops_per_sec"] = round(tflops, 2)
-        row["mfu_vs_197"] = round(tflops / 197.0, 4)
+        # Peak from the chip the bench actually ran on (bench.py's
+        # device-kind lookup, BENCH_PEAK_TFLOPS overridable) — not a
+        # hardcoded v5e constant.
+        from bench import _peak_tflops
+        peak = _peak_tflops(jax.devices()[0].device_kind)
+        if peak:
+            row["peak_tflops"] = peak
+            row["mfu"] = round(tflops / peak, 4)
     return row
 
 

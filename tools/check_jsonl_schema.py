@@ -38,6 +38,14 @@ KIND_KEYS = {
     "done": ("step", "images_per_sec"),
     "preempt": ("step", "signum"),
     "numerics_halt": ("step",),
+    # Serving runtime (serve/metrics.py; docs/SERVING.md). Percentile
+    # values are null until the window has completions.
+    "serve": ("requests", "completed", "shed_queue", "shed_deadline",
+              "qps", "p50_ms", "p95_ms", "p99_ms", "batch_fill",
+              "window_s"),
+    "serve_done": ("requests", "completed", "shed_queue",
+                   "shed_deadline", "qps", "p50_ms", "p95_ms", "p99_ms",
+                   "batch_fill", "shed_fraction", "total_s"),
 }
 
 

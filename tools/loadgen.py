@@ -1,0 +1,315 @@
+#!/usr/bin/env python
+"""Load generator for the serving subsystem: closed- or open-loop
+traffic against the micro-batching engine, with a BENCH-style report.
+
+Two drive modes (the standard serving-bench dichotomy):
+
+- **closed** (default): ``--concurrency`` client threads each submit
+  one request, wait for its result, and immediately submit the next —
+  throughput is whatever the engine sustains at that concurrency
+  (latency and throughput are coupled).
+- **open**: requests arrive on a fixed ``--qps`` schedule regardless of
+  completions — the honest overload experiment: when the engine can't
+  keep up, the queue grows until admission control sheds, and the
+  report's ``shed_fraction`` says so (closed-loop clients would instead
+  silently slow down — coordinated omission).
+
+Two targets:
+
+- **in-process** (default): builds a CPU/TPU engine right here —
+  ``--artifact PATH`` serves an ``export.py`` artifact, otherwise a
+  fresh-initialized CNN (geometry from ``--image_size``) so the tool
+  runs on a bare checkout.
+- ``--target http://host:port``: drives a running ``--mode serve``
+  process over HTTP (raw-bytes POST /predict), measuring end-to-end
+  including transport.
+
+Requests replay CIFAR test images (``--source dataset``, raw uint8 from
+the on-disk records) or synthetic pixels (``--source random``). The
+JSON report (``--report``) carries achieved QPS, latency percentiles,
+shed fraction, and batch-fill — the serving analogue of BENCH_*.json.
+
+Usage:
+    python tools/loadgen.py --mode closed --concurrency 8 --duration_s 10
+    python tools/loadgen.py --mode open --qps 500 --deadline_ms 50 \\
+        --artifact /tmp/logs/model.jaxexport --report /tmp/serve_bench.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_engine(args):
+    from dml_cnn_cifar10_tpu.serve.engine import ServingEngine
+
+    if args.artifact:
+        return ServingEngine.from_artifact(args.artifact)
+    import jax
+
+    from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+
+    model_def = get_model(args.model)
+    model_cfg = ModelConfig(name=args.model, logit_relu=False)
+    data_cfg = DataConfig(image_height=args.image_size,
+                          image_width=args.image_size,
+                          crop_height=args.crop_size,
+                          crop_width=args.crop_size,
+                          normalize="scale")
+    params = model_def.init(jax.random.key(args.seed), model_cfg, data_cfg)
+    mstate = model_def.init_state(params) if model_def.has_state else None
+    return ServingEngine.from_params(model_def, model_cfg, data_cfg,
+                                     params, mstate)
+
+
+def load_images(args, image_shape):
+    """[N, H, W, C] uint8 request pool."""
+    import numpy as np
+
+    if args.source == "dataset":
+        from dml_cnn_cifar10_tpu.config import DataConfig
+        from dml_cnn_cifar10_tpu.data import ensure_dataset, test_files
+        from dml_cnn_cifar10_tpu.data.pipeline import _load_split
+
+        h, w, c = image_shape
+        cfg = DataConfig(dataset=args.dataset, data_dir=args.data_dir,
+                         image_height=h, image_width=w, num_channels=c,
+                         synthetic_test_records=512,
+                         use_native_loader=False)
+        ensure_dataset(cfg)
+        images, _ = _load_split(test_files(cfg), cfg)
+        return images
+    rng = np.random.default_rng(args.seed)
+    return rng.integers(0, 256, (256, *image_shape), dtype=np.uint8)
+
+
+class _HttpClient:
+    """Minimal stand-in for MicroBatcher.submit over HTTP — blocking
+    POST, so it only supports the closed-loop drive."""
+
+    def __init__(self, target: str, image_shape):
+        self.target = target.rstrip("/")
+        self.image_shape = image_shape
+
+    def predict(self, image) -> bool:
+        """True = completed, False = shed (HTTP 503)."""
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.target}/predict", data=image.tobytes(),
+            headers={"Content-Type": "application/octet-stream"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                return False
+            raise
+
+
+def run_closed(submit, images, args, client_stats):
+    """``--concurrency`` threads in submit→wait→repeat lockstep."""
+    stop_at = time.perf_counter() + args.duration_s
+    counter = {"i": 0}
+    lock = threading.Lock()
+
+    def worker():
+        while time.perf_counter() < stop_at:
+            with lock:
+                idx = counter["i"] = (counter["i"] + 1) % len(images)
+            submit(images[idx], client_stats)
+    threads = [threading.Thread(target=worker)
+               for _ in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def run_open(submit, images, args, client_stats):
+    """Fixed-rate arrivals for ``--duration_s``, fire-and-collect: each
+    request runs on its own short-lived thread so a slow engine cannot
+    slow the arrival schedule (no coordinated omission)."""
+    period = 1.0 / args.qps
+    t_end = time.perf_counter() + args.duration_s
+    pending = []
+    i = 0
+    next_at = time.perf_counter()
+    while next_at < t_end:
+        now = time.perf_counter()
+        if now < next_at:
+            time.sleep(next_at - now)
+        img = images[i % len(images)]
+        i += 1
+        th = threading.Thread(target=submit, args=(img, client_stats))
+        th.start()
+        pending.append(th)
+        next_at += period
+    for th in pending:
+        th.join(timeout=30)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--mode", choices=["closed", "open"], default="closed")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop client threads")
+    ap.add_argument("--qps", type=float, default=100.0,
+                    help="open-loop arrival rate")
+    ap.add_argument("--duration_s", type=float, default=10.0)
+    ap.add_argument("--deadline_ms", type=float, default=None)
+    ap.add_argument("--buckets", type=str, default="1,8,32,128")
+    ap.add_argument("--queue_depth", type=int, default=256)
+    ap.add_argument("--batch_window_ms", type=float, default=2.0)
+    ap.add_argument("--artifact", type=str, default=None,
+                    help="serve this export.py artifact instead of a "
+                         "fresh-initialized model")
+    ap.add_argument("--target", type=str, default=None,
+                    help="drive a running --mode serve HTTP endpoint "
+                         "instead of an in-process engine (closed mode "
+                         "only)")
+    ap.add_argument("--model", type=str, default="cnn")
+    ap.add_argument("--image_size", type=int, default=32)
+    ap.add_argument("--crop_size", type=int, default=24)
+    ap.add_argument("--source", choices=["random", "dataset"],
+                    default="random")
+    ap.add_argument("--dataset", type=str, default="synthetic")
+    ap.add_argument("--data_dir", type=str, default="cifar10data")
+    ap.add_argument("--metrics_jsonl", type=str, default=None,
+                    help="also append serve/serve_done JSONL records "
+                         "(in-process only)")
+    ap.add_argument("--report", type=str, default="loadgen_report.json")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from dml_cnn_cifar10_tpu.utils.telemetry import latency_summary
+
+    client_stats = {"completed": 0, "shed": 0, "latencies": [],
+                    "lock": threading.Lock()}
+
+    def record(ok: bool, dt: float, stats) -> None:
+        with stats["lock"]:
+            if ok:
+                stats["completed"] += 1
+                stats["latencies"].append(dt)
+            else:
+                stats["shed"] += 1
+
+    if args.target:
+        if args.mode != "closed":
+            raise SystemExit("--target supports --mode closed only (the "
+                             "server's own deadline handles open-loop "
+                             "overload)")
+        client = _HttpClient(args.target, None)
+        import numpy as np
+        rng = np.random.default_rng(args.seed)
+        images = rng.integers(
+            0, 256, (256, args.image_size, args.image_size, 3),
+            dtype=np.uint8)
+
+        def submit(img, stats):
+            t0 = time.perf_counter()
+            ok = client.predict(img)
+            record(ok, time.perf_counter() - t0, stats)
+
+        t0 = time.perf_counter()
+        run_closed(submit, images, args, client_stats)
+        wall = time.perf_counter() - t0
+        engine_side = {}
+    else:
+        from dml_cnn_cifar10_tpu.serve.batcher import (MicroBatcher,
+                                                       ShedError)
+        from dml_cnn_cifar10_tpu.serve.metrics import ServeMetrics
+
+        engine = build_engine(args)
+        images = load_images(args, engine.image_shape)
+        metrics = ServeMetrics()
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b)
+        batcher = MicroBatcher(
+            engine, buckets=buckets, max_queue_depth=args.queue_depth,
+            batch_window_s=args.batch_window_ms / 1e3,
+            default_deadline_s=None if args.deadline_ms is None
+            else args.deadline_ms / 1e3,
+            metrics=metrics)
+        print(f"[loadgen] engine ready (compile_s="
+              f"{batcher.compile_secs}); driving {args.mode} loop for "
+              f"{args.duration_s}s", flush=True)
+
+        def submit(img, stats):
+            t0 = time.perf_counter()
+            try:
+                batcher.submit(img).result()
+                record(True, time.perf_counter() - t0, stats)
+            except ShedError:
+                record(False, time.perf_counter() - t0, stats)
+
+        t0 = time.perf_counter()
+        if args.mode == "closed":
+            run_closed(submit, images, args, client_stats)
+        else:
+            run_open(submit, images, args, client_stats)
+        wall = time.perf_counter() - t0
+        batcher.close()
+        engine_side = metrics.cumulative()
+        if args.metrics_jsonl:
+            from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+            logger = MetricsLogger(jsonl_path=args.metrics_jsonl)
+            metrics.emit(logger, final=True)
+            logger.close()
+
+    completed = client_stats["completed"]
+    shed = client_stats["shed"]
+    total = completed + shed
+    lat = latency_summary(client_stats["latencies"])
+    report = {
+        "loadgen": {
+            "mode": args.mode,
+            "engine": "http" if args.target else "inprocess",
+            "concurrency": args.concurrency,
+            "target_qps": args.qps if args.mode == "open" else None,
+            "duration_s": round(wall, 3),
+            "deadline_ms": args.deadline_ms,
+            "buckets": args.buckets,
+            "queue_depth": args.queue_depth,
+            "batch_window_ms": args.batch_window_ms,
+            "source": args.source,
+            "seed": args.seed,
+        },
+        "requests": total,
+        "completed": completed,
+        "shed": shed,
+        "shed_fraction": round(shed / total, 4) if total else 0.0,
+        "achieved_qps": round(completed / wall, 2) if wall else 0.0,
+        "latency_ms": {
+            "p50": lat["p50_ms"], "p95": lat["p95_ms"],
+            "p99": lat["p99_ms"], "mean": lat["mean_ms"],
+            "max": lat["max_ms"],
+        },
+    }
+    for key in ("batch_fill", "batches", "queue_wait_p50_ms",
+                "device_p50_ms"):
+        if key in engine_side:
+            report[key] = engine_side[key]
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps(report))
+    print(f"[loadgen] wrote {args.report}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -126,6 +126,31 @@ def summarize(path: str) -> str:
             lines.append(f"    {label:<13} {first.get(key)} -> "
                          f"{last.get(key)}")
         lines.append(f"    max grad norm {gmax}")
+    serve = _last(records, "serve_done")
+    if serve is None:
+        # A server that died before the final flush still has windows.
+        windows = [r for r in records if r.get("kind") == "serve"]
+        if windows:
+            serve = windows[-1]
+    if serve:
+        span = serve.get("total_s") or serve.get("window_s") or 0.0
+        lines.append(f"  serving over {span:.2f} s "
+                     f"({'final' if serve['kind'] == 'serve_done' else 'last window'}):")
+        lines.append(
+            f"    {serve.get('completed')}/{serve.get('requests')} "
+            f"completed at {serve.get('qps')} qps; shed "
+            f"{serve.get('shed_queue')} queue-full + "
+            f"{serve.get('shed_deadline')} deadline")
+        if serve.get("p50_ms") is not None:
+            lines.append(
+                f"    latency p50/p95/p99: {serve.get('p50_ms')} / "
+                f"{serve.get('p95_ms')} / {serve.get('p99_ms')} ms "
+                f"(queue-wait p50 {serve.get('queue_wait_p50_ms')} ms, "
+                f"device p50 {serve.get('device_p50_ms')} ms)")
+        if serve.get("batch_fill") is not None:
+            lines.append(
+                f"    {serve.get('batches')} batches, mean fill "
+                f"{100 * serve['batch_fill']:.1f} %")
     hbm = _last(records, "hbm")
     if hbm:
         if hbm.get("available"):
