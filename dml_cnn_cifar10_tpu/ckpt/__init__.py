@@ -8,4 +8,6 @@ from dml_cnn_cifar10_tpu.ckpt.checkpoint import (  # noqa: F401
     restore_checkpoint,
     save_checkpoint,
     save_data_state,
+    verify_checkpoint,
+    write_checksum,
 )

@@ -23,10 +23,13 @@ TF's ``checkpoint`` protofile convention.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
+import sys
 import time
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 
@@ -39,6 +42,92 @@ FORMATS = ("msgpack", "orbax", "sharded")
 
 def _ckpt_path(ckpt_dir: str, step: int, fmt: str = "msgpack") -> str:
     return os.path.join(ckpt_dir, f"ckpt_{step}.{fmt}")
+
+
+# ---------------------------------------------------------------------------
+# Integrity sidecars: a sha256 checksum committed AFTER the checkpoint
+# bytes land, verified before any restore attempt. The sidecar records
+# the exact file list digested at commit time, so stale extra files in a
+# .sharded dir (a crashed larger-cluster save — already tolerated by
+# restore_sharded's manifest contract) don't fail verification, while a
+# truncated/bit-flipped/vanished member does.
+# ---------------------------------------------------------------------------
+
+def checksum_path(path: str) -> str:
+    return path + ".sha256"
+
+
+def _checkpoint_files(path: str):
+    """Relative paths of the files a checkpoint comprises, sorted."""
+    if not os.path.isdir(path):
+        return [os.path.basename(path)]
+    out = []
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for name in files:
+            out.append(os.path.relpath(os.path.join(root, name), path))
+    return sorted(out)
+
+
+def _digest_files(path: str, rel_files) -> Tuple[str, int]:
+    """(hex sha256, total bytes) over ``rel_files`` of ``path`` — each
+    file's relative name is mixed into the digest so renames don't pass."""
+    base = path if os.path.isdir(path) else os.path.dirname(path)
+    h = hashlib.sha256()
+    total = 0
+    for rel in rel_files:
+        h.update(rel.encode())
+        with open(os.path.join(base, rel), "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                h.update(chunk)
+                total += len(chunk)
+    return h.hexdigest(), total
+
+
+def write_checksum(path: str) -> str:
+    """Commit the integrity sidecar for an already-committed checkpoint
+    (atomic, like the checkpoint itself)."""
+    files = _checkpoint_files(path)
+    digest, nbytes = _digest_files(path, files)
+    sc = checksum_path(path)
+    tmp = sc + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"algo": "sha256", "digest": digest, "bytes": nbytes,
+                   "files": files}, f)
+    os.replace(tmp, sc)
+    return sc
+
+
+def verify_checkpoint(path: str) -> Tuple[bool, str]:
+    """(ok, reason). Missing sidecar passes (pre-integrity checkpoints
+    stay restorable — the decode itself still guards them); a present
+    sidecar must match exactly: every listed file present with the
+    committed combined digest."""
+    sc = checksum_path(path)
+    if not os.path.isfile(sc):
+        return True, "no checksum sidecar (pre-integrity checkpoint)"
+    try:
+        with open(sc) as f:
+            want = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"unreadable checksum sidecar: {e!r}"
+    base = path if os.path.isdir(path) else os.path.dirname(path)
+    rel_files = want.get("files") or []
+    missing = [r for r in rel_files
+               if not os.path.isfile(os.path.join(base, r))]
+    if missing:
+        return False, f"missing checkpoint files {missing}"
+    try:
+        digest, nbytes = _digest_files(path, rel_files)
+    except OSError as e:
+        return False, f"unreadable checkpoint file: {e!r}"
+    if digest != want.get("digest"):
+        return False, (f"checksum mismatch (have {nbytes} bytes, "
+                       f"sidecar recorded {want.get('bytes')})")
+    return True, "verified"
 
 
 def fetch_to_host(state: Any) -> Any:
@@ -60,7 +149,8 @@ def fetch_to_host(state: Any) -> Any:
 
 
 def save_checkpoint(ckpt_dir: str, state: Any, step: int,
-                    keep: int = 3, fmt: str = "msgpack") -> str:
+                    keep: int = 3, fmt: str = "msgpack",
+                    logger=None) -> str:
     """Fetch (collective-safe) + atomically write ``ckpt_<step>.<fmt>``.
 
     ``fmt='sharded'`` skips the full-state gather entirely: every
@@ -73,10 +163,10 @@ def save_checkpoint(ckpt_dir: str, state: Any, step: int,
         path = _ckpt_path(ckpt_dir, step, fmt)
         sharded_lib.save_sharded(path, state)
         if jax.process_index() == 0:
-            _finalize_checkpoint(ckpt_dir, path, keep)
+            _finalize_checkpoint(ckpt_dir, path, keep, logger=logger)
         return path
     return _write_checkpoint(ckpt_dir, fetch_to_host(state), step, keep,
-                             fmt=fmt)
+                             fmt=fmt, logger=logger)
 
 
 def _check_orbax_single_process(fmt: str) -> None:
@@ -94,7 +184,8 @@ def _check_orbax_single_process(fmt: str) -> None:
 
 
 def _write_checkpoint(ckpt_dir: str, host_state: Any, step: int,
-                      keep: int, fmt: str = "msgpack") -> str:
+                      keep: int, fmt: str = "msgpack",
+                      logger=None) -> str:
     """Write an already-on-host state; prune to ``keep`` newest."""
     if fmt not in FORMATS:
         raise ValueError(f"unknown checkpoint format {fmt!r}; "
@@ -119,12 +210,18 @@ def _write_checkpoint(ckpt_dir: str, host_state: Any, step: int,
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, path)
-    _finalize_checkpoint(ckpt_dir, path, keep)
+    _finalize_checkpoint(ckpt_dir, path, keep, logger=logger)
     return path
 
 
-def _finalize_checkpoint(ckpt_dir: str, path: str, keep: int) -> None:
-    """Point the ``checkpoint`` index at ``path``; prune to ``keep``."""
+def _finalize_checkpoint(ckpt_dir: str, path: str, keep: int,
+                         logger=None) -> None:
+    """Commit the integrity sidecar, point the ``checkpoint`` index at
+    ``path``, prune to ``keep`` (checksum + data-state sidecars ride
+    along). A prune failure (disk full, permissions) is logged as a
+    ``ckpt_prune_error`` event instead of silently accumulating
+    checkpoints until the disk fills for real."""
+    write_checksum(path)
     with open(os.path.join(ckpt_dir, "checkpoint"), "w") as f:
         f.write(os.path.basename(path) + "\n")
     for old_step, old_fmt in sorted(_checkpoints(ckpt_dir))[:-keep]:
@@ -135,12 +232,17 @@ def _finalize_checkpoint(ckpt_dir: str, path: str, keep: int) -> None:
                 shutil.rmtree(old)
             else:
                 os.remove(old)
-            sidecar = os.path.join(ckpt_dir,
-                                   f"data_state_{old_step}.json")
-            if os.path.isfile(sidecar):
-                os.remove(sidecar)
-        except OSError:
-            pass
+            for sidecar in (checksum_path(old),
+                            os.path.join(ckpt_dir,
+                                         f"data_state_{old_step}.json")):
+                if os.path.isfile(sidecar):
+                    os.remove(sidecar)
+        except OSError as e:
+            print(f"[ckpt] retention prune of {old} failed: {e!r} — "
+                  f"old checkpoints are accumulating", file=sys.stderr)
+            if logger is not None:
+                logger.log("ckpt_prune_error", step=old_step, path=old,
+                           error=repr(e))
 
 
 def save_data_state(ckpt_dir: str, step: int, counts: dict) -> None:
@@ -203,14 +305,11 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
     return _ckpt_path(ckpt_dir, step, fmt)
 
 
-def restore_checkpoint(ckpt_dir: str, target: Any,
-                       sharding=None) -> Any:
-    """Restore the latest checkpoint into ``target``'s structure, or return
-    ``target`` unchanged if none exists. ``sharding`` (e.g. a replicated
-    NamedSharding) places the restored arrays back on the mesh."""
-    path = latest_checkpoint(ckpt_dir)
-    if path is None:
-        return target
+def _restore_one(path: str, target: Any, host_target: Any,
+                 sharding=None) -> Any:
+    """Restore ONE specific checkpoint into ``target``'s structure;
+    raises ValueError (with the standard classified message) on a
+    config mismatch or corrupt bytes."""
     if path.endswith(".sharded"):
         from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
 
@@ -219,11 +318,14 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
         # shard files — an allgather of the about-to-be-overwritten
         # values would be exactly the O(full-state) cost this codec
         # exists to avoid.
-        restored = sharded_lib.restore_sharded(path, target)
+        try:
+            restored = sharded_lib.restore_sharded(path, target)
+        except ValueError as e:
+            raise ValueError(
+                f"failed to restore checkpoint {path}: {e}") from e
         if sharding is not None:
             restored = jax.device_put(restored, sharding)
         return restored
-    host_target = fetch_to_host(target)
     try:
         if path.endswith(".orbax"):
             import orbax.checkpoint as ocp
@@ -249,6 +351,62 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
     return restored
 
 
+def restore_checkpoint(ckpt_dir: str, target: Any,
+                       sharding=None, on_fallback=None) -> Any:
+    """Restore the newest VERIFIABLE checkpoint into ``target``'s
+    structure, or return ``target`` unchanged if none exists.
+
+    Candidates are walked newest→oldest: one that fails its integrity
+    sidecar (``verify_checkpoint``) or fails to decode is skipped with a
+    warning (and ``on_fallback(step, path, reason)`` when given — the
+    Trainer logs a ``ckpt_fallback`` JSONL record) and the next older
+    checkpoint is tried, so a corrupt/truncated latest degrades a
+    restart by one checkpoint interval instead of killing it. When
+    nothing restores, the newest candidate's error is raised (integrity
+    failures everywhere raise a summary naming every skip).
+
+    ``sharding`` (e.g. a replicated NamedSharding) places the restored
+    arrays back on the mesh.
+    """
+    candidates = sorted(_checkpoints(ckpt_dir), reverse=True)
+    if not candidates:
+        return target
+    host_target = None
+    first_error: Optional[ValueError] = None
+    skipped = []
+
+    def note(step, path, reason):
+        print(f"[ckpt] skipping checkpoint {path}: {reason}; falling "
+              f"back to an older checkpoint", file=sys.stderr)
+        skipped.append(f"{os.path.basename(path)}: {reason}")
+        if on_fallback is not None:
+            on_fallback(step, path, reason)
+
+    for step, fmt in candidates:
+        path = _ckpt_path(ckpt_dir, step, fmt)
+        ok, reason = verify_checkpoint(path)
+        if not ok:
+            note(step, path, reason)
+            continue
+        if host_target is None and fmt != "sharded":
+            # Collective-safe fetch, computed once across the walk.
+            host_target = fetch_to_host(target)
+        try:
+            return _restore_one(path, target, host_target,
+                                sharding=sharding)
+        except ValueError as e:
+            if first_error is None:
+                first_error = e
+            note(step, path, str(e))
+            continue
+    if first_error is not None:
+        raise first_error
+    raise ValueError(
+        f"no restorable checkpoint in {ckpt_dir}: all "
+        f"{len(candidates)} candidates failed integrity verification "
+        f"({'; '.join(skipped)})")
+
+
 class CheckpointManager:
     """Periodic chief-only saver (the CheckpointSaverHook role).
 
@@ -263,11 +421,14 @@ class CheckpointManager:
     def __init__(self, ckpt_dir: str, every_steps: int, keep: int = 3,
                  is_chief: Optional[bool] = None, async_save: bool = False,
                  every_secs: Optional[float] = None,
-                 fmt: str = "msgpack"):
+                 fmt: str = "msgpack", logger=None):
         self.ckpt_dir = ckpt_dir
         self.every_steps = max(1, every_steps)
         self.keep = keep
         self.fmt = fmt
+        # Optional MetricsLogger-shaped sink for checkpoint-maintenance
+        # events (ckpt_prune_error); the writer thread may call it.
+        self.logger = logger
         # Fail at construction, not at the first due save 500 steps in
         # (the write path re-checks for direct save_checkpoint callers).
         _check_orbax_single_process(fmt)
@@ -396,14 +557,16 @@ class CheckpointManager:
         from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
         sharded_lib.finish_sharded_save(path, payload, state)
         if self.is_chief:
-            _finalize_checkpoint(self.ckpt_dir, path, self.keep)
+            _finalize_checkpoint(self.ckpt_dir, path, self.keep,
+                                 logger=self.logger)
             if data_state is not None:
                 save_data_state(self.ckpt_dir, step, data_state)
 
     def _write_with_sidecar(self, host_state: Any, step: int,
                             data_state: Optional[dict]) -> str:
         path = _write_checkpoint(self.ckpt_dir, host_state, step,
-                                 keep=self.keep, fmt=self.fmt)
+                                 keep=self.keep, fmt=self.fmt,
+                                 logger=self.logger)
         if data_state is not None:
             save_data_state(self.ckpt_dir, step, data_state)
         return path

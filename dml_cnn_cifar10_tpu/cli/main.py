@@ -116,6 +116,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="HTTP port for --mode serve (0 = ephemeral)")
     p.add_argument("--serve_metrics_every_s", type=float, default=5.0,
                    help="cadence of `serve` JSONL window records")
+    p.add_argument("--serve_drain_deadline_s", type=float, default=5.0,
+                   help="graceful-shutdown budget for --mode serve: on "
+                        "SIGTERM/SIGINT stop accepting, let queued "
+                        "batches finish for at most this long, shed the "
+                        "rest, flush metrics, exit 0")
     p.add_argument("--learning_rate", type=float, default=0.1)
     p.add_argument("--fidelity", type=str, default="faithful",
                    choices=["faithful", "fixed"],
@@ -280,6 +285,43 @@ def build_parser() -> argparse.ArgumentParser:
                    help="halt at the next metrics boundary on non-finite "
                         "loss without checkpointing the poisoned state "
                         "(faithful parity runs NaN by design — keep off)")
+    p.add_argument("--on_nonfinite", type=str, default="halt",
+                   choices=["halt", "skip", "rollback"],
+                   help="what a --check_numerics detection does: halt "
+                        "raises without saving; skip discards the "
+                        "updates since the last finite boundary and "
+                        "keeps training; rollback raises a classified "
+                        "failure the --supervise loop answers by "
+                        "restoring the last good checkpoint (optionally "
+                        "scaling LR by --rollback_lr_scale). skip/"
+                        "rollback degrade to halt when the "
+                        "--recovery_retries budget is exhausted "
+                        "(docs/RESILIENCE.md)")
+    p.add_argument("--supervise", type="bool", default=False,
+                   help="wrap training in the recovery supervisor: "
+                        "classified recoverable failures (non-finite "
+                        "loss under rollback, data-pipeline errors, "
+                        "checkpoint-restore errors) restore the last "
+                        "verifiable checkpoint, rewind the exact-resume "
+                        "data state, back off, and resume")
+    p.add_argument("--recovery_retries", type=int, default=3,
+                   help="shared recovery budget: max on_nonfinite=skip "
+                        "events per run AND max supervisor restarts; "
+                        "exhausted degrades to halt")
+    p.add_argument("--recovery_backoff_s", type=float, default=0.5,
+                   help="supervisor restart backoff base (doubles per "
+                        "attempt, capped at 30s)")
+    p.add_argument("--rollback_lr_scale", type=float, default=1.0,
+                   help="LR multiplier applied at each supervisor "
+                        "rollback of a non-finite failure (1.0 = keep "
+                        "LR; a deterministically diverging run replayed "
+                        "at the same LR diverges again)")
+    p.add_argument("--fault_spec", type=str, default=None,
+                   help="deterministic fault injection for recovery "
+                        "drills: comma-separated kind@step with kinds "
+                        "nan, ckpt_corrupt, sigterm, data_stall — each "
+                        "fires once at the first dispatch at/after its "
+                        "global step (utils/faults.py)")
     p.add_argument("--preempt_sync_every", type=int, default=10,
                    help="steps between multi-host preemption/clock-save "
                         "agreement allgathers (single-process reacts "
@@ -330,6 +372,12 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         peak_tflops=args.peak_tflops,
         preempt_sync_every=args.preempt_sync_every,
         check_numerics=args.check_numerics,
+        on_nonfinite=args.on_nonfinite,
+        supervise=args.supervise,
+        recovery_retries=args.recovery_retries,
+        recovery_backoff_s=args.recovery_backoff_s,
+        rollback_lr_scale=args.rollback_lr_scale,
+        fault_spec=args.fault_spec,
         ckpt_format=args.ckpt_format,
         tensorboard_dir=args.tensorboard_dir,
         profile_dir=args.profile_dir,
@@ -437,6 +485,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.serve.port = args.serve_port
     cfg.serve.artifact_path = args.serve_artifact
     cfg.serve.metrics_every_s = args.serve_metrics_every_s
+    cfg.serve.drain_deadline_s = args.serve_drain_deadline_s
     return cfg
 
 
@@ -524,7 +573,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from dml_cnn_cifar10_tpu.serve.server import main_serve
         return main_serve(cfg, task_index=args.task_index)
 
-    result = Trainer(cfg, task_index=args.task_index).fit()
+    if cfg.supervise:
+        from dml_cnn_cifar10_tpu.train.supervisor import fit_supervised
+        result = fit_supervised(cfg, task_index=args.task_index)
+    else:
+        result = Trainer(cfg, task_index=args.task_index).fit()
     print(f"[cli] done at step {result.final_step}; "
           f"{result.images_per_sec:.1f} images/sec")
     return 0

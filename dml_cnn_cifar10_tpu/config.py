@@ -327,6 +327,11 @@ class ServeConfig:
     artifact_path: Optional[str] = None
     # Cadence of `serve` JSONL window records while the server runs.
     metrics_every_s: float = 5.0
+    # Graceful-shutdown budget: on SIGTERM/SIGINT the server stops
+    # accepting, lets already-queued batches finish for at most this
+    # long, sheds the remainder, flushes metrics, and exits 0
+    # (serve/server.py; reuses PreemptionGuard).
+    drain_deadline_s: float = 5.0
 
 
 @dataclasses.dataclass
@@ -392,6 +397,37 @@ class TrainConfig:
     # (LR 0.1 on raw 0-255 pixels) and must keep running like the
     # reference does.
     check_numerics: bool = False
+    # What a check_numerics detection DOES (docs/RESILIENCE.md):
+    # "halt" raises without checkpointing the poisoned state (the
+    # original behavior); "skip" discards every update since the last
+    # finite metrics boundary (a device-side snapshot kept at each
+    # finite boundary) and keeps training forward; "rollback" raises a
+    # classified failure the run supervisor (train/supervisor.py)
+    # answers by restoring the last good checkpoint, rewinding the
+    # exact-resume data state, and retrying with backoff. skip and
+    # rollback share the recovery_retries budget and degrade to halt
+    # when it is exhausted.
+    on_nonfinite: str = "halt"            # halt | skip | rollback
+    # Shared recovery budget: max skip events inside one fit() AND max
+    # supervisor restart attempts across a run. Exhausted => halt.
+    recovery_retries: int = 3
+    # Supervisor restart backoff: base * 2^(attempt-1), capped.
+    recovery_backoff_s: float = 0.5
+    recovery_backoff_max_s: float = 30.0
+    # LR multiplier applied at each supervisor rollback of a non-finite
+    # failure (1.0 = keep the configured LR). A deterministically
+    # diverging run needs the step size reduced, not just replayed.
+    rollback_lr_scale: float = 1.0
+    # Deterministic fault injection (utils/faults.py):
+    # "kind@step,..." with kinds nan | ckpt_corrupt | sigterm |
+    # data_stall — each fires once at the first dispatch seam at/after
+    # its step. Test/drill tooling; None disables.
+    fault_spec: Optional[str] = None
+    # Wrap fit() in the run supervisor (train/supervisor.py): classified
+    # recoverable failures restore the last verified checkpoint and
+    # resume instead of killing the run. Per-process scope — multi-host
+    # whole-job restarts stay the scheduler's job.
+    supervise: bool = False
     metrics_jsonl: Optional[str] = None   # structured metrics sink
     # Run-health telemetry (utils/telemetry.py): host-loop span tracing
     # (compile, data wait, dispatch, drain, eval, checkpoint, preemption

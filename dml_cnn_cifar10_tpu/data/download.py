@@ -11,10 +11,13 @@ that writes files in the exact CIFAR binary layout so every downstream stage
 
 from __future__ import annotations
 
+import hashlib
 import os
 import tarfile
+import time
+import urllib.error
 import urllib.request
-from typing import List
+from typing import List, Optional
 
 import numpy as np
 
@@ -22,6 +25,26 @@ from dml_cnn_cifar10_tpu.config import DataConfig
 
 CIFAR10_URL = "http://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz"
 CIFAR100_URL = "http://www.cs.toronto.edu/~kriz/cifar-100-binary.tar.gz"
+# Published size/md5 of the archives — verified BEFORE extraction so a
+# truncated or tampered download is caught at the byte layer instead of
+# surfacing later as a record-framing decode error mid-training.
+KNOWN_ARCHIVES = {
+    CIFAR10_URL: {"bytes": 170052171,
+                  "md5": "c32a1d4ab5d03f1284b67883e8d87530"},
+    CIFAR100_URL: {"bytes": 169001437,
+                   "md5": "03b5dce01913d631647c71ecec9e9cb8"},
+}
+
+
+class DownloadError(RuntimeError):
+    """Dataset acquisition failed after bounded retries. ``fault`` names
+    the class — ``"network"`` (unreachable/timeout) or ``"integrity"``
+    (bad size/checksum/archive) — so ``ensure_dataset`` can report WHY
+    it degraded to synthetic data."""
+
+    def __init__(self, fault: str, msg: str):
+        super().__init__(msg)
+        self.fault = fault
 CIFAR10_FOLDER = "cifar-10-batches-bin"   # extract_folder (cifar10cnn.py:27)
 CIFAR100_FOLDER = "cifar-100-binary"
 # ImageNet-shaped synthetic rung (BASELINE.json configs[3] — "ResNet-50 on
@@ -41,22 +64,103 @@ def _progress(url: str):
     return cb
 
 
-def download_and_extract(data_dir: str, url: str) -> str:
-    """Fetch + untar ``url`` into ``data_dir``.
+def _fetch(url: str, dest: str, timeout: float) -> None:
+    """One bounded-timeout download attempt, atomic (tmp + rename) so a
+    dropped connection can never leave a half tarball that a later run
+    would treat as already-downloaded (the reference's exact trap,
+    ``cifar10cnn.py:43-44``)."""
+    tmp = dest + ".tmp"
+    cb = _progress(url)
+    with urllib.request.urlopen(url, timeout=timeout) as r, \
+            open(tmp, "wb") as f:
+        total = int(r.headers.get("Content-Length") or 0)
+        block = 1 << 16
+        n = 0
+        while True:
+            chunk = r.read(block)
+            if not chunk:
+                break
+            f.write(chunk)
+            n += 1
+            cb(n, block, total)
+    print()
+    os.replace(tmp, dest)
+
+
+def _verify_archive(url: str, path: str) -> Optional[str]:
+    """Failure reason when ``path`` mismatches the published size/md5 of
+    ``url``; None when it matches (or the URL has no published record)."""
+    want = KNOWN_ARCHIVES.get(url)
+    if want is None:
+        return None
+    size = os.path.getsize(path)
+    if size != want["bytes"]:
+        return f"size {size} != expected {want['bytes']}"
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+    if h.hexdigest() != want["md5"]:
+        return f"md5 {h.hexdigest()} != expected {want['md5']}"
+    return None
+
+
+def download_and_extract(data_dir: str, url: str, retries: int = 3,
+                         timeout: float = 30.0,
+                         backoff_s: float = 1.0) -> str:
+    """Fetch + verify + untar ``url`` into ``data_dir``, with bounded
+    retry/backoff around the network and integrity steps.
 
     Unlike the reference (which skips extraction whenever the tarball exists,
     ``cifar10cnn.py:43-44`` — leaving a half-extracted dir broken forever),
     extraction re-runs whenever this is called: callers only call it when
-    the target .bin files are missing.
+    the target .bin files are missing. A tarball that fails its size/md5
+    check is deleted and re-fetched; exhausted retries raise a
+    classified :class:`DownloadError`.
     """
     os.makedirs(data_dir, exist_ok=True)
     data_file = os.path.join(data_dir, os.path.basename(url))
-    if not os.path.isfile(data_file):
-        data_file, _ = urllib.request.urlretrieve(url, data_file,
-                                                  _progress(url))
-        print()
-    tarfile.open(data_file, "r:gz").extractall(data_dir)
-    return data_dir
+    last: Optional[BaseException] = None
+    fault = "network"
+    for attempt in range(max(1, retries)):
+        if attempt:
+            time.sleep(min(backoff_s * 2 ** (attempt - 1), 30.0))
+        if not os.path.isfile(data_file):
+            try:
+                _fetch(url, data_file, timeout)
+            except (urllib.error.URLError, OSError) as e:
+                # URLError covers HTTP errors and DNS failures; OSError
+                # covers socket timeouts/resets. Anything else is a bug
+                # and propagates.
+                last, fault = e, "network"
+                print(f"\n[data] download attempt {attempt + 1}/"
+                      f"{retries} failed: {e!r}")
+                continue
+        bad = _verify_archive(url, data_file)
+        if bad:
+            last, fault = DownloadError("integrity", bad), "integrity"
+            print(f"[data] archive failed verification ({bad}); "
+                  f"deleting and re-fetching")
+            os.remove(data_file)
+            continue
+        try:
+            tarfile.open(data_file, "r:gz").extractall(data_dir)
+        except (tarfile.TarError, EOFError) as e:
+            # Undetectable-by-table corruption (unknown URL, or a stale
+            # pre-verification tarball): treat like an integrity failure
+            # and re-fetch.
+            last, fault = e, "integrity"
+            print(f"[data] extraction failed ({e!r}); deleting the "
+                  f"archive and re-fetching")
+            os.remove(data_file)
+            continue
+        return data_dir
+    raise DownloadError(
+        fault, f"failed to acquire {url} after {retries} attempts; "
+               f"last error: {last!r}") from last
 
 
 def train_files(cfg: DataConfig) -> List[str]:
@@ -175,7 +279,12 @@ def ensure_dataset(cfg: DataConfig) -> None:
     url = CIFAR100_URL if cfg.dataset == "cifar100" else CIFAR10_URL
     try:
         download_and_extract(cfg.data_dir, url)
-    except Exception as e:  # no network: degrade to synthetic with a warning
-        print(f"[data] download failed ({e!r}); generating synthetic "
-              f"CIFAR-format data instead")
+    except DownloadError as e:
+        # Only classified acquisition failures (network unreachable,
+        # integrity exhausted) degrade to synthetic data — and the
+        # warning names which class, so an air-gapped box and a
+        # corrupted mirror are distinguishable in the logs. Anything
+        # else (disk full, permission, a bug) propagates loudly.
+        print(f"[data] {e.fault} failure acquiring {url} ({e}); "
+              f"generating synthetic CIFAR-format data instead")
         generate_synthetic_dataset(cfg, seed=cfg.seed)

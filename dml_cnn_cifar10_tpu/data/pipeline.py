@@ -32,6 +32,14 @@ class Batch(NamedTuple):
     labels: np.ndarray  # [B] int32
 
 
+class DataPipelineError(RuntimeError):
+    """A failure raised while drawing the next batch/chunk. The training
+    loop wraps its data-seam exceptions in this so the run supervisor
+    (``train/supervisor.py``) can classify them as recoverable — restore
+    the last checkpoint, rebuild the pipeline, resume — instead of
+    treating an input hiccup like a model bug."""
+
+
 def _load_split(files: List[str], cfg: DataConfig):
     """Decode all shards once, as uint8 HWC (cast happens per batch)."""
     nlb = download.label_bytes(cfg)

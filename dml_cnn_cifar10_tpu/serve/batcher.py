@@ -140,8 +140,23 @@ class MicroBatcher:
                     req = self._q.get_nowait()
                 except queue.Empty:
                     break
+                self.metrics.record_shed("shutdown")
                 req.future.set_exception(ShedError("shutdown"))
         self._worker.join()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful-shutdown close: stop admitting, let already-queued
+        batches finish for at most ``timeout`` seconds, then shed
+        whatever is still waiting. Returns True when everything queued
+        completed inside the deadline. The queue hand-off is race-free:
+        each request is popped by exactly one side (worker dispatch or
+        the shed sweep), so no future resolves twice."""
+        self._stop.set()
+        self._worker.join(timeout)
+        if not self._worker.is_alive():
+            return True
+        self.close(drain=False)
+        return False
 
     def __enter__(self) -> "MicroBatcher":
         return self

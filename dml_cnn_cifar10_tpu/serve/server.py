@@ -128,12 +128,27 @@ def resolve_engine(cfg, task_index: int = 0) -> ServingEngine:
 
 
 def main_serve(cfg, task_index: int = 0,
-               ready_event: Optional[threading.Event] = None) -> int:
-    """Blocking serve loop (Ctrl-C to stop). ``ready_event`` is set once
-    the HTTP socket is listening and all buckets are compiled — the
-    hook tests and ``tools/loadgen.py --target`` use it to avoid racing
-    the warmup."""
+               ready_event: Optional[threading.Event] = None,
+               stop_event: Optional[threading.Event] = None) -> int:
+    """Blocking serve loop with graceful SIGTERM/SIGINT drain.
+
+    ``ready_event`` is set once the HTTP socket is listening and all
+    buckets are compiled — the hook tests and ``tools/loadgen.py
+    --target`` use it to avoid racing the warmup. ``stop_event``
+    requests the same graceful shutdown programmatically (tests, and
+    any caller not on the main thread, where the signal guard is a
+    no-op).
+
+    Shutdown sequence (the managed-pool preemption contract, reusing
+    :class:`~dml_cnn_cifar10_tpu.utils.preemption.PreemptionGuard`):
+    stop accepting connections, let already-queued batches finish for
+    at most ``serve.drain_deadline_s``, shed the remainder, flush the
+    final ``serve_done`` metrics record, exit 0.
+    """
+    import time
+
     from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+    from dml_cnn_cifar10_tpu.utils.preemption import PreemptionGuard
 
     serve_cfg = cfg.serve
     engine = resolve_engine(cfg, task_index)
@@ -155,19 +170,42 @@ def main_serve(cfg, task_index: int = 0,
                                  _make_handler(batcher, metrics))
     flusher = _MetricsFlusher(metrics, logger, serve_cfg.metrics_every_s)
     flusher.start()
-    print(f"[serve] listening on :{server.server_address[1]} "
-          f"(POST /predict, GET /stats, GET /healthz)")
-    if ready_event is not None:
-        ready_event.set()
+    # The accept loop runs on its own thread so the main thread can
+    # park on the shutdown signals (signal handlers only fire on the
+    # main thread — the exact reason PreemptionGuard exists).
+    accept = threading.Thread(target=server.serve_forever,
+                              name="serve-accept", daemon=True)
+    drained = True
     try:
-        server.serve_forever()
-    except KeyboardInterrupt:
-        pass
+        with PreemptionGuard() as guard:
+            accept.start()
+            print(f"[serve] listening on :{server.server_address[1]} "
+                  f"(POST /predict, GET /stats, GET /healthz)")
+            if ready_event is not None:
+                ready_event.set()
+            try:
+                while not guard.requested and (
+                        stop_event is None or not stop_event.is_set()):
+                    time.sleep(0.1)
+                why = (f"signal {guard.signum}" if guard.requested
+                       else "stop requested")
+            except KeyboardInterrupt:
+                why = "keyboard interrupt"
+            print(f"[serve] {why}: draining in-flight batches "
+                  f"(deadline {serve_cfg.drain_deadline_s:.1f}s)")
+            server.shutdown()          # stop accepting; accept loop exits
+            accept.join()
+            drained = batcher.drain(timeout=serve_cfg.drain_deadline_s)
     finally:
+        # In-flight handler threads have resolved futures by now (result
+        # or ShedError), so the close's thread-join is bounded.
         server.server_close()
         flusher.stop()
-        batcher.close()
+        if batcher._worker.is_alive():   # drain never ran (startup crash)
+            batcher.close()
         metrics.emit(logger, final=True)
         logger.flush()
         logger.close()
+    print(f"[serve] exiting cleanly "
+          f"({'drained' if drained else 'drain deadline hit; backlog shed'})")
     return 0

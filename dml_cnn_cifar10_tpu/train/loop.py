@@ -33,6 +33,7 @@ from dml_cnn_cifar10_tpu.data import pipeline as pipe
 from dml_cnn_cifar10_tpu.models.registry import get_model
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
+from dml_cnn_cifar10_tpu.utils import faults as faults_lib
 from dml_cnn_cifar10_tpu.utils import telemetry as telemetry_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
 from dml_cnn_cifar10_tpu.utils.preemption import PreemptionGuard
@@ -53,9 +54,19 @@ class TrainResult:
 
 
 class Trainer:
-    def __init__(self, cfg: TrainConfig, mesh=None, task_index: int = 0):
+    def __init__(self, cfg: TrainConfig, mesh=None, task_index: int = 0,
+                 fault_injector=None):
         self.cfg = cfg
         self.task_index = task_index
+        if cfg.on_nonfinite not in ("halt", "skip", "rollback"):
+            raise ValueError(
+                f"on_nonfinite={cfg.on_nonfinite!r} must be one of "
+                f"halt | skip | rollback")
+        # Deterministic fault injection (utils/faults.py). The supervisor
+        # passes ONE injector across restart attempts so fired events
+        # stay fired; a bare Trainer builds its own from the config.
+        self.faults = fault_injector if fault_injector is not None \
+            else faults_lib.FaultInjector.from_spec(cfg.fault_spec)
         self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(
             cfg.parallel)
         self.model_def = get_model(cfg.model.name)
@@ -116,8 +127,18 @@ class Trainer:
         state = step_lib.init_train_state(
             key, self.model_def, self.cfg.model, self.cfg.data,
             self.cfg.optim, self.mesh, state_sharding=sharding)
+
+        def note_fallback(step, path, reason):
+            # A skipped candidate during the newest-verifiable walk
+            # (ckpt/checkpoint.py) — surfaced in the JSONL stream so a
+            # restart that silently lost a checkpoint interval is
+            # visible after the fact.
+            self.logger.log("ckpt_fallback", step=step, path=path,
+                            error=str(reason))
+
         return ckpt_lib.restore_checkpoint(
-            self.cfg.log_dir, state, sharding=sharding)
+            self.cfg.log_dir, state, sharding=sharding,
+            on_fallback=note_fallback)
 
     def _placed(self, batch: pipe.Batch):
         return mesh_lib.shard_batch(
@@ -382,16 +403,61 @@ class Trainer:
         ckpt_mgr = ckpt_lib.CheckpointManager(
             cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints,
             async_save=cfg.async_checkpoint,
-            every_secs=cfg.checkpoint_every_secs, fmt=cfg.ckpt_format)
+            every_secs=cfg.checkpoint_every_secs, fmt=cfg.ckpt_format,
+            logger=self.logger)
         train_loss, test_accuracy = [], []
         last_metrics = None
+        # on_nonfinite="skip" keeps a device-side snapshot of the last
+        # known-finite state, refreshed at every finite metrics boundary;
+        # a detection restores it (discarding every update since) and
+        # training continues forward. A real buffer copy: step buffers
+        # are donated, so holding a reference alone would dangle.
+        keep_snapshot = cfg.check_numerics and cfg.on_nonfinite == "skip"
+        snapshot = _copy_state(state) if keep_snapshot else None
+        skips = {"n": 0}
 
-        def guarded_save(state, step, force=False):
+        def _nonfinite(loss, step):
+            """Apply the on_nonfinite policy to a detected non-finite
+            loss. halt — and an exhausted skip budget — raises via
+            ``_numerics_halt``; rollback logs the classified fault and
+            raises for the supervisor; skip returns a fresh copy of the
+            snapshot with the step counter advanced to ``step`` (the
+            updates are discarded but the steps still happened — data
+            consumption, cadences, and checkpoint naming key on it)."""
+            if cfg.on_nonfinite == "rollback":
+                self.logger.log("fault", step=step, fault="nonfinite",
+                                injected=False)
+                raise FloatingPointError(
+                    f"non-finite train loss ({loss}) at step {step}; "
+                    f"raising for supervisor rollback "
+                    f"(on_nonfinite=rollback)")
+            if cfg.on_nonfinite == "skip" and snapshot is not None \
+                    and skips["n"] < cfg.recovery_retries:
+                skips["n"] += 1
+                self.logger.log("fault", step=step, fault="nonfinite",
+                                injected=False)
+                self.logger.log("recovery", step=step, fault="nonfinite",
+                                action="skip", attempt=skips["n"])
+                print(f"[recover] non-finite loss at step {step}: "
+                      f"discarding updates since the last finite "
+                      f"boundary (skip {skips['n']}/"
+                      f"{cfg.recovery_retries})")
+                restored = _copy_state(snapshot)
+                opt = dict(restored.opt)
+                opt["step"] = restored.opt["step"] * 0 + step
+                return restored._replace(opt=opt)
+            _numerics_halt(loss, step)
+
+        def guarded_save(save_state, step, force=False):
             """ckpt_mgr.maybe_save, but under check_numerics no save may
             persist a non-finite state: the loss of the LAST dispatch is
             fetched (one round trip, only when a save is actually due)
-            and a poisoned state halts instead of overwriting the last
-            good checkpoint."""
+            and a poisoned state follows the on_nonfinite policy —
+            halt/rollback raise instead of overwriting the last good
+            checkpoint; skip discards the poisoned update and skips this
+            save (the next due boundary checkpoints the restored
+            state)."""
+            nonlocal state, last_metrics
             if not ckpt_mgr.due(step, force):
                 # Early out BEFORE opening the checkpoint span: due() is
                 # the manager's own save predicate, so a skipped boundary
@@ -401,7 +467,9 @@ class Trainer:
             if cfg.check_numerics and last_metrics is not None:
                 loss = float(jax.device_get(last_metrics["loss"]))
                 if not np.isfinite(loss):
-                    _numerics_halt(loss, step)
+                    state = _nonfinite(loss, step)
+                    last_metrics = None
+                    return False
             # Sidecar pairing the checkpoint with the streams' cumulative
             # consumption (counts identical on every process under SPMD
             # lockstep). The manager's writer commits it AFTER the
@@ -413,7 +481,7 @@ class Trainer:
                 "test": base_counts["test"] + consumed["test"],
             } if exact_ok else None
             with tracer.span("checkpoint", cat="checkpoint"):
-                return ckpt_mgr.maybe_save(state, step, force=force,
+                return ckpt_mgr.maybe_save(save_state, step, force=force,
                                            data_state=data_state)
 
         def _numerics_halt(loss, step):
@@ -453,9 +521,27 @@ class Trainer:
             with PreemptionGuard() as preempt, profile_trace(cfg.profile_dir):
                 while global_step < total_steps and not stop:
                     drained = False
+                    if self.faults is not None:
+                        # Deterministic fault injection at the host seam
+                        # (utils/faults.py): may poison the state, corrupt
+                        # the latest checkpoint on disk, deliver SIGTERM,
+                        # or raise an injected data stall.
+                        state = self.faults.step_hook(
+                            global_step, state, cfg.log_dir, self.logger)
                     first = probe_thread is None
                     with tracer.span("data_wait", cat="data"):
-                        batch = next(prefetch)
+                        try:
+                            batch = next(prefetch)
+                        except pipe.DataPipelineError:
+                            raise
+                        except Exception as e:
+                            # Classify the data seam: anything that dies
+                            # while drawing input is a pipeline failure
+                            # the supervisor may restart from the last
+                            # checkpoint, not a model bug.
+                            raise pipe.DataPipelineError(
+                                f"input pipeline failed at step "
+                                f"{global_step}: {e!r}") from e
                     if step_abs is None:
                         step_abs = abstractify((state, *batch))
                     # First call traces + compiles before it enqueues
@@ -646,11 +732,15 @@ class Trainer:
                                         **perf)
                         telemetry_lib.flush_boundary(tracer, self.logger,
                                                      global_step)
-                        if cfg.check_numerics and not np.isfinite(loss):
+                        if cfg.check_numerics:
                             # Loss is a replicated metric, so every
-                            # process raises on the same boundary — no
-                            # peer hangs.
-                            _numerics_halt(loss, global_step)
+                            # process takes the same branch on the same
+                            # boundary — no peer hangs.
+                            if not np.isfinite(loss):
+                                state = _nonfinite(loss, global_step)
+                                last_metrics = None
+                            elif keep_snapshot:
+                                snapshot = _copy_state(state)
                     if (i + k) % cfg.eval_every == 0:
                         with tracer.span("eval", cat="eval"):
                             ta = self.evaluate(state, test_it)
@@ -757,6 +847,14 @@ class Trainer:
         self._resident_acc_eval = None
         return TrainResult(global_step, train_loss, test_accuracy,
                            avg_rate, state, preempted=stop)
+
+
+def _copy_state(state):
+    """Independent buffer copy of a train state (same shardings): the
+    on_nonfinite="skip" snapshot must survive the donation of every
+    subsequent step's buffers, so a reference is not enough."""
+    return jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state)
 
 
 def _full_split_arrays(it, reload_fn):

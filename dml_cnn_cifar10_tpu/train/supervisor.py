@@ -1,0 +1,130 @@
+"""Run supervisor: a classified retry loop around ``Trainer.fit``.
+
+The reference's whole recovery story is "the scheduler restarts the
+worker and MonitoredTrainingSession restores the latest checkpoint"
+(SURVEY §5) — which under synchronous SPMD means any single failure is
+a whole-job failure (TF-Replicator, arXiv:1902.00465). The save half of
+that contract already exists here (atomic checkpoints, preemption
+guard, exact-resume data sidecars); this module is the recover half:
+instead of dying on the first recoverable failure and waiting for an
+external scheduler, the supervisor
+
+1. classifies the exception (:func:`classify_failure`) — non-finite
+   loss under ``on_nonfinite=rollback``, a data-pipeline failure, or a
+   checkpoint-restore failure are recoverable; anything else re-raises
+   unchanged (a genuine bug must stay loud);
+2. restores the last *verifiable* checkpoint (``restore_checkpoint``
+   walks past corrupt/truncated candidates via their integrity
+   sidecars) and rewinds the exact-resume data state, both of which
+   happen naturally inside the next ``fit`` attempt;
+3. applies bounded exponential backoff
+   (``recovery_backoff_s * 2^(attempt-1)``, capped at
+   ``recovery_backoff_max_s``) and retries, up to ``recovery_retries``
+   attempts — the budget exhausted degrades to halt (re-raise).
+
+Rollback of a non-finite loss may also scale the learning rate down
+(``rollback_lr_scale``): a deterministically diverging run replayed at
+the same LR diverges again; shrinking the step size is the classic
+operator move, now automated and logged as a ``rollback`` record.
+
+Scope: per-process. Under multi-host SPMD a peer that died takes the
+collectives with it — whole-job restart remains the scheduler's job;
+this supervisor makes the single-process (and the restarted-job) path
+self-healing and, via ``--fault_spec`` (utils/faults.py), testable on
+CPU in tier-1.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+from dml_cnn_cifar10_tpu.config import TrainConfig
+from dml_cnn_cifar10_tpu.data.pipeline import DataPipelineError
+from dml_cnn_cifar10_tpu.utils import faults as faults_lib
+from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+
+#: Failure classes the supervisor may retry.
+RECOVERABLE_FAULTS = ("nonfinite", "data", "ckpt_restore")
+
+
+def classify_failure(exc: BaseException) -> Optional[str]:
+    """Name the recoverable failure class of ``exc``, or None.
+
+    - injected/real data-pipeline failures → ``"data"``
+    - non-finite loss (``FloatingPointError``) → ``"nonfinite"`` (only
+      actionable when ``on_nonfinite=rollback``; the caller checks)
+    - checkpoint-restore failures (the classified ``ValueError`` every
+      restore path raises) → ``"ckpt_restore"``
+    """
+    if isinstance(exc, (faults_lib.DataStallError, DataPipelineError)):
+        return "data"
+    if isinstance(exc, FloatingPointError):
+        return "nonfinite"
+    if isinstance(exc, ValueError) and "restore" in str(exc) \
+            and "checkpoint" in str(exc):
+        return "ckpt_restore"
+    return None
+
+
+def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
+                   task_index: int = 0):
+    """``Trainer.fit`` under the recovery supervisor; returns the final
+    :class:`TrainResult`. Unrecoverable failures — and recoverable ones
+    past the ``recovery_retries`` budget — re-raise unchanged."""
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+
+    # ONE injector across every attempt: fired faults stay fired, so a
+    # recovered run replaying the same steps does not re-injure itself.
+    injector = faults_lib.FaultInjector.from_spec(cfg.fault_spec)
+    logger = MetricsLogger(cfg.metrics_jsonl, task_index=task_index)
+    attempt = 0
+    try:
+        while True:
+            trainer = Trainer(cfg, task_index=task_index,
+                              fault_injector=injector)
+            try:
+                result = trainer.fit(total_steps)
+            except Exception as e:
+                fault = classify_failure(e)
+                if fault is None or attempt >= cfg.recovery_retries:
+                    raise
+                if fault == "nonfinite" and cfg.on_nonfinite != "rollback":
+                    # halt stays a halt; an exhausted skip budget
+                    # already degraded to halt inside the loop.
+                    raise
+                attempt += 1
+                steps = ckpt_lib.all_checkpoint_steps(cfg.log_dir)
+                restore_step = max(steps) if steps else 0
+                backoff = min(
+                    cfg.recovery_backoff_s * (2 ** (attempt - 1)),
+                    cfg.recovery_backoff_max_s)
+                logger.log("fault", step=restore_step, fault=fault,
+                           injected=False, error=str(e)[:300])
+                if fault == "nonfinite" and cfg.rollback_lr_scale != 1.0:
+                    cfg.optim.learning_rate *= cfg.rollback_lr_scale
+                if fault == "nonfinite":
+                    logger.log("rollback", step=restore_step,
+                               restore_step=restore_step,
+                               attempt=attempt,
+                               lr=cfg.optim.learning_rate)
+                logger.log("recovery", step=restore_step, fault=fault,
+                           action="restart", attempt=attempt,
+                           backoff_s=backoff)
+                print(f"[supervisor] recoverable {fault} failure "
+                      f"(attempt {attempt}/{cfg.recovery_retries}): "
+                      f"{e}; restoring from step {restore_step} after "
+                      f"{backoff:.2f}s backoff")
+                time.sleep(backoff)
+                continue
+            if attempt:
+                logger.log("recovery", step=result.final_step,
+                           fault="none", action="recovered",
+                           attempt=attempt)
+                print(f"[supervisor] recovered: reached step "
+                      f"{result.final_step} after {attempt} "
+                      f"restart(s)")
+            return result
+    finally:
+        logger.close()

@@ -1,0 +1,182 @@
+"""Deterministic fault injection for exercising recovery paths.
+
+The reference's fault story is untestable by construction: the only way
+to see MonitoredTrainingSession recover is to kill a real worker
+mid-run (SURVEY §5). Here every failure mode the resilience layer
+handles can be injected at an exact global step, on CPU, in tier-1 —
+``--fault_spec "nan@120,ckpt_corrupt@200,sigterm@300,data_stall@400"``
+fires each fault ONCE at the first host-loop seam where the global step
+reaches its trigger. The injector's fired-state survives supervisor
+restarts (``train/supervisor.py`` builds one injector and threads it
+through every attempt), so a recovered run does not re-injure itself
+replaying the same steps.
+
+Fault kinds:
+
+- ``nan`` — multiply one parameter leaf by NaN so the *real* forward/
+  backward produces a non-finite loss (the detection path is the
+  genuine ``check_numerics`` boundary fetch, not a mock).
+- ``ckpt_corrupt`` — truncate the newest committed checkpoint on disk
+  (a file codec loses its tail; a directory codec loses one member
+  file), leaving the checksum sidecar stale — exactly what a crashed
+  copy or bit rot looks like to ``restore_checkpoint``. Defers until a
+  checkpoint exists.
+- ``sigterm`` — deliver SIGTERM to this process, exercising
+  ``PreemptionGuard``'s finish-step/checkpoint/exit path.
+- ``data_stall`` — raise :class:`DataStallError` at the host-loop seam,
+  the stand-in for a wedged input pipeline; the supervisor classifies
+  it as a recoverable data failure.
+
+Every injection logs a ``fault`` JSONL record (``injected: true``) so
+recovery tooling can pair injections with the ``recovery`` records they
+provoke (``docs/RESILIENCE.md``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from typing import List, Optional
+
+FAULT_KINDS = ("nan", "ckpt_corrupt", "sigterm", "data_stall")
+
+
+class InjectedFault(RuntimeError):
+    """Base class for failures raised (not merely caused) by injection."""
+
+
+class DataStallError(InjectedFault):
+    """Injected stand-in for a wedged/failed input pipeline."""
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    kind: str
+    step: int
+    fired: bool = False
+
+
+def parse_fault_spec(spec: str) -> List[FaultEvent]:
+    """``"kind@step,kind@step,..."`` → ordered fault events.
+
+    Steps are global training steps; duplicate kinds are allowed (e.g.
+    ``nan@100,nan@200`` re-poisons after a recovery). Unknown kinds and
+    malformed entries fail loudly at parse time — a typo'd fault plan
+    that silently injects nothing would void the test it was written
+    for.
+    """
+    events = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind, sep, step_s = entry.partition("@")
+        kind = kind.strip()
+        if not sep or kind not in FAULT_KINDS:
+            raise ValueError(
+                f"bad fault spec entry {entry!r}: want kind@step with "
+                f"kind in {FAULT_KINDS}")
+        try:
+            step = int(step_s)
+        except ValueError:
+            raise ValueError(
+                f"bad fault spec entry {entry!r}: step {step_s!r} is "
+                f"not an integer") from None
+        if step < 0:
+            raise ValueError(f"bad fault spec entry {entry!r}: "
+                             f"negative step")
+        events.append(FaultEvent(kind, step))
+    return sorted(events, key=lambda e: (e.step, e.kind))
+
+
+def poison_state(state):
+    """Multiply the first parameter leaf by NaN, preserving structure,
+    dtype, and sharding — the subsequent (real) train step then yields a
+    non-finite loss through the genuine compute path."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(state.params)
+    if not leaves:
+        return state
+    leaves[0] = leaves[0] * jnp.asarray(float("nan"), leaves[0].dtype)
+    return state._replace(params=jax.tree.unflatten(treedef, leaves))
+
+
+def corrupt_latest_checkpoint(log_dir: str) -> Optional[str]:
+    """Truncate the newest committed checkpoint (file codecs) or one
+    member file (directory codecs). Returns the corrupted path, or None
+    when no checkpoint exists yet."""
+    from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+
+    path = ckpt_lib.latest_checkpoint(log_dir)
+    if path is None:
+        return None
+    victim = path
+    if os.path.isdir(path):
+        members = sorted(
+            os.path.join(path, n) for n in os.listdir(path)
+            if os.path.isfile(os.path.join(path, n))
+            and n != "MANIFEST.json")
+        if not members:  # nothing but the manifest — truncate that
+            members = [os.path.join(path, "MANIFEST.json")]
+        victim = members[0]
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.truncate(size // 2)
+    return path
+
+
+class FaultInjector:
+    """One-shot, step-keyed fault firing at the training loop's host
+    seam (``Trainer.fit`` calls :meth:`step_hook` once per dispatch).
+    Owned by the supervisor across restarts so fired events stay
+    fired."""
+
+    def __init__(self, events: List[FaultEvent]):
+        self.events = events
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str]) -> Optional["FaultInjector"]:
+        if not spec:
+            return None
+        return cls(parse_fault_spec(spec))
+
+    def pending(self) -> List[FaultEvent]:
+        return [e for e in self.events if not e.fired]
+
+    def _log(self, logger, step: int, kind: str, **extra) -> None:
+        if logger is not None:
+            logger.log("fault", step=step, fault=kind, injected=True,
+                       **extra)
+
+    def step_hook(self, step: int, state, log_dir: str, logger=None):
+        """Fire every due, unfired event; returns the (possibly
+        poisoned) state. ``ckpt_corrupt`` stays pending until a
+        checkpoint exists to corrupt. ``data_stall`` raises after
+        marking itself fired so a supervised restart does not re-raise
+        it."""
+        for ev in self.events:
+            if ev.fired or step < ev.step:
+                continue
+            if ev.kind == "nan":
+                ev.fired = True
+                state = poison_state(state)
+                self._log(logger, step, ev.kind)
+            elif ev.kind == "ckpt_corrupt":
+                path = corrupt_latest_checkpoint(log_dir)
+                if path is None:
+                    continue  # no checkpoint yet — stay pending
+                ev.fired = True
+                self._log(logger, step, ev.kind, path=path)
+            elif ev.kind == "sigterm":
+                ev.fired = True
+                self._log(logger, step, ev.kind)
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif ev.kind == "data_stall":
+                ev.fired = True
+                self._log(logger, step, ev.kind)
+                raise DataStallError(
+                    f"injected data stall at step {step}")
+        return state

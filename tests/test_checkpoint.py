@@ -49,6 +49,127 @@ def test_atomic_write_leaves_no_tmp(tmp_path):
     assert not any(n.endswith(".tmp") for n in os.listdir(tmp_path))
 
 
+# ---- integrity sidecars + newest-verifiable fallback restore ----
+
+def test_checksum_sidecar_committed_and_verifies(tmp_path):
+    path = ckpt_lib.save_checkpoint(str(tmp_path), _state(), step=7)
+    assert os.path.isfile(path + ".sha256")
+    ok, reason = ckpt_lib.verify_checkpoint(path)
+    assert ok and reason == "verified"
+
+
+def test_truncated_latest_falls_back_to_older(tmp_path):
+    s1, s2 = _state(seed=1), _state(seed=2)
+    ckpt_lib.save_checkpoint(str(tmp_path), s1, step=1)
+    p2 = ckpt_lib.save_checkpoint(str(tmp_path), s2, step=2)
+    with open(p2, "r+b") as f:
+        f.truncate(os.path.getsize(p2) // 2)
+    notes = []
+    restored = ckpt_lib.restore_checkpoint(
+        str(tmp_path), _state(seed=9),
+        on_fallback=lambda step, path, why: notes.append((step, why)))
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert notes and notes[0][0] == 2 and "mismatch" in notes[0][1]
+
+
+def test_same_size_bitflip_detected_and_skipped(tmp_path):
+    s1 = _state(seed=1)
+    ckpt_lib.save_checkpoint(str(tmp_path), s1, step=1)
+    p2 = ckpt_lib.save_checkpoint(str(tmp_path), _state(seed=2), step=2)
+    size = os.path.getsize(p2)
+    with open(p2, "r+b") as f:
+        f.seek(size // 2)
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    ok, reason = ckpt_lib.verify_checkpoint(p2)
+    assert not ok and "mismatch" in reason
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), _state(seed=9))
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["conv1"]["kernel"]),
+        np.asarray(s1.params["conv1"]["kernel"]))
+
+
+def test_missing_sidecar_is_back_compat(tmp_path):
+    """Pre-integrity checkpoints (no .sha256) still restore; a corrupt
+    one without a sidecar is caught by the decode and walked past."""
+    s1, s2 = _state(seed=1), _state(seed=2)
+    ckpt_lib.save_checkpoint(str(tmp_path), s1, step=1)
+    p2 = ckpt_lib.save_checkpoint(str(tmp_path), s2, step=2)
+    os.remove(p2 + ".sha256")
+    ok, reason = ckpt_lib.verify_checkpoint(p2)
+    assert ok and "no checksum sidecar" in reason
+    # Still restores the (intact) latest.
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), _state(seed=9))
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["conv1"]["kernel"]),
+        np.asarray(s2.params["conv1"]["kernel"]))
+    # Truncate it: no sidecar to catch it, but the msgpack decode fails
+    # and the walk still falls back to step 1.
+    with open(p2, "r+b") as f:
+        f.truncate(os.path.getsize(p2) // 2)
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), _state(seed=9))
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["conv1"]["kernel"]),
+        np.asarray(s1.params["conv1"]["kernel"]))
+
+
+def test_all_candidates_corrupt_raises(tmp_path):
+    path = ckpt_lib.save_checkpoint(str(tmp_path), _state(), step=1)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(ValueError, match="integrity"):
+        ckpt_lib.restore_checkpoint(str(tmp_path), _state(seed=2))
+
+
+def test_sharded_member_corruption_falls_back(tmp_path):
+    """Directory codec: a damaged manifest-listed shard file fails the
+    sidecar (stale EXTRA files stay inert — that contract is pinned by
+    test_sharded_stale_shard_files_are_inert) and restore walks back."""
+    s1 = _state(seed=1)
+    ckpt_lib.save_checkpoint(str(tmp_path), s1, step=1)
+    p2 = ckpt_lib.save_checkpoint(str(tmp_path), _state(seed=2), step=2,
+                                  fmt="sharded")
+    shard = os.path.join(p2, "shard_0.msgpack")
+    with open(shard, "r+b") as f:
+        f.truncate(os.path.getsize(shard) // 2)
+    ok, reason = ckpt_lib.verify_checkpoint(p2)
+    assert not ok
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), _state(seed=9))
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["conv1"]["kernel"]),
+        np.asarray(s1.params["conv1"]["kernel"]))
+
+
+def test_prune_failure_logged_not_swallowed(tmp_path, monkeypatch):
+    """Retention prune hitting an OSError must emit a ckpt_prune_error
+    event (and keep going) instead of silently accumulating."""
+    events = []
+
+    class FakeLogger:
+        def log(self, kind, **fields):
+            events.append((kind, fields))
+
+    real_remove = os.remove
+
+    def failing_remove(p):
+        if p.endswith(".msgpack"):
+            raise OSError("disk on fire")
+        real_remove(p)
+
+    mgr = ckpt_lib.CheckpointManager(str(tmp_path), every_steps=1,
+                                     keep=1, logger=FakeLogger())
+    state = _state()
+    mgr.maybe_save(state, 1)
+    monkeypatch.setattr(os, "remove", failing_remove)
+    mgr.maybe_save(state, 2)
+    kinds = [k for k, _ in events]
+    assert "ckpt_prune_error" in kinds
+    rec = dict(events[kinds.index("ckpt_prune_error")][1])
+    assert rec["step"] == 1 and "disk on fire" in rec["error"]
+
+
 def test_resume_continues_training_identically(tmp_path):
     """Train 4 steps straight vs train 2 + checkpoint + restore + 2 more:
     identical parameters (the MTS restart contract, cifar10cnn.py:222)."""

@@ -200,3 +200,121 @@ def test_imagenet_synth_wide_label_roundtrip(tmp_path):
     batch = next(it)
     assert batch.images.shape == (32, 12, 12, 3)
     assert 0 <= batch.labels.min() and batch.labels.max() < 1000
+
+
+# ---- hardened dataset acquisition (data/download.py) ----
+
+def _fake_targz(path, name="cifar-10-batches-bin/marker.txt"):
+    import io
+    import tarfile
+    with tarfile.open(path, "w:gz") as t:
+        data = b"payload"
+        info = tarfile.TarInfo(name)
+        info.size = len(data)
+        t.addfile(info, io.BytesIO(data))
+
+
+def test_download_retries_transient_network_failure(tmp_path, monkeypatch):
+    import os
+
+    from dml_cnn_cifar10_tpu.data import download
+
+    calls = {"n": 0}
+
+    def flaky_fetch(url, dest, timeout):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("connection reset")
+        _fake_targz(dest)
+
+    monkeypatch.setattr(download, "_fetch", flaky_fetch)
+    out = download.download_and_extract(
+        str(tmp_path), "http://example.invalid/fake.tar.gz",
+        retries=3, backoff_s=0.0)
+    assert calls["n"] == 3 and out == str(tmp_path)
+    assert os.path.isfile(os.path.join(
+        str(tmp_path), "cifar-10-batches-bin", "marker.txt"))
+
+
+def test_download_network_exhaustion_is_classified(tmp_path, monkeypatch):
+    from dml_cnn_cifar10_tpu.data import download
+
+    def dead_fetch(url, dest, timeout):
+        raise OSError("no route to host")
+
+    monkeypatch.setattr(download, "_fetch", dead_fetch)
+    with pytest.raises(download.DownloadError) as ei:
+        download.download_and_extract(
+            str(tmp_path), "http://example.invalid/f.tar.gz",
+            retries=2, backoff_s=0.0)
+    assert ei.value.fault == "network"
+
+
+def test_download_integrity_mismatch_deletes_and_classifies(
+        tmp_path, monkeypatch):
+    """An archive failing its published size/md5 is deleted and
+    re-fetched; persistent mismatch exhausts as an integrity fault."""
+    import os
+
+    from dml_cnn_cifar10_tpu.data import download
+
+    url = "http://example.invalid/archive.tar.gz"
+    monkeypatch.setattr(download, "KNOWN_ARCHIVES",
+                        {url: {"bytes": 3, "md5": "0" * 32}})
+    fetches = {"n": 0}
+
+    def fake_fetch(u, dest, timeout):
+        fetches["n"] += 1
+        _fake_targz(dest)
+
+    monkeypatch.setattr(download, "_fetch", fake_fetch)
+    with pytest.raises(download.DownloadError) as ei:
+        download.download_and_extract(str(tmp_path), url,
+                                      retries=2, backoff_s=0.0)
+    assert ei.value.fault == "integrity"
+    assert fetches["n"] == 2  # deleted + re-fetched each attempt
+    assert not os.path.isfile(os.path.join(str(tmp_path),
+                                           "archive.tar.gz"))
+
+
+def test_corrupt_tarball_refetched_then_integrity_fault(tmp_path,
+                                                        monkeypatch):
+    from dml_cnn_cifar10_tpu.data import download
+
+    def garbage_fetch(url, dest, timeout):
+        with open(dest, "wb") as f:
+            f.write(b"definitely not a tar.gz")
+
+    monkeypatch.setattr(download, "_fetch", garbage_fetch)
+    with pytest.raises(download.DownloadError) as ei:
+        download.download_and_extract(
+            str(tmp_path), "http://example.invalid/g.tar.gz",
+            retries=2, backoff_s=0.0)
+    assert ei.value.fault == "integrity"
+
+
+def test_ensure_dataset_degrades_only_on_classified_failure(
+        tmp_path, monkeypatch):
+    import os
+
+    from dml_cnn_cifar10_tpu.data import download
+
+    cfg = DataConfig(dataset="cifar10", data_dir=str(tmp_path / "a"),
+                     synthetic_train_records=64,
+                     synthetic_test_records=16)
+
+    def down(*a, **k):
+        raise download.DownloadError("network", "offline box")
+
+    monkeypatch.setattr(download, "download_and_extract", down)
+    download.ensure_dataset(cfg)  # degrades to synthetic, classified
+    assert all(os.path.isfile(p) for p in download.train_files(cfg))
+
+    cfg2 = DataConfig(dataset="cifar10", data_dir=str(tmp_path / "b"))
+
+    def boom(*a, **k):
+        raise RuntimeError("a genuine bug")
+
+    monkeypatch.setattr(download, "download_and_extract", boom)
+    with pytest.raises(RuntimeError, match="genuine bug"):
+        download.ensure_dataset(cfg2)  # bugs must NOT degrade silently

@@ -1,0 +1,305 @@
+"""Resilience layer: deterministic fault injection (utils/faults.py),
+the on_nonfinite policy (train/loop.py), and the recovery supervisor
+(train/supervisor.py). Every recovery path the framework claims runs
+here on CPU — the ISSUE-3 acceptance smoke injects a non-finite loss
+AND a corrupted latest checkpoint and requires the run to finish at the
+requested step with a schema-clean fault/recovery JSONL trail."""
+
+import json
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+from dml_cnn_cifar10_tpu.train.loop import Trainer
+from dml_cnn_cifar10_tpu.train.supervisor import (classify_failure,
+                                                  fit_supervised)
+from dml_cnn_cifar10_tpu.utils import faults as faults_lib
+from tests.conftest import tiny_train_cfg
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _resilient_cfg(data_cfg, tmpdir, total_steps=40):
+    cfg = tiny_train_cfg(data_cfg, tmpdir, total_steps=total_steps)
+    cfg.checkpoint_every = 10
+    cfg.output_every = 10
+    cfg.eval_every = 20
+    cfg.check_numerics = True
+    cfg.recovery_backoff_s = 0.01
+    cfg.metrics_jsonl = os.path.join(tmpdir, "m.jsonl")
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# fault-spec grammar + injector mechanics
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parsing():
+    inj = faults_lib.FaultInjector.from_spec(
+        "nan@120, ckpt_corrupt@200,sigterm@300,data_stall@400")
+    assert [(e.kind, e.step) for e in inj.events] == [
+        ("nan", 120), ("ckpt_corrupt", 200), ("sigterm", 300),
+        ("data_stall", 400)]
+    # Duplicates allowed (re-poison after a recovery), ordered by step.
+    inj2 = faults_lib.FaultInjector.from_spec("nan@50,nan@10")
+    assert [(e.kind, e.step) for e in inj2.events] == [("nan", 10),
+                                                      ("nan", 50)]
+    assert faults_lib.FaultInjector.from_spec(None) is None
+    assert faults_lib.FaultInjector.from_spec("") is None
+    for bad in ("bogus@10", "nan@x", "nan120", "nan@-3"):
+        with pytest.raises(ValueError):
+            faults_lib.parse_fault_spec(bad)
+
+
+def test_injector_fires_once_at_trigger():
+    from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig,
+                                            OptimConfig)
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+    state = step_lib.init_train_state(
+        jax.random.key(0), get_model("cnn"), ModelConfig(), DataConfig(),
+        OptimConfig())
+    inj = faults_lib.FaultInjector.from_spec("nan@10")
+    # Below the trigger: untouched, still pending.
+    s1 = inj.step_hook(9, state, log_dir="/nonexistent")
+    assert s1 is state and len(inj.pending()) == 1
+    # At the trigger: exactly one leaf poisoned, event consumed.
+    s2 = inj.step_hook(10, state, log_dir="/nonexistent")
+    leaves = jax.tree.leaves(s2.params)
+    assert any(not np.isfinite(np.asarray(x)).all() for x in leaves)
+    assert inj.pending() == []
+    # One-shot: a later step does not re-poison.
+    s3 = inj.step_hook(11, state, log_dir="/nonexistent")
+    assert s3 is state
+
+
+def test_ckpt_corrupt_defers_until_checkpoint_exists(tmp_path):
+    inj = faults_lib.FaultInjector.from_spec("ckpt_corrupt@1")
+    assert inj.step_hook(5, None, log_dir=str(tmp_path)) is None
+    assert len(inj.pending()) == 1  # nothing to corrupt yet
+
+    from dml_cnn_cifar10_tpu.config import (DataConfig, ModelConfig,
+                                            OptimConfig)
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
+    state = step_lib.init_train_state(
+        jax.random.key(0), get_model("cnn"), ModelConfig(), DataConfig(),
+        OptimConfig())
+    path = ckpt_lib.save_checkpoint(str(tmp_path), state, step=3)
+    inj.step_hook(6, None, log_dir=str(tmp_path))
+    assert inj.pending() == []
+    ok, reason = ckpt_lib.verify_checkpoint(path)
+    assert not ok and "mismatch" in reason
+
+
+def test_classify_failure():
+    from dml_cnn_cifar10_tpu.data.pipeline import DataPipelineError
+    assert classify_failure(faults_lib.DataStallError("x")) == "data"
+    assert classify_failure(DataPipelineError("x")) == "data"
+    assert classify_failure(FloatingPointError("nan")) == "nonfinite"
+    assert classify_failure(
+        ValueError("failed to restore checkpoint /x: bad")) \
+        == "ckpt_restore"
+    assert classify_failure(ValueError("something else")) is None
+    assert classify_failure(RuntimeError("boom")) is None
+
+
+# ---------------------------------------------------------------------------
+# the acceptance smoke: nan + ckpt_corrupt, supervised recovery
+# ---------------------------------------------------------------------------
+
+def test_supervisor_recovers_nan_and_corrupt_checkpoint(data_cfg,
+                                                        tmp_path):
+    """Inject a poisoned state at step 25 and corrupt the latest
+    checkpoint (step 20) at step 26. Under on_nonfinite=rollback the
+    boundary at 30 raises, the supervisor restores — walking past the
+    corrupt ckpt_20 to the verified ckpt_10 — rewinds the data streams,
+    and the run completes to the requested 40 steps with final params
+    BIT-IDENTICAL to a fault-free run (the exact-resume contract)."""
+    cfg = _resilient_cfg(data_cfg, str(tmp_path / "faulty"))
+    cfg.on_nonfinite = "rollback"
+    cfg.fault_spec = "nan@25,ckpt_corrupt@26"
+    result = fit_supervised(cfg)
+    assert result.final_step == 40
+
+    clean = _resilient_cfg(data_cfg, str(tmp_path / "clean"))
+    clean.metrics_jsonl = None
+    ref = Trainer(clean).fit()
+    for a, b in zip(jax.tree.leaves(result.state.params),
+                    jax.tree.leaves(ref.state.params)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(a)),
+                                      np.asarray(jax.device_get(b)))
+
+    recs = _read_jsonl(cfg.metrics_jsonl)
+    injected = {r["fault"] for r in recs
+                if r["kind"] == "fault" and r.get("injected")}
+    assert injected == {"nan", "ckpt_corrupt"}
+    detected = [r for r in recs
+                if r["kind"] == "fault" and not r.get("injected")]
+    assert any(r["fault"] == "nonfinite" for r in detected)
+    restarts = [r for r in recs if r["kind"] == "recovery"
+                and r["action"] == "restart"]
+    assert restarts and restarts[0]["fault"] == "nonfinite"
+    rollbacks = [r for r in recs if r["kind"] == "rollback"]
+    assert rollbacks and rollbacks[0]["restore_step"] == 20
+    # The corrupt ckpt_20 was skipped by the restore walk: fallback
+    # record names it, and training actually resumed from ckpt_10.
+    fallbacks = [r for r in recs if r["kind"] == "ckpt_fallback"]
+    assert any(r["step"] == 20 for r in fallbacks)
+    # The stream passes the documented-schema lint.
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []
+    # And the report CLI summarizes the recovery.
+    from tools import telemetry_report
+    out = telemetry_report.summarize(cfg.metrics_jsonl)
+    assert "resilience" in out and "restart" in out
+
+
+def test_supervisor_recovers_injected_data_stall(data_cfg, tmp_path):
+    cfg = _resilient_cfg(data_cfg, str(tmp_path), total_steps=30)
+    cfg.fault_spec = "data_stall@15"
+    result = fit_supervised(cfg)
+    assert result.final_step == 30
+    recs = _read_jsonl(cfg.metrics_jsonl)
+    restarts = [r for r in recs if r["kind"] == "recovery"
+                and r["action"] == "restart"]
+    assert restarts and restarts[0]["fault"] == "data"
+
+
+def test_supervisor_budget_exhaustion_reraises(data_cfg, tmp_path):
+    """Every recovery has a bounded budget: more stalls than retries
+    must surface the original failure, not loop forever."""
+    cfg = _resilient_cfg(data_cfg, str(tmp_path), total_steps=30)
+    cfg.recovery_retries = 1
+    cfg.fault_spec = "data_stall@5,data_stall@15"
+    with pytest.raises(faults_lib.DataStallError):
+        fit_supervised(cfg)
+
+
+def test_supervisor_does_not_retry_halt_policy(data_cfg, tmp_path):
+    """on_nonfinite=halt means halt even under the supervisor — the
+    policy flag, not the wrapper, decides."""
+    cfg = _resilient_cfg(data_cfg, str(tmp_path), total_steps=30)
+    cfg.on_nonfinite = "halt"
+    cfg.fault_spec = "nan@5"
+    with pytest.raises(FloatingPointError):
+        fit_supervised(cfg)
+
+
+# ---------------------------------------------------------------------------
+# on_nonfinite=skip inside one fit()
+# ---------------------------------------------------------------------------
+
+def test_on_nonfinite_skip_discards_update_and_continues(data_cfg,
+                                                         tmp_path):
+    cfg = _resilient_cfg(data_cfg, str(tmp_path))
+    cfg.on_nonfinite = "skip"
+    cfg.fault_spec = "nan@15"
+    result = Trainer(cfg).fit()
+    assert result.final_step == 40
+    # Final state is finite: the poisoned updates were discarded.
+    assert all(np.isfinite(np.asarray(jax.device_get(x))).all()
+               for x in jax.tree.leaves(result.state.params))
+    recs = _read_jsonl(cfg.metrics_jsonl)
+    skips = [r for r in recs if r["kind"] == "recovery"
+             and r["action"] == "skip"]
+    assert len(skips) == 1 and skips[0]["attempt"] == 1
+    # Boundaries after the skip are finite again.
+    trains = [r for r in recs if r["kind"] == "train"]
+    assert trains[-1]["loss"] is not None
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []
+
+
+def test_on_nonfinite_skip_budget_degrades_to_halt(data_cfg, tmp_path):
+    cfg = _resilient_cfg(data_cfg, str(tmp_path))
+    cfg.on_nonfinite = "skip"
+    cfg.recovery_retries = 1
+    cfg.fault_spec = "nan@3,nan@13"   # re-poison after the first skip
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        Trainer(cfg).fit()
+
+
+def test_bad_on_nonfinite_rejected(data_cfg, tmp_path):
+    cfg = tiny_train_cfg(data_cfg, str(tmp_path))
+    cfg.on_nonfinite = "explode"
+    with pytest.raises(ValueError, match="on_nonfinite"):
+        Trainer(cfg)
+
+
+# ---------------------------------------------------------------------------
+# sigterm injection → PreemptionGuard clean exit
+# ---------------------------------------------------------------------------
+
+def test_sigterm_fault_checkpoints_and_exits_cleanly(data_cfg, tmp_path):
+    before = signal.getsignal(signal.SIGTERM)
+    cfg = _resilient_cfg(data_cfg, str(tmp_path), total_steps=100)
+    cfg.fault_spec = "sigterm@12"
+    result = Trainer(cfg).fit()
+    assert result.preempted
+    assert 12 <= result.final_step < 100
+    # The forced preemption save landed and verifies.
+    steps = ckpt_lib.all_checkpoint_steps(cfg.log_dir)
+    assert result.final_step in steps
+    ok, _ = ckpt_lib.verify_checkpoint(
+        ckpt_lib.latest_checkpoint(cfg.log_dir))
+    assert ok
+    # Guard restored the previous handler on exit.
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+# ---------------------------------------------------------------------------
+# guarded_save: a due save must never persist a non-finite state
+# ---------------------------------------------------------------------------
+
+def test_guarded_save_refuses_to_persist_nonfinite_state(data_cfg,
+                                                         tmp_path):
+    """Checkpoint cadence fires between metrics boundaries while the
+    state is poisoned: the save-time numerics fetch must halt BEFORE
+    writing, leaving only pre-poison checkpoints on disk."""
+    cfg = _resilient_cfg(data_cfg, str(tmp_path), total_steps=20)
+    cfg.checkpoint_every = 5
+    cfg.fault_spec = "nan@11"       # poison after the step-10 save
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        Trainer(cfg).fit()
+    steps = sorted(ckpt_lib.all_checkpoint_steps(cfg.log_dir))
+    assert steps == [5, 10]         # the due step-15 save was refused
+    for s in steps:
+        ok, _ = ckpt_lib.verify_checkpoint(
+            os.path.join(cfg.log_dir, f"ckpt_{s}.msgpack"))
+        assert ok
+
+
+# ---------------------------------------------------------------------------
+# PreemptionGuard off the main thread (satellite)
+# ---------------------------------------------------------------------------
+
+def test_preemption_guard_is_noop_off_main_thread():
+    before = signal.getsignal(signal.SIGTERM)
+    out = {}
+
+    def run():
+        from dml_cnn_cifar10_tpu.utils.preemption import PreemptionGuard
+        guard = PreemptionGuard()
+        with guard:
+            out["saved"] = dict(guard._saved)
+            out["requested"] = guard.requested
+            out["handler_during"] = signal.getsignal(signal.SIGTERM)
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    assert out["saved"] == {}            # no handlers touched
+    assert out["requested"] is False
+    assert out["handler_during"] is before
+    assert signal.getsignal(signal.SIGTERM) is before

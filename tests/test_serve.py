@@ -275,3 +275,83 @@ def test_loadgen_closed_loop_smoke(tmp_path):
 
     from tools import check_jsonl_schema
     assert check_jsonl_schema.check_file(jsonl_path) == []
+
+
+# ---- graceful SIGTERM/stop drain (serve/server.py) ----
+
+def test_batcher_drain_completes_queued_work():
+    eng = StubEngine()
+    b = MicroBatcher(eng, buckets=(1, 4), max_queue_depth=64,
+                     batch_window_s=0.001)
+    futs = [b.submit(img) for img in _images(8)]
+    assert b.drain(timeout=5.0) is True
+    assert all(f.done() and f.exception() is None for f in futs)
+
+
+def test_batcher_drain_deadline_sheds_backlog():
+    """A backlog slower than the drain deadline: whatever completes in
+    time completes, the rest is shed with ShedError — never a future
+    left unresolved."""
+    eng = StubEngine(forward_s=0.25)
+    b = MicroBatcher(eng, buckets=(1,), max_queue_depth=64,
+                     batch_window_s=0.0)
+    futs = [b.submit(img) for img in _images(6)]
+    assert b.drain(timeout=0.3) is False
+    done_ok = sum(1 for f in futs if f.exception() is None)
+    shed = sum(1 for f in futs
+               if isinstance(f.exception(), ShedError))
+    assert done_ok >= 1 and shed >= 1
+    assert done_ok + shed == len(futs)
+
+
+def test_main_serve_graceful_stop_drains_and_flushes(tmp_path):
+    """The full --mode serve runtime shut down via its stop hook (the
+    same path a SIGTERM takes through PreemptionGuard): in-flight work
+    answered, final serve_done record flushed, exit code 0."""
+    import socket
+    import urllib.request
+
+    from dml_cnn_cifar10_tpu.config import TrainConfig
+    from dml_cnn_cifar10_tpu.serve.server import main_serve
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    cfg = TrainConfig(log_dir=str(tmp_path / "logs"),
+                      metrics_jsonl=str(tmp_path / "m.jsonl"))
+    cfg.model.logit_relu = False
+    cfg.serve.port = port
+    cfg.serve.buckets = (1, 4)
+    cfg.serve.metrics_every_s = 0.2
+    cfg.serve.drain_deadline_s = 5.0
+
+    ready, stop = threading.Event(), threading.Event()
+    rc = {}
+    t = threading.Thread(
+        target=lambda: rc.setdefault("rc", main_serve(
+            cfg, ready_event=ready, stop_event=stop)),
+        daemon=True)
+    t.start()
+    assert ready.wait(180), "server never became ready"
+
+    health = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=60).read())
+    img = np.zeros(tuple(health["image_shape"]), np.uint8).tobytes()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=img, method="POST")
+    resp = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert "class" in resp
+
+    stop.set()
+    t.join(120)
+    assert not t.is_alive(), "serve loop did not exit on stop"
+    assert rc["rc"] == 0
+
+    with open(cfg.metrics_jsonl) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    finals = [r for r in recs if r["kind"] == "serve_done"]
+    assert finals and finals[-1]["completed"] >= 1
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []

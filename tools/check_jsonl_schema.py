@@ -38,6 +38,18 @@ KIND_KEYS = {
     "done": ("step", "images_per_sec"),
     "preempt": ("step", "signum"),
     "numerics_halt": ("step",),
+    # Resilience layer (train/supervisor.py, utils/faults.py,
+    # ckpt/checkpoint.py; docs/RESILIENCE.md). `fault` records both
+    # injections (injected=true) and detections (injected=false);
+    # `recovery` records the action taken (skip/restart/recovered);
+    # `rollback` the supervisor's restore-point + LR decision;
+    # `ckpt_fallback` a checkpoint skipped by the newest-verifiable
+    # restore walk; `ckpt_prune_error` a retention prune that failed.
+    "fault": ("step", "fault", "injected"),
+    "recovery": ("step", "fault", "action", "attempt"),
+    "rollback": ("step", "restore_step", "attempt", "lr"),
+    "ckpt_fallback": ("step", "path", "error"),
+    "ckpt_prune_error": ("step", "path", "error"),
     # Serving runtime (serve/metrics.py; docs/SERVING.md). Percentile
     # values are null until the window has completions.
     "serve": ("requests", "completed", "shed_queue", "shed_deadline",

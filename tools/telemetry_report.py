@@ -151,6 +151,32 @@ def summarize(path: str) -> str:
             lines.append(
                 f"    {serve.get('batches')} batches, mean fill "
                 f"{100 * serve['batch_fill']:.1f} %")
+    # Resilience events (docs/RESILIENCE.md): how many faults the run
+    # absorbed, and what the recovery path did about them.
+    faults = [r for r in records if r.get("kind") == "fault"]
+    recoveries = [r for r in records if r.get("kind") == "recovery"]
+    fallbacks = [r for r in records if r.get("kind") == "ckpt_fallback"]
+    prune_errs = [r for r in records
+                  if r.get("kind") == "ckpt_prune_error"]
+    if faults or recoveries or fallbacks or prune_errs:
+        injected = sum(1 for r in faults if r.get("injected"))
+        lines.append(
+            f"  resilience: {len(faults)} fault(s) "
+            f"({injected} injected), {len(recoveries)} recovery "
+            f"action(s), {len(fallbacks)} checkpoint fallback(s)")
+        for r in recoveries:
+            lines.append(
+                f"    step {r.get('step')}: {r.get('fault')} -> "
+                f"{r.get('action')} (attempt {r.get('attempt')})")
+        rb = _last(records, "rollback")
+        if rb:
+            lines.append(
+                f"    last rollback restored step "
+                f"{rb.get('restore_step')} at lr {rb.get('lr')}")
+        if prune_errs:
+            lines.append(
+                f"    [{len(prune_errs)} checkpoint prune failure(s) — "
+                f"old checkpoints may be accumulating]")
     hbm = _last(records, "hbm")
     if hbm:
         if hbm.get("available"):
