@@ -322,6 +322,51 @@ def build_parser() -> argparse.ArgumentParser:
                         "nan, ckpt_corrupt, sigterm, data_stall — each "
                         "fires once at the first dispatch at/after its "
                         "global step (utils/faults.py)")
+    p.add_argument("--cluster_dir", type=str, default=None,
+                   help="shared directory arming the cluster-resilience "
+                        "layer (parallel/cluster.py): per-process "
+                        "heartbeats, a collective watchdog classifying "
+                        "straggler vs. hang/host-loss at each dispatch "
+                        "seam, and chief-recorded coordinated elastic "
+                        "restarts (docs/RESILIENCE.md). NFS/GCS-fuse in "
+                        "production, a tmpdir in the CPU simulation")
+    p.add_argument("--heartbeat_interval_s", type=float, default=0.5,
+                   help="background heartbeat cadence; beats publish "
+                        "from a daemon thread so a compiling/blocked "
+                        "host still looks alive")
+    p.add_argument("--straggler_after_s", type=float, default=2.0,
+                   help="dispatch-seam overrun after which the watchdog "
+                        "classifies peers (straggler telemetry for "
+                        "beating-but-behind peers)")
+    p.add_argument("--peer_dead_after_s", type=float, default=10.0,
+                   help="a peer whose newest heartbeat is older than "
+                        "this is declared lost: the run aborts "
+                        "deterministically (and elastically restarts "
+                        "under --supervise) instead of blocking in an "
+                        "XLA collective forever")
+    p.add_argument("--collective_timeout_s", type=float, default=120.0,
+                   help="armed-seam duration after which the watchdog "
+                        "presumes the main thread wedged inside a "
+                        "collective and aborts this process after "
+                        "logging (a loud corpse beats a silent hang)")
+    p.add_argument("--min_hosts", type=int, default=1,
+                   help="floor for coordinated elastic restarts: the "
+                        "chief halts instead of shrinking the world "
+                        "below this many surviving hosts")
+    p.add_argument("--cluster_lockstep", type="bool", default=False,
+                   help="simulation only: make the dispatch seam a "
+                        "software barrier over the heartbeat store so "
+                        "multi-process CPU runs without real "
+                        "collectives still block on (and recover from) "
+                        "a lost peer; real pods leave this off")
+    p.add_argument("--coordinator_timeout_s", type=float, default=60.0,
+                   help="per-attempt jax.distributed.initialize wait "
+                        "for the coordinator; a slow-to-start "
+                        "coordinator is retried with bounded backoff "
+                        "(--coordinator_retries), not crashed on")
+    p.add_argument("--coordinator_retries", type=int, default=3,
+                   help="bounded retry budget around the coordinator "
+                        "bootstrap")
     p.add_argument("--preempt_sync_every", type=int, default=10,
                    help="steps between multi-host preemption/clock-save "
                         "agreement allgathers (single-process reacts "
@@ -440,6 +485,15 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.parallel.model_axis = args.model_axis
     cfg.parallel.seq_axis = args.seq_axis
     cfg.parallel.pipe_axis = args.pipe_axis
+    cfg.parallel.cluster_dir = args.cluster_dir
+    cfg.parallel.heartbeat_interval_s = args.heartbeat_interval_s
+    cfg.parallel.straggler_after_s = args.straggler_after_s
+    cfg.parallel.peer_dead_after_s = args.peer_dead_after_s
+    cfg.parallel.collective_timeout_s = args.collective_timeout_s
+    cfg.parallel.min_hosts = args.min_hosts
+    cfg.parallel.cluster_lockstep = args.cluster_lockstep
+    cfg.parallel.coordinator_timeout_s = args.coordinator_timeout_s
+    cfg.parallel.coordinator_retries = args.coordinator_retries
     if args.pipe_microbatches and args.pipe_axis <= 1:
         # Silently measuring "plain dp" while believing it's an M=4P
         # schedule is exactly the trap the moe_experts guard below
@@ -486,6 +540,15 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.serve.artifact_path = args.serve_artifact
     cfg.serve.metrics_every_s = args.serve_metrics_every_s
     cfg.serve.drain_deadline_s = args.serve_drain_deadline_s
+    # The worker set also names the cluster-resilience world: process_id
+    # feeds chiefness (multihost.is_chief) and the heartbeat identity
+    # even when jax.distributed never initializes (the lockstep CPU
+    # simulation runs one independent JAX world per process).
+    workers = [h for h in args.worker_hosts.split(",") if h]
+    if len(workers) > 1:
+        cfg.parallel.coordinator_address = workers[0]
+        cfg.parallel.num_processes = len(workers)
+    cfg.parallel.process_id = args.task_index
     return cfg
 
 
@@ -506,7 +569,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     workers = [h for h in args.worker_hosts.split(",") if h]
-    if len(workers) > 1:
+    if len(workers) > 1 and not args.cluster_lockstep:
+        # Lockstep-simulation runs keep one independent JAX world per
+        # process (the cluster layer, not XLA, provides the barrier) —
+        # everything else bootstraps the real distributed runtime.
         from dml_cnn_cifar10_tpu.parallel import multihost
         multihost.initialize_from_hosts(workers, args.task_index)
 
@@ -576,6 +642,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cfg.supervise:
         from dml_cnn_cifar10_tpu.train.supervisor import fit_supervised
         result = fit_supervised(cfg, task_index=args.task_index)
+        if result is None:
+            # Fenced by a cluster restart decision (peers declared this
+            # process dead): a clean, saveless exit is the contract.
+            print("[cli] fenced by the cluster restart decision; "
+                  "exiting cleanly")
+            return 0
     else:
         result = Trainer(cfg, task_index=args.task_index).fit()
     print(f"[cli] done at step {result.final_step}; "

@@ -281,6 +281,43 @@ class ParallelConfig:
     coordinator_address: Optional[str] = None
     num_processes: int = 1
     process_id: int = 0
+    # Coordinator bootstrap hardening (parallel/multihost.py): how long
+    # one jax.distributed.initialize attempt may wait for the
+    # coordinator, and how many attempts (with the shared bounded
+    # exponential backoff, utils/backoff.py) before a slow-to-start
+    # coordinator becomes a real failure.
+    coordinator_timeout_s: float = 60.0
+    coordinator_retries: int = 3
+    # Cluster-resilience layer (parallel/cluster.py; docs/RESILIENCE.md
+    # multi-host section). cluster_dir enables it: a shared directory
+    # (NFS/GCS-fuse in production, a tmpdir in the CPU simulation)
+    # holding per-process heartbeat beats and the chief's restart
+    # decisions. None = layer off (the default; single-process runs
+    # don't need it).
+    cluster_dir: Optional[str] = None
+    # Background beat cadence. Beats publish from a daemon thread so a
+    # host that is merely compiling/blocked still looks ALIVE.
+    heartbeat_interval_s: float = 0.5
+    # Dispatch-seam overrun after which the watchdog starts classifying
+    # peers (straggler telemetry for peers beating-but-behind).
+    straggler_after_s: float = 2.0
+    # A peer whose newest beat is older than this is declared lost —
+    # the run aborts deterministically (PeerLostError) instead of
+    # blocking in an XLA collective forever.
+    peer_dead_after_s: float = 10.0
+    # Armed-seam duration after which the watchdog presumes the main
+    # thread is wedged inside a collective and aborts THIS process
+    # (os._exit) after logging — a loud corpse beats a silent hang.
+    collective_timeout_s: float = 120.0
+    # Coordinated elastic restart shrinks the world by the lost hosts;
+    # below this floor the chief halts instead of continuing degraded.
+    min_hosts: int = 1
+    # Simulation only: make the dispatch seam a software barrier over
+    # the heartbeat store (wait for every live peer to reach the local
+    # step) so multi-process CPU runs without real collectives still
+    # exercise straggler/hang/host-loss classification in lockstep.
+    # Real multi-host runs leave this off — XLA already enforces it.
+    cluster_lockstep: bool = False
     # Explicit shard_map + lax.psum step instead of jit auto-partitioning.
     explicit_collectives: bool = False
     # ZeRO/FSDP: shard params + optimizer moments over the ``data`` axis

@@ -1,0 +1,504 @@
+"""Cluster resilience: heartbeats, collective watchdog, coordinated restart.
+
+The reference's PS runtime survived worker churn because a dead worker
+only idled its own queue (``cifar10cnn.py:184-196``); the chief and the
+other workers kept optimizing. Synchronous SPMD inverts that failure
+mode: one hung or dead host stalls every XLA collective forever, with
+no error, no timeout, and no log line. This module is the missing
+liveness layer (what TF-Replicator calls out as the coordination half
+of the contract, arXiv:1902.00465):
+
+- :class:`HeartbeatStore` — a file-backed beat store (any shared
+  directory: NFS/GCS-fuse in production, a tmpdir in the CPU
+  simulation). Every process publishes ``{process_id, step, wallclock,
+  phase}`` via atomic rename; peers read without locks.
+- :class:`CollectiveWatchdog` — a daemon thread armed around each
+  dispatch seam. When the seam overruns ``straggler_after_s`` it reads
+  the peer beats and classifies: a peer still beating but behind is a
+  **straggler** (telemetry only — emit a ``straggler`` record naming
+  the lagging process); a peer whose beat is stale past
+  ``peer_dead_after_s`` is a **hang / host loss** (mark it dead so the
+  seam can abort deterministically instead of blocking in XLA). If the
+  main thread is genuinely wedged inside a collective past
+  ``collective_timeout_s``, the watchdog aborts the process itself
+  (``os._exit``) after logging — a loud corpse beats a silent hang.
+- :class:`RestartCoordinator` — the chief records a restart decision
+  ``{epoch, world_size, restore_step, survivors}`` (atomic rename);
+  surviving non-chiefs poll for it; a process excluded from the
+  survivor set fences itself (:class:`EvictedError`) instead of
+  rejoining a world that already gave up on it.
+- :class:`ClusterMonitor` — the per-process façade the Trainer and the
+  run supervisor use: background beat publisher, watchdog lifecycle,
+  seam hooks (``begin_step`` / ``sync`` / ``end_step``), and the
+  eviction check.
+
+Simulation: with ``cluster_lockstep=True`` the ``sync`` seam waits for
+every live peer's beat to reach the local step — a software stand-in
+for the XLA collective barrier — so a 2-process CPU run (each process
+its own single-process JAX world) exercises straggler detection, death
+classification, and the coordinated elastic restart end-to-end in
+tier-1 (``tests/test_cluster.py``). Real multi-host runs leave
+lockstep off: the collectives already enforce it, and the watchdog's
+job is only to observe and abort.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+#: Exit code of a watchdog abort (dead peer while blocked in a
+#: collective, or self-classified hang) — distinct from a crash so the
+#: scheduler can tell "fenced by the resilience layer" from "bug".
+EXIT_WATCHDOG_ABORT = 78
+
+
+class PeerLostError(RuntimeError):
+    """One or more peers' heartbeats went stale past
+    ``peer_dead_after_s`` — the run cannot continue at this world size.
+    Classified as recoverable by the supervisor (``peer_lost``)."""
+
+    def __init__(self, process_ids: Sequence[int], message: str):
+        super().__init__(message)
+        self.process_ids = sorted(process_ids)
+
+
+class EvictedError(RuntimeError):
+    """A restart decision excluded this process: the surviving world
+    declared it dead (stalled heartbeats look identical to a dead host
+    from outside). The only correct move is a clean, saveless exit —
+    rejoining would split-brain the run."""
+
+
+@dataclasses.dataclass
+class Beat:
+    process_id: int
+    step: int
+    wallclock: float
+    phase: str
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        return (now if now is not None else time.time()) - self.wallclock
+
+
+@dataclasses.dataclass
+class RestartDecision:
+    epoch: int
+    world_size: int
+    restore_step: int
+    survivors: List[int]
+
+
+class HeartbeatStore:
+    """Atomic-rename JSON beats under ``<cluster_dir>/heartbeats/``.
+
+    File-backed deliberately: the store must work where the collectives
+    do NOT (that is the whole point), must be inspectable post-mortem
+    with ``cat``, and must be simulatable on CPU without a network
+    stack. A socket/KV backend can replace it behind the same
+    publish/read API."""
+
+    def __init__(self, cluster_dir: str, process_id: int):
+        self.dir = os.path.join(cluster_dir, "heartbeats")
+        self.process_id = process_id
+        os.makedirs(self.dir, exist_ok=True)
+        self.started_at = time.time()
+
+    def _path(self, pid: int) -> str:
+        return os.path.join(self.dir, f"proc_{pid}.json")
+
+    def publish(self, step: int, phase: str) -> Beat:
+        beat = Beat(self.process_id, int(step), time.time(), phase)
+        tmp = self._path(self.process_id) + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(dataclasses.asdict(beat), f)
+        os.replace(tmp, self._path(self.process_id))
+        return beat
+
+    def read(self, pid: int) -> Optional[Beat]:
+        """The peer's latest beat, or None if it never published (a
+        torn read — mid-rename on exotic filesystems — reads as None
+        too and self-heals on the next poll)."""
+        try:
+            with open(self._path(pid)) as f:
+                return Beat(**json.load(f))
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def read_peers(self, expected: Sequence[int]) -> Dict[int, Optional[Beat]]:
+        return {pid: self.read(pid) for pid in expected
+                if pid != self.process_id}
+
+
+class RestartCoordinator:
+    """Chief-written, survivor-polled restart decisions.
+
+    The decision file is the cluster's only piece of mutable shared
+    truth, so it follows the checkpoint rules: written to a tmp name,
+    committed by atomic rename, monotone ``epoch`` so a stale decision
+    can never be mistaken for a new one."""
+
+    def __init__(self, cluster_dir: str):
+        self.path = os.path.join(cluster_dir, "restart_decision.json")
+        os.makedirs(cluster_dir, exist_ok=True)
+
+    def read(self) -> Optional[RestartDecision]:
+        try:
+            with open(self.path) as f:
+                return RestartDecision(**json.load(f))
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def record(self, decision: RestartDecision) -> RestartDecision:
+        prior = self.read()
+        if prior is not None and prior.epoch >= decision.epoch:
+            raise ValueError(
+                f"restart epoch must be monotone: have {prior.epoch}, "
+                f"recording {decision.epoch}")
+        tmp = self.path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(dataclasses.asdict(decision), f)
+        os.replace(tmp, self.path)
+        return decision
+
+    def await_decision(self, min_epoch: int, timeout_s: float,
+                       poll_s: float = 0.05) -> RestartDecision:
+        """Non-chief survivors block here until the chief commits a
+        decision at/after ``min_epoch``. A chief that never decides is
+        a coordinator loss: raise ``PeerLostError(chief)`` so the
+        caller fails deterministically instead of polling forever."""
+        deadline = time.time() + timeout_s
+        while True:
+            d = self.read()
+            if d is not None and d.epoch >= min_epoch:
+                return d
+            if time.time() > deadline:
+                raise PeerLostError(
+                    [0], f"no restart decision at epoch >= {min_epoch} "
+                         f"within {timeout_s:.1f}s — coordinator lost")
+            time.sleep(poll_s)
+
+
+class CollectiveWatchdog(threading.Thread):
+    """Deadline thread around the dispatch seam.
+
+    ``arm(step)`` starts the clock; ``disarm()`` stops it. While armed
+    past ``straggler_after_s`` the thread polls the beat store and
+    classifies each peer: stale past ``peer_dead_after_s`` → dead
+    (recorded in ``dead_peers``; the seam raises ``PeerLostError``
+    deterministically); beating but behind → ``straggler`` telemetry,
+    rate-limited per peer. Armed past ``collective_timeout_s`` the main
+    thread is presumed wedged inside XLA (a state Python cannot unwind)
+    and the watchdog aborts the process after logging — classification
+    ``peer_dead`` if a corpse was found, ``self_hang`` otherwise."""
+
+    def __init__(self, store: HeartbeatStore, monitor: "ClusterMonitor",
+                 straggler_after_s: float, peer_dead_after_s: float,
+                 collective_timeout_s: float, abort_fn=None):
+        super().__init__(daemon=True, name="collective-watchdog")
+        self.store = store
+        self.monitor = monitor
+        self.straggler_after_s = straggler_after_s
+        self.peer_dead_after_s = peer_dead_after_s
+        self.collective_timeout_s = collective_timeout_s
+        self.dead_peers: set = set()
+        self._abort_fn = abort_fn if abort_fn is not None else self._abort
+        self._armed_at: Optional[float] = None
+        self._armed_step = 0
+        self._stop_evt = threading.Event()
+        self._lock = threading.Lock()
+        self._last_straggle_log: Dict[int, float] = {}
+
+    def arm(self, step: int) -> None:
+        with self._lock:
+            self._armed_at = time.time()
+            self._armed_step = step
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed_at = None
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+
+    def _abort(self, verdict: str) -> None:  # pragma: no cover - os._exit
+        os._exit(EXIT_WATCHDOG_ABORT)
+
+    def check_peers(self, now: Optional[float] = None) -> None:
+        """One classification pass (also called directly by the seam's
+        sync wait, so detection does not depend on thread timing)."""
+        now = now if now is not None else time.time()
+        step = self._armed_step
+        for pid, beat in self.store.read_peers(self.monitor.live_set()).items():
+            if pid in self.dead_peers:
+                continue
+            # A peer that never published counts from the store's birth:
+            # a host that failed to even start is as dead as one that
+            # stopped.
+            age = beat.age_s(now) if beat is not None \
+                else now - self.store.started_at
+            if age > self.peer_dead_after_s:
+                self.dead_peers.add(pid)
+                self.monitor.log("peer_lost", step=step, process_id=pid,
+                                 reason="stale_heartbeat",
+                                 beat_age_s=round(age, 3))
+                print(f"[cluster] process {pid} heartbeat stale "
+                      f"{age:.1f}s > {self.peer_dead_after_s:.1f}s: "
+                      f"declaring host lost")
+            elif beat is not None and beat.step < step:
+                last = self._last_straggle_log.get(pid, 0.0)
+                if now - last >= self.straggler_after_s:
+                    self._last_straggle_log[pid] = now
+                    self.monitor.log("straggler", step=step,
+                                     process_id=pid,
+                                     behind_steps=step - beat.step,
+                                     beat_age_s=round(age, 3))
+
+    def run(self) -> None:
+        poll = max(0.02, min(self.straggler_after_s / 4, 0.25))
+        while not self._stop_evt.wait(poll):
+            with self._lock:
+                armed_at, step = self._armed_at, self._armed_step
+            if armed_at is None:
+                continue
+            now = time.time()
+            overrun = now - armed_at
+            if overrun < self.straggler_after_s:
+                continue
+            self.check_peers(now)
+            if overrun > self.collective_timeout_s:
+                # The seam did not come back: the main thread is blocked
+                # (a real XLA collective with a dead peer, or a wedged
+                # dispatch). raising in this thread cannot unwind it —
+                # abort deterministically.
+                verdict = "peer_dead" if self.dead_peers else "self_hang"
+                self.monitor.log(
+                    "peer_lost", step=step,
+                    process_id=self.store.process_id,
+                    reason=f"watchdog_abort_{verdict}",
+                    beat_age_s=round(overrun, 3))
+                print(f"[cluster] dispatch seam armed {overrun:.1f}s > "
+                      f"collective_timeout_s="
+                      f"{self.collective_timeout_s:.1f}; aborting "
+                      f"({verdict})")
+                self.monitor.flush()
+                self._abort_fn(verdict)
+                self.disarm()  # only reached when abort_fn is a test stub
+
+
+class ClusterMonitor:
+    """Per-process cluster-resilience runtime.
+
+    Owns the beat publisher thread (beats keep flowing while the main
+    thread compiles, blocks, or sleeps in backoff — a slow host must
+    look SLOW, not dead), the watchdog, and the restart coordinator.
+    Created once by the supervisor and threaded through every fit
+    attempt, like the fault injector, so epoch/world state survives
+    restarts."""
+
+    def __init__(self, cluster_dir: str, process_id: int,
+                 num_processes: int, heartbeat_interval_s: float = 0.5,
+                 straggler_after_s: float = 2.0,
+                 peer_dead_after_s: float = 10.0,
+                 collective_timeout_s: float = 120.0,
+                 min_hosts: int = 1, lockstep: bool = False,
+                 logger=None, abort_fn=None):
+        self.cluster_dir = cluster_dir
+        self.process_id = process_id
+        self.min_hosts = min_hosts
+        self.lockstep = lockstep
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.peer_dead_after_s = peer_dead_after_s
+        self._logger = logger
+        self._log_lock = threading.Lock()
+        self._survivors = list(range(num_processes))
+        self.epoch = 0
+        self._step = 0
+        self._phase = "init"
+        self._stalled = False
+        self._last_beat_log = 0.0
+        self.store = HeartbeatStore(cluster_dir, process_id)
+        self.coordinator = RestartCoordinator(cluster_dir)
+        self.watchdog = CollectiveWatchdog(
+            self.store, self, straggler_after_s, peer_dead_after_s,
+            collective_timeout_s, abort_fn=abort_fn)
+        self._stop = threading.Event()
+        self._publisher = threading.Thread(
+            target=self._publish_loop, daemon=True,
+            name="heartbeat-publisher")
+        self.store.publish(0, "init")
+        self._publisher.start()
+        self.watchdog.start()
+
+    @classmethod
+    def from_config(cls, parallel_cfg, logger=None,
+                    abort_fn=None) -> Optional["ClusterMonitor"]:
+        """None when the cluster layer is off (no ``cluster_dir``)."""
+        if not getattr(parallel_cfg, "cluster_dir", None):
+            return None
+        return cls(
+            parallel_cfg.cluster_dir, parallel_cfg.process_id,
+            max(parallel_cfg.num_processes, 1),
+            heartbeat_interval_s=parallel_cfg.heartbeat_interval_s,
+            straggler_after_s=parallel_cfg.straggler_after_s,
+            peer_dead_after_s=parallel_cfg.peer_dead_after_s,
+            collective_timeout_s=parallel_cfg.collective_timeout_s,
+            min_hosts=parallel_cfg.min_hosts,
+            lockstep=parallel_cfg.cluster_lockstep,
+            logger=logger, abort_fn=abort_fn)
+
+    # -- identity / world ------------------------------------------------
+
+    @property
+    def is_chief(self) -> bool:
+        """Lowest LIVE process id plays chief: when process 0 itself is
+        the lost host, the next survivor inherits the restart decision
+        (coordinator-loss handling, docs/RESILIENCE.md)."""
+        live = [p for p in self._survivors
+                if p not in self.watchdog.dead_peers]
+        return bool(live) and self.process_id == min(live)
+
+    def live_set(self) -> List[int]:
+        return list(self._survivors)
+
+    def world_size(self) -> int:
+        return len(self._survivors)
+
+    # -- logging (watchdog + publisher + seam threads share the sink) ---
+
+    def log(self, kind: str, **fields) -> None:
+        if self._logger is not None:
+            with self._log_lock:
+                self._logger.log(kind, **fields)
+
+    def flush(self) -> None:
+        if self._logger is not None and hasattr(self._logger, "flush"):
+            with self._log_lock:
+                self._logger.flush()
+
+    # -- heartbeat publishing -------------------------------------------
+
+    def _publish_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            if not self._stalled:
+                self.store.publish(self._step, self._phase)
+
+    def set_phase(self, phase: str) -> None:
+        self._phase = phase
+
+    def stall_heartbeats(self) -> None:
+        """Fault hook (``heartbeat_stall@N``): stop publishing while the
+        process keeps running — from outside, indistinguishable from a
+        dead host. The peers will declare this process lost; the
+        eviction check is how it finds out."""
+        self._stalled = True
+
+    # -- dispatch-seam hooks --------------------------------------------
+
+    def begin_step(self, step: int, phase: str = "train") -> None:
+        """Publish a beat, check for eviction, arm the watchdog. Raises
+        ``PeerLostError`` immediately when a peer was already declared
+        dead (detected while this process was off in eval/checkpoint)."""
+        self._step = step
+        self._phase = phase
+        if not self._stalled:
+            self.store.publish(step, phase)
+            now = time.time()
+            if now - self._last_beat_log >= self.heartbeat_interval_s:
+                self._last_beat_log = now
+                self.log("heartbeat", step=step,
+                         process_id=self.process_id, phase=phase)
+        self.check_evicted(step)
+        self.watchdog.arm(step)
+        self._raise_if_dead(step)
+
+    def sync(self, step: int, poll_s: float = 0.02) -> None:
+        """Simulated collective barrier (``cluster_lockstep``): wait for
+        every live peer's beat to reach ``step``. The wait is where a
+        2-process CPU simulation "blocks in the collective" — and where
+        the watchdog's classification frees it: a dead peer raises
+        ``PeerLostError``, an eviction raises ``EvictedError``."""
+        if not self.lockstep:
+            return
+        while True:
+            self._raise_if_dead(step)
+            self.check_evicted(step)
+            beats = self.store.read_peers(self.live_set())
+            if all(b is not None and b.step >= step
+                   for b in beats.values()):
+                return
+            self.watchdog.check_peers()
+            time.sleep(poll_s)
+
+    def end_step(self, step: int) -> None:
+        self._step = step
+        self.watchdog.disarm()
+
+    def _raise_if_dead(self, step: int) -> None:
+        dead = sorted(self.watchdog.dead_peers)
+        if dead:
+            self.watchdog.disarm()
+            raise PeerLostError(
+                dead, f"process(es) {dead} lost (heartbeats stale > "
+                      f"{self.peer_dead_after_s:.1f}s) at step {step}")
+
+    def check_evicted(self, step: int) -> None:
+        d = self.coordinator.read()
+        if d is not None and d.epoch > self.epoch \
+                and self.process_id not in d.survivors:
+            self.log("peer_lost", step=step, process_id=self.process_id,
+                     reason="evicted")
+            raise EvictedError(
+                f"restart epoch {d.epoch} excluded process "
+                f"{self.process_id} (survivors {d.survivors}); fencing")
+
+    # -- coordinated elastic restart ------------------------------------
+
+    def decide_restart(self, lost: Sequence[int],
+                       restore_step: int) -> RestartDecision:
+        """Chief half of the protocol: shrink the world by the lost
+        hosts and commit the decision survivors will poll. Raises
+        ``PeerLostError`` (unrecoverable by world-shrink) when the
+        survivor set would fall under ``min_hosts``."""
+        survivors = [p for p in self._survivors if p not in set(lost)]
+        if len(survivors) < self.min_hosts:
+            raise PeerLostError(
+                sorted(lost),
+                f"only {len(survivors)} survivor(s) left, below "
+                f"min_hosts={self.min_hosts}; halting")
+        return self.coordinator.record(RestartDecision(
+            epoch=self.epoch + 1, world_size=len(survivors),
+            restore_step=restore_step, survivors=survivors))
+
+    def await_restart(self, timeout_s: float) -> RestartDecision:
+        """Non-chief half: poll for the chief's decision; fence if it
+        excludes this process."""
+        d = self.coordinator.await_decision(self.epoch + 1, timeout_s)
+        if self.process_id not in d.survivors:
+            self.log("peer_lost", step=d.restore_step,
+                     process_id=self.process_id, reason="evicted")
+            raise EvictedError(
+                f"restart epoch {d.epoch} excluded process "
+                f"{self.process_id}; fencing")
+        return d
+
+    def adopt(self, decision: RestartDecision) -> None:
+        """Enter the new world: smaller survivor set, next epoch, dead
+        bookkeeping cleared (the dead are no longer expected, so their
+        stale beats must stop mattering)."""
+        self.epoch = decision.epoch
+        self._survivors = list(decision.survivors)
+        self.watchdog.dead_peers.clear()
+        self._phase = "restart"
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        self._stop.set()
+        self.watchdog.stop()
+        self._publisher.join(timeout=2.0)
+        self.watchdog.join(timeout=2.0)

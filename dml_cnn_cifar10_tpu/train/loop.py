@@ -31,7 +31,9 @@ from dml_cnn_cifar10_tpu import ckpt as ckpt_lib
 from dml_cnn_cifar10_tpu.config import TrainConfig
 from dml_cnn_cifar10_tpu.data import pipeline as pipe
 from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import cluster as cluster_lib
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.parallel import multihost
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
 from dml_cnn_cifar10_tpu.utils import faults as faults_lib
 from dml_cnn_cifar10_tpu.utils import telemetry as telemetry_lib
@@ -55,7 +57,7 @@ class TrainResult:
 
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None, task_index: int = 0,
-                 fault_injector=None):
+                 fault_injector=None, cluster=None):
         self.cfg = cfg
         self.task_index = task_index
         if cfg.on_nonfinite not in ("halt", "skip", "rollback"):
@@ -113,6 +115,16 @@ class Trainer:
             cfg.metrics_jsonl, task_index=task_index,
             tensorboard_dir=(cfg.tensorboard_dir
                              if jax.process_index() == 0 else None))
+        # Cluster-resilience monitor (parallel/cluster.py): heartbeats,
+        # collective watchdog, eviction checks at the dispatch seam.
+        # The supervisor passes ONE monitor across restart attempts
+        # (epoch/world state must survive them); a bare Trainer builds
+        # its own from the config and owns its lifecycle.
+        self._owns_cluster = cluster is None \
+            and cfg.parallel.cluster_dir is not None
+        self.cluster = cluster if cluster is not None \
+            else cluster_lib.ClusterMonitor.from_config(
+                cfg.parallel, logger=self.logger)
         # Resident-eval fns; built per-fit when the resident path is active.
         self._resident_full_eval = None
         self._resident_test_eval = None
@@ -480,6 +492,8 @@ class Trainer:
                 "acc": base_counts["acc"] + consumed["acc"],
                 "test": base_counts["test"] + consumed["test"],
             } if exact_ok else None
+            if self.cluster is not None:
+                self.cluster.set_phase("checkpoint")
             with tracer.span("checkpoint", cat="checkpoint"):
                 return ckpt_mgr.maybe_save(save_state, step, force=force,
                                            data_state=data_state)
@@ -521,13 +535,29 @@ class Trainer:
             with PreemptionGuard() as preempt, profile_trace(cfg.profile_dir):
                 while global_step < total_steps and not stop:
                     drained = False
+                    if self.cluster is not None:
+                        # Dispatch-seam liveness (parallel/cluster.py):
+                        # publish a beat, check for eviction, arm the
+                        # collective watchdog. Raises PeerLostError when
+                        # a peer's heartbeats went stale — determinism
+                        # instead of blocking in XLA.
+                        self.cluster.begin_step(global_step)
                     if self.faults is not None:
                         # Deterministic fault injection at the host seam
                         # (utils/faults.py): may poison the state, corrupt
                         # the latest checkpoint on disk, deliver SIGTERM,
-                        # or raise an injected data stall.
+                        # raise an injected data stall, or fire a cluster
+                        # fault (stalled beats / abrupt death / wedged
+                        # collective) against the armed watchdog.
                         state = self.faults.step_hook(
-                            global_step, state, cfg.log_dir, self.logger)
+                            global_step, state, cfg.log_dir, self.logger,
+                            cluster=self.cluster)
+                    if self.cluster is not None:
+                        # Lockstep simulation barrier (no-op outside the
+                        # CPU sim): wait for every live peer to reach
+                        # this step, the software stand-in for the XLA
+                        # collective a real pod would block in.
+                        self.cluster.sync(global_step)
                     first = probe_thread is None
                     with tracer.span("data_wait", cat="data"):
                         try:
@@ -552,6 +582,12 @@ class Trainer:
                                      else "dispatch",
                                      cat="compile" if first else None):
                         state, metrics = step_fn(state, *batch)
+                    if self.cluster is not None:
+                        # The dispatch came back: disarm the watchdog.
+                        # Boundary work (eval/checkpoint) runs unarmed —
+                        # the background publisher keeps this process
+                        # looking alive to its peers throughout.
+                        self.cluster.end_step(global_step + k)
 
                     if probe_thread is None:
                         # First dispatch returned ⇒ trace+compile are done
@@ -742,6 +778,8 @@ class Trainer:
                             elif keep_snapshot:
                                 snapshot = _copy_state(state)
                     if (i + k) % cfg.eval_every == 0:
+                        if self.cluster is not None:
+                            self.cluster.set_phase("eval")
                         with tracer.span("eval", cat="eval"):
                             ta = self.evaluate(state, test_it)
                         if not cfg.eval_full_test_set:
@@ -808,10 +846,34 @@ class Trainer:
                     jax.device_get(last_metrics["loss"])
                     avg_rate = ((global_step - start_step) * cfg.batch_size
                                 / max(time.perf_counter() - run_t0, 1e-9))
-                guarded_save(state, global_step, force=True)
-                if stop:
+                # A preempted NON-CHIEF host does not attempt the drain
+                # save: the chief owns the checkpoint decision, and a
+                # non-chief writing its own view of step N is how
+                # restore races start. It emits a peer_lost-style
+                # notice and exits cleanly instead. Gated to the
+                # process-local case (jax.process_count() == 1 — the
+                # cluster-sim / independent-world layout): in a real
+                # jax.distributed world the save is a COLLECTIVE fetch
+                # the allgathered stop makes every process enter
+                # together, and skipping it on one would hang the rest.
+                nonchief_preempt = (stop and num_shards == 1
+                                    and not multihost.is_chief(
+                                        cfg.parallel))
+                if nonchief_preempt:
+                    self.logger.log(
+                        "peer_lost", step=global_step,
+                        process_id=cfg.parallel.process_id,
+                        reason="preempt_nonchief_exit")
+                    print(f"[preempt] signal {preempt.signum} on "
+                          f"non-chief process "
+                          f"{cfg.parallel.process_id}: exiting cleanly "
+                          f"without saving (chief owns the checkpoint)")
+                else:
+                    guarded_save(state, global_step, force=True)
+                if stop and not nonchief_preempt:
                     print(f"[preempt] signal {preempt.signum}: checkpointed at "
                           f"step {global_step}, exiting cleanly")
+                if stop:
                     self.logger.log("preempt", step=global_step,
                                     signum=preempt.signum)
                 self.logger.log("done", step=global_step,
@@ -831,6 +893,11 @@ class Trainer:
             # matter.
             ckpt_mgr.close()
             prefetch.close()
+            # A supervisor-owned monitor must keep its threads (and
+            # epoch/world state) across fit attempts; only a monitor
+            # this Trainer built for itself dies with the fit.
+            if self._owns_cluster and self.cluster is not None:
+                self.cluster.close()
             # The Chrome trace exports from the finally block so a
             # crashed/preempted run still leaves its host-loop timeline —
             # exactly the runs worth opening in Perfetto.

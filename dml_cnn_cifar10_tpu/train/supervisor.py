@@ -27,11 +27,20 @@ Rollback of a non-finite loss may also scale the learning rate down
 the same LR diverges again; shrinking the step size is the classic
 operator move, now automated and logged as a ``rollback`` record.
 
-Scope: per-process. Under multi-host SPMD a peer that died takes the
-collectives with it — whole-job restart remains the scheduler's job;
-this supervisor makes the single-process (and the restarted-job) path
-self-healing and, via ``--fault_spec`` (utils/faults.py), testable on
-CPU in tier-1.
+Scope: per-process for the classes above — and, with the
+cluster-resilience layer armed (``--cluster_dir``,
+``parallel/cluster.py``), **cluster-aware**: a ``peer_lost`` failure
+(heartbeats stale past ``--peer_dead_after_s``) is recoverable too.
+The chief records a restart decision (survivor set, shrunken world
+size, restore step), survivors poll and adopt it, each re-enters
+through the same restore path — checkpoints are placement-free
+(``tests/test_elastic.py``), so resuming at a smaller world size is
+just another elastic restore — and a process the decision excludes
+fences itself (:class:`EvictedError`) instead of split-braining the
+run. World size decrements stop at ``--min_hosts``; below that the
+failure re-raises. Everything is testable on CPU in tier-1 via
+``--fault_spec`` (utils/faults.py) and the lockstep simulation
+harness (``tests/test_cluster.py``).
 """
 
 from __future__ import annotations
@@ -42,11 +51,13 @@ from typing import Optional
 from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
 from dml_cnn_cifar10_tpu.config import TrainConfig
 from dml_cnn_cifar10_tpu.data.pipeline import DataPipelineError
+from dml_cnn_cifar10_tpu.parallel import cluster as cluster_lib
+from dml_cnn_cifar10_tpu.utils import backoff
 from dml_cnn_cifar10_tpu.utils import faults as faults_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
 
 #: Failure classes the supervisor may retry.
-RECOVERABLE_FAULTS = ("nonfinite", "data", "ckpt_restore")
+RECOVERABLE_FAULTS = ("nonfinite", "data", "ckpt_restore", "peer_lost")
 
 
 def classify_failure(exc: BaseException) -> Optional[str]:
@@ -57,7 +68,11 @@ def classify_failure(exc: BaseException) -> Optional[str]:
       actionable when ``on_nonfinite=rollback``; the caller checks)
     - checkpoint-restore failures (the classified ``ValueError`` every
       restore path raises) → ``"ckpt_restore"``
+    - a peer declared lost by the collective watchdog → ``"peer_lost"``
+      (recoverable by coordinated world-shrink, not by plain retry)
     """
+    if isinstance(exc, cluster_lib.PeerLostError):
+        return "peer_lost"
     if isinstance(exc, (faults_lib.DataStallError, DataPipelineError)):
         return "data"
     if isinstance(exc, FloatingPointError):
@@ -68,24 +83,66 @@ def classify_failure(exc: BaseException) -> Optional[str]:
     return None
 
 
+def _coordinate_restart(cfg: TrainConfig, monitor, exc, logger,
+                        attempt: int):
+    """The coordinated elastic-restart protocol, from this process's
+    seat. Chief: shrink the survivor set by the lost peers (halting
+    below ``min_hosts``), pick the restore step (newest checkpoint on
+    disk — the same one every survivor's ``init_or_restore`` walk will
+    find), commit the decision. Non-chief: poll for it, fencing if
+    excluded. Both: adopt the new world and log ``elastic_restart``."""
+    if monitor.is_chief:
+        steps = ckpt_lib.all_checkpoint_steps(cfg.log_dir)
+        restore_step = max(steps) if steps else 0
+        decision = monitor.decide_restart(exc.process_ids, restore_step)
+    else:
+        timeout = max(30.0, cfg.parallel.peer_dead_after_s * 6)
+        decision = monitor.await_restart(timeout)
+    monitor.adopt(decision)
+    cfg.parallel.num_processes = decision.world_size
+    logger.log("elastic_restart", step=decision.restore_step,
+               restore_step=decision.restore_step,
+               world_size=decision.world_size, epoch=decision.epoch,
+               attempt=attempt, lost=list(exc.process_ids))
+    print(f"[supervisor] elastic restart epoch {decision.epoch}: "
+          f"lost {list(exc.process_ids)}, world size "
+          f"{decision.world_size}, restoring from step "
+          f"{decision.restore_step}")
+    return decision
+
+
 def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                    task_index: int = 0):
     """``Trainer.fit`` under the recovery supervisor; returns the final
     :class:`TrainResult`. Unrecoverable failures — and recoverable ones
-    past the ``recovery_retries`` budget — re-raise unchanged."""
+    past the ``recovery_retries`` budget — re-raise unchanged. A
+    process evicted by a restart decision returns ``None`` after a
+    clean notice: it was fenced, not failed."""
     from dml_cnn_cifar10_tpu.train.loop import Trainer
 
     # ONE injector across every attempt: fired faults stay fired, so a
     # recovered run replaying the same steps does not re-injure itself.
+    # Same ownership rule for the cluster monitor: epoch/world state
+    # (and the background beat publisher) must span restarts.
     injector = faults_lib.FaultInjector.from_spec(cfg.fault_spec)
     logger = MetricsLogger(cfg.metrics_jsonl, task_index=task_index)
+    monitor = cluster_lib.ClusterMonitor.from_config(cfg.parallel,
+                                                     logger=logger)
     attempt = 0
     try:
         while True:
             trainer = Trainer(cfg, task_index=task_index,
-                              fault_injector=injector)
+                              fault_injector=injector, cluster=monitor)
             try:
                 result = trainer.fit(total_steps)
+            except cluster_lib.EvictedError as e:
+                # The surviving world already restarted without this
+                # process (a stalled heartbeat looks dead from outside).
+                # Exit cleanly and saveless — rejoining would
+                # split-brain the run. The monitor logged `peer_lost`
+                # (reason "evicted") at detection.
+                print(f"[supervisor] fenced: {e}")
+                return None
             except Exception as e:
                 fault = classify_failure(e)
                 if fault is None or attempt >= cfg.recovery_retries:
@@ -94,12 +151,26 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                     # halt stays a halt; an exhausted skip budget
                     # already degraded to halt inside the loop.
                     raise
+                if fault == "peer_lost" and monitor is None:
+                    raise
                 attempt += 1
-                steps = ckpt_lib.all_checkpoint_steps(cfg.log_dir)
-                restore_step = max(steps) if steps else 0
-                backoff = min(
-                    cfg.recovery_backoff_s * (2 ** (attempt - 1)),
-                    cfg.recovery_backoff_max_s)
+                if fault == "peer_lost":
+                    # May re-raise PeerLostError (below min_hosts —
+                    # unrecoverable) or fence this process (the
+                    # decision excluded it while it was awaiting).
+                    try:
+                        decision = _coordinate_restart(cfg, monitor, e,
+                                                       logger, attempt)
+                    except cluster_lib.EvictedError as ev:
+                        print(f"[supervisor] fenced: {ev}")
+                        return None
+                    restore_step = decision.restore_step
+                else:
+                    steps = ckpt_lib.all_checkpoint_steps(cfg.log_dir)
+                    restore_step = max(steps) if steps else 0
+                backoff_s = backoff.delay_s(cfg.recovery_backoff_s,
+                                            cfg.recovery_backoff_max_s,
+                                            attempt)
                 logger.log("fault", step=restore_step, fault=fault,
                            injected=False, error=str(e)[:300])
                 if fault == "nonfinite" and cfg.rollback_lr_scale != 1.0:
@@ -111,12 +182,12 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                                lr=cfg.optim.learning_rate)
                 logger.log("recovery", step=restore_step, fault=fault,
                            action="restart", attempt=attempt,
-                           backoff_s=backoff)
+                           backoff_s=backoff_s)
                 print(f"[supervisor] recoverable {fault} failure "
                       f"(attempt {attempt}/{cfg.recovery_retries}): "
                       f"{e}; restoring from step {restore_step} after "
-                      f"{backoff:.2f}s backoff")
-                time.sleep(backoff)
+                      f"{backoff_s:.2f}s backoff")
+                time.sleep(backoff_s)
                 continue
             if attempt:
                 logger.log("recovery", step=result.final_step,
@@ -127,4 +198,6 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                       f"restart(s)")
             return result
     finally:
+        if monitor is not None:
+            monitor.close()
         logger.close()

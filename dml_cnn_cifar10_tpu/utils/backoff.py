@@ -1,0 +1,28 @@
+"""Deterministic bounded exponential backoff.
+
+One tiny pure function shared by every retry loop in the framework —
+the run supervisor (``train/supervisor.py``), the multi-host
+coordinator bootstrap (``parallel/multihost.py``), and the dataset
+downloader all retry with the same shape: ``base * 2^(attempt-1)``
+capped at ``cap``. Keeping it pure (no jitter, no clock) makes retry
+plans reproducible: the sequence of sleeps for a given budget is a
+fixed list a test can pin exactly (``tests/test_cluster.py``).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def delay_s(base_s: float, cap_s: float, attempt: int) -> float:
+    """Backoff before retry ``attempt`` (1-based): ``base * 2^(a-1)``,
+    capped at ``cap_s``. ``attempt < 1`` is a contract violation."""
+    if attempt < 1:
+        raise ValueError(f"attempt must be >= 1, got {attempt}")
+    return min(base_s * (2 ** (attempt - 1)), cap_s)
+
+
+def schedule(base_s: float, cap_s: float, retries: int) -> List[float]:
+    """The full deterministic sleep plan for a ``retries``-attempt
+    budget — what a run WILL wait, computable before it waits it."""
+    return [delay_s(base_s, cap_s, a) for a in range(1, retries + 1)]

@@ -27,6 +27,22 @@ Fault kinds:
   the stand-in for a wedged input pipeline; the supervisor classifies
   it as a recoverable data failure.
 
+Cluster-resilience kinds (need a :class:`~parallel.cluster.ClusterMonitor`
+— i.e. ``--cluster_dir``; docs/RESILIENCE.md multi-host section):
+
+- ``heartbeat_stall`` — stop publishing heartbeats while the process
+  keeps training: from outside, indistinguishable from a dead host.
+  Peers declare this process lost and restart without it; the eviction
+  check fences it cleanly.
+- ``host_lost`` — ``os._exit`` with no cleanup, no checkpoint, no
+  flushed logs: the crashed/preempted-host case. Peers see the
+  heartbeats go stale.
+- ``collective_hang`` — block the main thread at the dispatch seam
+  while the background publisher keeps beating: the wedged-collective
+  case. Peers see a fresh-but-behind straggler; this process's own
+  watchdog eventually aborts it (``collective_timeout_s``), turning
+  the silent hang into a classified host loss.
+
 Every injection logs a ``fault`` JSONL record (``injected: true``) so
 recovery tooling can pair injections with the ``recovery`` records they
 provoke (``docs/RESILIENCE.md``).
@@ -39,7 +55,13 @@ import os
 import signal
 from typing import List, Optional
 
-FAULT_KINDS = ("nan", "ckpt_corrupt", "sigterm", "data_stall")
+FAULT_KINDS = ("nan", "ckpt_corrupt", "sigterm", "data_stall",
+               "heartbeat_stall", "host_lost", "collective_hang")
+
+#: Exit code of a ``host_lost`` injection — an abrupt, cleanup-free
+#: death (distinct from the watchdog's own abort code so tests can tell
+#: the injected corpse from a watchdog-fenced process).
+EXIT_HOST_LOST = 77
 
 
 class InjectedFault(RuntimeError):
@@ -151,12 +173,16 @@ class FaultInjector:
             logger.log("fault", step=step, fault=kind, injected=True,
                        **extra)
 
-    def step_hook(self, step: int, state, log_dir: str, logger=None):
+    def step_hook(self, step: int, state, log_dir: str, logger=None,
+                  cluster=None):
         """Fire every due, unfired event; returns the (possibly
         poisoned) state. ``ckpt_corrupt`` stays pending until a
         checkpoint exists to corrupt. ``data_stall`` raises after
         marking itself fired so a supervised restart does not re-raise
-        it."""
+        it. The cluster kinds take the :class:`ClusterMonitor` the
+        Trainer threads through (``cluster``) and fail loudly without
+        one — a cluster drill that silently no-ops would void its
+        test."""
         for ev in self.events:
             if ev.fired or step < ev.step:
                 continue
@@ -179,4 +205,33 @@ class FaultInjector:
                 self._log(logger, step, ev.kind)
                 raise DataStallError(
                     f"injected data stall at step {step}")
+            elif ev.kind == "heartbeat_stall":
+                if cluster is None:
+                    raise InjectedFault(
+                        "heartbeat_stall injection needs --cluster_dir "
+                        "(no ClusterMonitor to stall)")
+                ev.fired = True
+                self._log(logger, step, ev.kind)
+                cluster.stall_heartbeats()
+            elif ev.kind == "host_lost":
+                ev.fired = True
+                self._log(logger, step, ev.kind)
+                # Abrupt death: no checkpoint, no drain, no atexit. The
+                # JSONL line above is line-buffered (already on disk);
+                # everything else is deliberately lost.
+                os._exit(EXIT_HOST_LOST)
+            elif ev.kind == "collective_hang":
+                if cluster is None:
+                    raise InjectedFault(
+                        "collective_hang injection needs --cluster_dir "
+                        "(no watchdog to abort the hang)")
+                ev.fired = True
+                self._log(logger, step, ev.kind)
+                # Wedge the main thread while the publisher keeps
+                # beating — exactly what a stuck XLA collective looks
+                # like. Only the watchdog's collective_timeout_s abort
+                # (os._exit) ends this loop.
+                import time
+                while True:
+                    time.sleep(0.05)
         return state

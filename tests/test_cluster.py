@@ -1,0 +1,450 @@
+"""Cluster resilience (parallel/cluster.py): heartbeats, the collective
+watchdog, coordinated elastic restart — and the ISSUE-4 acceptance
+smokes: 2-process CPU lockstep simulations where one host stalls its
+heartbeats / dies abruptly, the survivor classifies the fault, executes
+a coordinated elastic restart at reduced world size, and finishes with
+params BIT-IDENTICAL to a fault-free single-process run restored from
+the same checkpoint."""
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dml_cnn_cifar10_tpu.parallel import cluster as cluster_lib
+from dml_cnn_cifar10_tpu.utils import backoff
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeLogger:
+    def __init__(self):
+        self.records = []
+
+    def log(self, kind, **fields):
+        self.records.append({"kind": kind, **fields})
+
+    def flush(self):
+        pass
+
+    def kinds(self):
+        return [r["kind"] for r in self.records]
+
+
+# ---------------------------------------------------------------------------
+# backoff helper (satellite): deterministic, reproducible, capped
+# ---------------------------------------------------------------------------
+
+def test_backoff_schedule_is_deterministic_and_capped():
+    plan = backoff.schedule(0.5, 30.0, 10)
+    assert plan == [0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 30.0, 30.0, 30.0,
+                    30.0]
+    # Reproducible: the same budget always yields the same sleep plan.
+    assert plan == backoff.schedule(0.5, 30.0, 10)
+    assert backoff.delay_s(0.5, 30.0, 3) == 2.0
+    with pytest.raises(ValueError):
+        backoff.delay_s(0.5, 30.0, 0)
+    # The supervisor's sleeps ARE this plan (same helper, same args).
+    from dml_cnn_cifar10_tpu.config import TrainConfig
+    cfg = TrainConfig()
+    assert backoff.schedule(cfg.recovery_backoff_s,
+                            cfg.recovery_backoff_max_s, 3) == \
+        [0.5, 1.0, 2.0]
+
+
+# ---------------------------------------------------------------------------
+# heartbeat store
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_store_roundtrip(tmp_path):
+    a = cluster_lib.HeartbeatStore(str(tmp_path), 0)
+    b = cluster_lib.HeartbeatStore(str(tmp_path), 1)
+    a.publish(7, "train")
+    beat = b.read(0)
+    assert beat.process_id == 0 and beat.step == 7
+    assert beat.phase == "train" and beat.age_s() < 5.0
+    assert b.read(3) is None                      # never published
+    b.publish(0, "init")
+    peers = a.read_peers([0, 1])                  # self excluded
+    assert list(peers) == [1] and peers[1].step == 0
+
+
+# ---------------------------------------------------------------------------
+# restart coordinator
+# ---------------------------------------------------------------------------
+
+def test_restart_coordinator_record_await_and_monotone_epoch(tmp_path):
+    c = cluster_lib.RestartCoordinator(str(tmp_path))
+    assert c.read() is None
+    d = c.record(cluster_lib.RestartDecision(
+        epoch=1, world_size=1, restore_step=10, survivors=[0]))
+    got = c.await_decision(min_epoch=1, timeout_s=1.0)
+    assert got == d
+    with pytest.raises(ValueError, match="monotone"):
+        c.record(cluster_lib.RestartDecision(
+            epoch=1, world_size=1, restore_step=10, survivors=[0]))
+    # A chief that never decides is a coordinator loss, not a hang.
+    with pytest.raises(cluster_lib.PeerLostError) as ei:
+        c.await_decision(min_epoch=2, timeout_s=0.15, poll_s=0.02)
+    assert ei.value.process_ids == [0]
+
+
+def _monitor(tmp_path, pid, n=2, logger=None, **kw):
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    kw.setdefault("straggler_after_s", 0.1)
+    kw.setdefault("peer_dead_after_s", 0.5)
+    kw.setdefault("collective_timeout_s", 60.0)
+    return cluster_lib.ClusterMonitor(
+        str(tmp_path), pid, n, logger=logger or FakeLogger(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# watchdog classification: straggler vs. host loss
+# ---------------------------------------------------------------------------
+
+def test_watchdog_classifies_straggler_then_dead(tmp_path):
+    log = FakeLogger()
+    mon = _monitor(tmp_path, 0, logger=log)
+    peer = cluster_lib.HeartbeatStore(str(tmp_path), 1)
+    try:
+        peer.publish(3, "train")
+        mon.watchdog.arm(8)
+        # Fresh beat, behind my step: straggler telemetry, not death.
+        mon.watchdog.check_peers()
+        assert [r for r in log.records if r["kind"] == "straggler"
+                and r["process_id"] == 1 and r["behind_steps"] == 5]
+        assert not mon.watchdog.dead_peers
+        # The SAME beat, read after its age passed peer_dead_after_s:
+        # hang/host-loss. Synthetic `now` — no wall-clock sleeps.
+        mon.watchdog.check_peers(now=time.time() + 1.0)
+        assert mon.watchdog.dead_peers == {1}
+        lost = [r for r in log.records if r["kind"] == "peer_lost"]
+        assert lost and lost[0]["process_id"] == 1
+        assert lost[0]["reason"] == "stale_heartbeat"
+        with pytest.raises(cluster_lib.PeerLostError) as ei:
+            mon.begin_step(9)
+        assert ei.value.process_ids == [1]
+    finally:
+        mon.close()
+
+
+def test_watchdog_aborts_wedged_seam(tmp_path):
+    """Main thread presumed stuck in XLA past collective_timeout_s: the
+    watchdog must abort the process (stubbed here) after classifying —
+    self_hang when peers are fine, peer_dead when a corpse was found."""
+    aborted = []
+    log = FakeLogger()
+    mon = cluster_lib.ClusterMonitor(
+        str(tmp_path), 0, 2, heartbeat_interval_s=0.05,
+        straggler_after_s=0.05, peer_dead_after_s=30.0,
+        collective_timeout_s=0.2, logger=log,
+        abort_fn=lambda verdict: aborted.append(verdict))
+    peer = cluster_lib.HeartbeatStore(str(tmp_path), 1)
+    try:
+        mon.watchdog.arm(4)
+        deadline = time.time() + 5.0
+        while not aborted and time.time() < deadline:
+            peer.publish(9, "train")      # alive and ahead: I am the hang
+            time.sleep(0.05)
+        assert aborted and aborted[0] == "self_hang"
+        assert any(r["kind"] == "peer_lost"
+                   and r["reason"] == "watchdog_abort_self_hang"
+                   for r in log.records)
+    finally:
+        mon.close()
+
+
+def test_heartbeat_stall_freezes_beats(tmp_path):
+    mon = _monitor(tmp_path, 0, n=1)
+    try:
+        mon.begin_step(5)
+        mon.end_step(6)
+        mon.stall_heartbeats()
+        time.sleep(0.1)    # let any in-flight background publish land
+        before = mon.store.read(0)
+        time.sleep(0.2)                   # >> heartbeat_interval_s
+        after = mon.store.read(0)
+        assert after.wallclock == before.wallclock
+    finally:
+        mon.close()
+
+
+# ---------------------------------------------------------------------------
+# eviction + world-shrink decisions
+# ---------------------------------------------------------------------------
+
+def test_eviction_fences_excluded_process(tmp_path):
+    log = FakeLogger()
+    mon = _monitor(tmp_path, 1, logger=log)
+    try:
+        mon.coordinator.record(cluster_lib.RestartDecision(
+            epoch=1, world_size=1, restore_step=20, survivors=[0]))
+        with pytest.raises(cluster_lib.EvictedError):
+            mon.check_evicted(25)
+        assert any(r["kind"] == "peer_lost" and r["reason"] == "evicted"
+                   for r in log.records)
+        # await_restart fences too (the non-chief survivor seat).
+        mon.epoch = 0
+        with pytest.raises(cluster_lib.EvictedError):
+            mon.await_restart(timeout_s=1.0)
+    finally:
+        mon.close()
+
+
+def test_decide_restart_shrinks_world_and_enforces_min_hosts(tmp_path):
+    mon = _monitor(tmp_path, 0, n=3, min_hosts=2)
+    try:
+        d = mon.decide_restart([2], restore_step=30)
+        assert d.world_size == 2 and d.survivors == [0, 1]
+        assert d.epoch == 1 and d.restore_step == 30
+        mon.adopt(d)
+        assert mon.world_size() == 2 and mon.epoch == 1
+        # Next loss would leave 1 < min_hosts=2: halt, don't degrade.
+        with pytest.raises(cluster_lib.PeerLostError, match="min_hosts"):
+            mon.decide_restart([1], restore_step=30)
+    finally:
+        mon.close()
+
+
+def test_chief_role_falls_to_lowest_live_process(tmp_path):
+    mon = _monitor(tmp_path, 1, n=3)
+    try:
+        assert not mon.is_chief
+        mon.watchdog.dead_peers.add(0)    # coordinator-loss: 0 is gone
+        assert mon.is_chief               # 1 inherits the decision pen
+    finally:
+        mon.close()
+
+
+def test_from_config_is_off_without_cluster_dir():
+    from dml_cnn_cifar10_tpu.config import ParallelConfig
+    assert cluster_lib.ClusterMonitor.from_config(ParallelConfig()) is None
+
+
+# ---------------------------------------------------------------------------
+# the acceptance smokes: 2-process lockstep simulation, one host fails,
+# the survivor elastically restarts, params stay bit-identical
+# ---------------------------------------------------------------------------
+
+WORKER = """
+import json, sys
+from dml_cnn_cifar10_tpu.utils.platform import force_cpu
+force_cpu()
+task, n, data_dir, log_dir, cluster_dir, fault_spec, total_steps = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6], int(sys.argv[7]))
+import hashlib
+import numpy as np
+import jax
+from dml_cnn_cifar10_tpu.config import TrainConfig, DataConfig
+from dml_cnn_cifar10_tpu.train.supervisor import fit_supervised
+
+cfg = TrainConfig(
+    batch_size=32, total_steps=total_steps, output_every=10,
+    eval_every=20, checkpoint_every=10, log_dir=log_dir,
+    metrics_jsonl=f"{log_dir}/metrics.jsonl",
+    data=DataConfig(dataset="synthetic", data_dir=data_dir,
+                    synthetic_train_records=256, synthetic_test_records=64,
+                    normalize="scale", use_native_loader=False),
+)
+cfg.model.logit_relu = False
+cfg.optim.learning_rate = 0.05
+cfg.keep_checkpoints = 20   # retention must not prune the restore point
+cfg.recovery_backoff_s = 0.05
+cfg.recovery_backoff_max_s = 0.2
+cfg.fault_spec = fault_spec or None
+cfg.parallel.process_id = task
+cfg.parallel.num_processes = n
+if cluster_dir:
+    cfg.parallel.cluster_dir = cluster_dir
+    cfg.parallel.cluster_lockstep = True
+    cfg.parallel.heartbeat_interval_s = 0.1
+    cfg.parallel.straggler_after_s = 0.4
+    cfg.parallel.peer_dead_after_s = 2.5
+    cfg.parallel.collective_timeout_s = 300.0
+
+res = fit_supervised(cfg, task_index=task)
+if res is None:
+    print("RESULT " + json.dumps({"task": task, "fenced": True}))
+    sys.exit(0)
+h = hashlib.sha256()
+for leaf in jax.tree.leaves(jax.device_get(res.state.params)):
+    h.update(np.ascontiguousarray(leaf).tobytes())
+print("RESULT " + json.dumps({
+    "task": task, "fenced": False, "final_step": res.final_step,
+    "digest": h.hexdigest()}))
+"""
+
+_REF_DIGEST_CACHE = {}
+
+
+def _read_result(out):
+    lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+    assert lines, f"no RESULT line in:\n{out}"
+    return json.loads(lines[-1][len("RESULT "):])
+
+
+def _spawn(script, args, tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, str(script)] + [str(a) for a in args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+
+
+def _ensure_data(tmp_path, data_cfg):
+    import dataclasses
+    from dml_cnn_cifar10_tpu.data import ensure_dataset
+    data_dir = str(tmp_path / "data")
+    ensure_dataset(dataclasses.replace(
+        data_cfg, data_dir=data_dir, synthetic_train_records=256,
+        synthetic_test_records=64))
+    return data_dir
+
+
+def _reference_digest(tmp_path, data_dir, survivor_logs, restore_step,
+                      script):
+    """Digest of a fault-free SINGLE-process run restored from the same
+    checkpoint the survivor restarted from (copied into a fresh dir).
+    Cached on the checkpoint bytes: both scenarios restart from an
+    identical step-10 checkpoint, so one reference run serves both."""
+    ckpt = os.path.join(survivor_logs, f"ckpt_{restore_step}.msgpack")
+    with open(ckpt, "rb") as f:
+        key = hashlib.sha256(f.read()).hexdigest()
+    if key in _REF_DIGEST_CACHE:
+        return _REF_DIGEST_CACHE[key]
+    ref_logs = str(tmp_path / "ref_logs")
+    os.makedirs(ref_logs)
+    for name in (f"ckpt_{restore_step}.msgpack",
+                 f"ckpt_{restore_step}.msgpack.sha256",
+                 f"data_state_{restore_step}.json"):
+        src = os.path.join(survivor_logs, name)
+        if os.path.exists(src):
+            shutil.copy(src, os.path.join(ref_logs, name))
+    proc = _spawn(script, [0, 1, data_dir, ref_logs, "", "", 40],
+                  tmp_path)
+    out = proc.communicate(timeout=300)[0]
+    assert proc.returncode == 0, f"reference run failed:\n{out}"
+    res = _read_result(out)
+    assert res["final_step"] == 40
+    _REF_DIGEST_CACHE[key] = res["digest"]
+    return res["digest"]
+
+
+def _run_failure_scenario(tmp_path, data_cfg, fault_spec,
+                          faulty_exit_code):
+    """Two lockstep sim hosts; task 1 carries the fault at step 15 (one
+    checkpoint interval past the step-10 save). Returns (survivor
+    result, survivor JSONL records, reference digest)."""
+    data_dir = _ensure_data(tmp_path, data_cfg)
+    cluster_dir = str(tmp_path / "cluster")
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    logs = [str(tmp_path / f"logs_{t}") for t in (0, 1)]
+    procs = [
+        _spawn(script, [t, 2, data_dir, logs[t], cluster_dir,
+                        fault_spec if t == 1 else "", 40], tmp_path)
+        for t in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert procs[0].returncode == 0, f"survivor failed:\n{outs[0]}"
+    assert procs[1].returncode == faulty_exit_code, \
+        f"faulty host exit {procs[1].returncode}:\n{outs[1]}"
+
+    survivor = _read_result(outs[0])
+    assert not survivor["fenced"]
+    assert survivor["final_step"] == 40
+
+    with open(os.path.join(logs[0], "metrics.jsonl")) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    kinds = {r["kind"] for r in recs}
+    # The watchdog classified the fault and the restart was coordinated
+    # and elastic: world shrank to the survivor, restore at the last
+    # checkpoint.
+    assert {"heartbeat", "peer_lost", "elastic_restart"} <= kinds
+    lost = [r for r in recs if r["kind"] == "peer_lost"
+            and r["reason"] == "stale_heartbeat"]
+    assert lost and lost[0]["process_id"] == 1
+    er = [r for r in recs if r["kind"] == "elastic_restart"]
+    assert er and er[0]["world_size"] == 1 and er[0]["epoch"] == 1
+    assert er[0]["restore_step"] == 10
+    # The stream passes the documented-schema lint, and the report CLI
+    # prints the cluster-health section.
+    from tools import check_jsonl_schema, telemetry_report
+    assert check_jsonl_schema.check_lines(
+        json.dumps(r) for r in recs) == []
+    out = telemetry_report.summarize(os.path.join(logs[0],
+                                                  "metrics.jsonl"))
+    assert "cluster health" in out and "elastic restart" in out
+
+    ref = _reference_digest(tmp_path, data_dir, logs[0], 10, script)
+    return survivor, recs, ref
+
+
+def test_sim_host_loss_elastic_restart_bit_identical(tmp_path,
+                                                     data_cfg):
+    """host_lost@15 on task 1 (os._exit, no cleanup): the survivor
+    declares it dead on stale heartbeats, restarts elastically at world
+    size 1 from ckpt_10, and finishes with params bit-identical to a
+    fault-free single-process run restored from the same checkpoint."""
+    from dml_cnn_cifar10_tpu.utils.faults import EXIT_HOST_LOST
+    survivor, recs, ref = _run_failure_scenario(
+        tmp_path, data_cfg, "host_lost@15", EXIT_HOST_LOST)
+    assert survivor["digest"] == ref
+
+
+def test_sim_heartbeat_stall_evicts_and_restarts_bit_identical(
+        tmp_path, data_cfg):
+    """heartbeat_stall@15 on task 1: it keeps training but looks dead
+    from outside. The survivor restarts without it; the stalled host
+    discovers the decision that excluded it and fences itself (clean
+    exit 0, no result)."""
+    survivor, recs, ref = _run_failure_scenario(
+        tmp_path, data_cfg, "heartbeat_stall@15", 0)
+    assert survivor["digest"] == ref
+
+
+# ---------------------------------------------------------------------------
+# satellite: SIGTERM on a non-chief host exits cleanly WITHOUT saving
+# ---------------------------------------------------------------------------
+
+def test_preempted_nonchief_exits_without_saving(data_cfg, tmp_path):
+    from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+    from tests.conftest import tiny_train_cfg
+
+    cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=100)
+    cfg.checkpoint_every = 50
+    cfg.metrics_jsonl = os.path.join(str(tmp_path), "m.jsonl")
+    cfg.fault_spec = "sigterm@12"
+    cfg.parallel.cluster_dir = str(tmp_path / "cluster")
+    cfg.parallel.num_processes = 2
+    cfg.parallel.process_id = 1          # non-chief
+    # Generous thresholds: the lone peer never beats in this test and
+    # must not be declared dead inside the short run.
+    cfg.parallel.straggler_after_s = 60.0
+    cfg.parallel.peer_dead_after_s = 600.0
+    result = Trainer(cfg).fit()
+    assert result.preempted
+    # No drain save: the chief owns the checkpoint decision.
+    assert ckpt_lib.all_checkpoint_steps(cfg.log_dir) == []
+    with open(cfg.metrics_jsonl) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    notice = [r for r in recs if r["kind"] == "peer_lost"]
+    assert notice and notice[0]["reason"] == "preempt_nonchief_exit"
+    assert notice[0]["process_id"] == 1
+    assert any(r["kind"] == "preempt" for r in recs)
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []

@@ -102,8 +102,25 @@ def test_ckpt_corrupt_defers_until_checkpoint_exists(tmp_path):
     assert not ok and "mismatch" in reason
 
 
+def test_cluster_fault_kinds_parse_and_require_monitor():
+    """The cluster kinds parse like any other; firing one without a
+    ClusterMonitor fails loudly — a cluster drill that silently no-ops
+    would void its test (tests/test_cluster.py runs the real ones)."""
+    inj = faults_lib.FaultInjector.from_spec(
+        "heartbeat_stall@5,host_lost@9,collective_hang@12")
+    assert [(e.kind, e.step) for e in inj.events] == [
+        ("heartbeat_stall", 5), ("host_lost", 9),
+        ("collective_hang", 12)]
+    for spec in ("heartbeat_stall@1", "collective_hang@1"):
+        with pytest.raises(faults_lib.InjectedFault, match="cluster_dir"):
+            faults_lib.FaultInjector.from_spec(spec).step_hook(
+                2, None, log_dir="/nonexistent")
+
+
 def test_classify_failure():
     from dml_cnn_cifar10_tpu.data.pipeline import DataPipelineError
+    from dml_cnn_cifar10_tpu.parallel.cluster import PeerLostError
+    assert classify_failure(PeerLostError([1], "stale")) == "peer_lost"
     assert classify_failure(faults_lib.DataStallError("x")) == "data"
     assert classify_failure(DataPipelineError("x")) == "data"
     assert classify_failure(FloatingPointError("nan")) == "nonfinite"

@@ -36,7 +36,10 @@ total_steps = int(sys.argv[8]) if len(sys.argv) > 8 else 8
 ckpt_format = sys.argv[9] if len(sys.argv) > 9 else "msgpack"
 resident = bool(int(sys.argv[10])) if len(sys.argv) > 10 else True
 dev_stream = bool(int(sys.argv[11])) if len(sys.argv) > 11 else False
-hosts = [f"localhost:{port}"] * n_procs  # coordinator = hosts[0]
+# Distinct host:port entries (validate_hosts rejects duplicates — two
+# processes on one address hang a real cluster); only hosts[0] is ever
+# dialed (the coordinator), the rest just size the process set.
+hosts = [f"localhost:{int(port) + i}" for i in range(n_procs)]
 multihost.initialize_from_hosts(hosts, task_index)
 assert jax.process_count() == n_procs
 
@@ -82,6 +85,92 @@ print("RESULT " + json.dumps({
     "idx_digest": idx_digest,
 }))
 """
+
+
+# ---------------------------------------------------------------------------
+# bootstrap validation + coordinator retry (tier-1: no processes spawned)
+# ---------------------------------------------------------------------------
+
+def test_validate_hosts_rejects_bad_inputs():
+    """A bad task_index or a malformed/duplicated host list used to
+    surface as a late jax.distributed hang; now it is a clear
+    ValueError before anything dials anything."""
+    from dml_cnn_cifar10_tpu.parallel import multihost
+
+    ok = ["a:2222", "b:2222"]
+    multihost.validate_hosts(ok, 0)
+    multihost.validate_hosts(ok, 1)
+    with pytest.raises(ValueError, match="empty"):
+        multihost.validate_hosts([], 0)
+    with pytest.raises(ValueError, match="empty"):
+        multihost.validate_hosts(["a:2222", ""], 0)
+    with pytest.raises(ValueError, match="host:port"):
+        multihost.validate_hosts(["a:2222", "b"], 0)
+    with pytest.raises(ValueError, match="host:port"):
+        multihost.validate_hosts(["a:2222", "b:"], 0)
+    with pytest.raises(ValueError, match="duplicated"):
+        multihost.validate_hosts(["a:2222", "a:2222"], 0)
+    with pytest.raises(ValueError, match="task_index"):
+        multihost.validate_hosts(ok, 2)
+    with pytest.raises(ValueError, match="task_index"):
+        multihost.validate_hosts(ok, -1)
+    # initialize_from_hosts validates BEFORE touching jax.distributed.
+    with pytest.raises(ValueError, match="task_index"):
+        multihost.initialize_from_hosts(ok, 5)
+
+
+def test_initialize_retries_slow_coordinator(monkeypatch):
+    """A refused/slow coordinator is a bounded retry with the shared
+    backoff schedule, not a crash; the budget exhausted raises a
+    classified RuntimeError naming the coordinator."""
+    import jax
+
+    from dml_cnn_cifar10_tpu.config import ParallelConfig
+    from dml_cnn_cifar10_tpu.parallel import multihost
+
+    cfg = ParallelConfig(coordinator_address="deadhost:2222",
+                         num_processes=2, process_id=1,
+                         coordinator_timeout_s=1.0,
+                         coordinator_retries=2)
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky_init(**kw):
+        assert kw["initialization_timeout"] == 1
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("connection refused")
+
+    monkeypatch.setattr(multihost, "_is_initialized", lambda: False)
+    monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+    monkeypatch.setattr(multihost.time, "sleep", sleeps.append)
+    multihost.initialize(cfg)
+    assert calls["n"] == 3            # 2 failures + 1 success
+    assert sleeps == [1.0, 2.0]       # utils/backoff.py, base 1s
+
+    calls["n"] = 0
+    sleeps.clear()
+
+    def always_down(**kw):
+        calls["n"] += 1
+        raise RuntimeError("connection refused")
+
+    monkeypatch.setattr(jax.distributed, "initialize", always_down)
+    with pytest.raises(RuntimeError, match="deadhost:2222 unreachable"):
+        multihost.initialize(cfg)
+    assert calls["n"] == 3            # 1 + coordinator_retries attempts
+
+
+def test_is_chief_prefers_config_world():
+    from dml_cnn_cifar10_tpu.config import ParallelConfig
+    from dml_cnn_cifar10_tpu.parallel import multihost
+
+    assert multihost.is_chief()  # single-process JAX world
+    assert multihost.is_chief(ParallelConfig())  # num_processes=1
+    assert multihost.is_chief(
+        ParallelConfig(num_processes=2, process_id=0))
+    assert not multihost.is_chief(
+        ParallelConfig(num_processes=2, process_id=1))
 
 
 def _free_port() -> int:
@@ -290,7 +379,7 @@ from dml_cnn_cifar10_tpu.parallel import multihost
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
 from dml_cnn_cifar10_tpu.train.loop import Trainer
 
-hosts = [f"localhost:{port}"] * n_procs
+hosts = [f"localhost:{int(port) + i}" for i in range(n_procs)]
 multihost.initialize_from_hosts(hosts, task_index)
 
 cfg = TrainConfig(

@@ -50,6 +50,19 @@ KIND_KEYS = {
     "rollback": ("step", "restore_step", "attempt", "lr"),
     "ckpt_fallback": ("step", "path", "error"),
     "ckpt_prune_error": ("step", "path", "error"),
+    # Cluster-resilience layer (parallel/cluster.py;
+    # docs/RESILIENCE.md multi-host section). `heartbeat` is the
+    # rate-limited JSONL mirror of the beat store; `straggler` names a
+    # peer beating but behind at an overrun dispatch seam; `peer_lost`
+    # records a stale-heartbeat death declaration, a watchdog abort, an
+    # eviction fence, or a non-chief preemption exit (`reason` says
+    # which); `elastic_restart` is the adopted coordinated-restart
+    # decision (shrunken world, restore step, epoch).
+    "heartbeat": ("step", "process_id", "phase"),
+    "straggler": ("step", "process_id", "behind_steps", "beat_age_s"),
+    "peer_lost": ("step", "process_id", "reason"),
+    "elastic_restart": ("step", "restore_step", "world_size", "epoch",
+                        "attempt"),
     # Serving runtime (serve/metrics.py; docs/SERVING.md). Percentile
     # values are null until the window has completions.
     "serve": ("requests", "completed", "shed_queue", "shed_deadline",

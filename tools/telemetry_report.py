@@ -177,6 +177,45 @@ def summarize(path: str) -> str:
             lines.append(
                 f"    [{len(prune_errs)} checkpoint prune failure(s) — "
                 f"old checkpoints may be accumulating]")
+    # Cluster health (parallel/cluster.py): beat cadence per process,
+    # straggler pressure, peer deaths, and elastic restarts — the
+    # stream-side answer to "did the cluster layer earn its keep".
+    beats = [r for r in records if r.get("kind") == "heartbeat"]
+    stragglers = [r for r in records if r.get("kind") == "straggler"]
+    losses = [r for r in records if r.get("kind") == "peer_lost"]
+    restarts = [r for r in records if r.get("kind") == "elastic_restart"]
+    if beats or stragglers or losses or restarts:
+        lines.append("  cluster health:")
+        by_pid = {}
+        for r in beats:
+            by_pid.setdefault(r.get("process_id"), []).append(
+                r.get("t") or 0.0)
+        for pid in sorted(by_pid, key=lambda p: (p is None, p)):
+            ts = by_pid[pid]
+            gap = max((b - a for a, b in zip(ts, ts[1:])), default=0.0)
+            lines.append(
+                f"    process {pid}: {len(ts)} heartbeat(s), max gap "
+                f"{gap:.2f} s")
+        if stragglers:
+            counts = {}
+            for r in stragglers:
+                counts[r.get("process_id")] = \
+                    counts.get(r.get("process_id"), 0) + 1
+            worst = max(r.get("behind_steps") or 0 for r in stragglers)
+            per = ", ".join(f"proc {p}: {n}"
+                            for p, n in sorted(counts.items(),
+                                               key=lambda kv: str(kv[0])))
+            lines.append(f"    stragglers: {len(stragglers)} event(s) "
+                         f"({per}); worst lag {worst} step(s)")
+        for r in losses:
+            lines.append(
+                f"    peer_lost: process {r.get('process_id')} at step "
+                f"{r.get('step')} ({r.get('reason')})")
+        for r in restarts:
+            lines.append(
+                f"    elastic restart epoch {r.get('epoch')}: world "
+                f"size {r.get('world_size')}, restored step "
+                f"{r.get('restore_step')}")
     hbm = _last(records, "hbm")
     if hbm:
         if hbm.get("available"):
