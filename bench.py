@@ -27,6 +27,17 @@ Baseline note: the reference publishes NO performance numbers
 ≥20,000 steps × batch 128 in <120 s on a v4-8 ⇒ 21,333 images/sec ÷ 8 chips
 = 2,666.7 images/sec/chip. vs_baseline = measured / 2666.7.
 
+Compile cost (round 6): every compile seam routes through the
+persistent compilation cache (``compilecache/``, default dir
+``/tmp/dml_bench_compile_cache``; override with
+``BENCH_COMPILE_CACHE_DIR``, empty string disables). Warm re-runs skip
+the XLA recompile (jax's native persistent cache armed under the same
+dir; raw executable deserialization is opt-in per backend), each row
+reports ``compile_s`` + ``cache_hit``, and the FLOPs figure is read
+from the SAME cached artifact the timed path executes — the old caveat
+(the AOT ``lower().compile()`` probe not sharing the executable cache,
+forcing a post-measurement recompile) is gone.
+
 Prints ONE JSON line:
   {"metric": "train_throughput", "value": N, "unit": "images/sec/chip",
    "vs_baseline": N, "fp32": {...}, "bf16": {...}, ...}
@@ -40,6 +51,12 @@ import statistics
 import time
 
 NORTH_STAR_IMAGES_PER_SEC_PER_CHIP = 20000 * 128 / 120.0 / 8.0  # 2666.7
+
+
+def _bench_cache_dir():
+    """Cache dir for the bench's compile seams ('' disables)."""
+    return os.environ.get("BENCH_COMPILE_CACHE_DIR",
+                          "/tmp/dml_bench_compile_cache")
 
 # MXU peak TFLOP/s per chip by device kind (substring match on
 # jax.devices()[0].device_kind). One number per part, NOT per dtype:
@@ -100,6 +117,9 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
     # directly; the native loader's C++ shuffle pool would be dead weight.
     cfg.data.use_native_loader = False
     cfg.model.compute_dtype = compute_dtype
+    # Compile-cache every seam (trainer step fns, the chunk below, the
+    # FLOPs probes): warm bench re-runs skip XLA entirely.
+    cfg.compile_cache_dir = _bench_cache_dir() or None
 
     trainer = Trainer(cfg)
     state = trainer.init_or_restore()
@@ -125,7 +145,8 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
         ds_images, ds_labels, state_sharding=trainer.state_sharding,
         data_cfg=cfg.data,
         index_stream=((cfg.data.seed, cfg.batch_size, chunk_k)
-                      if dev_stream else None))
+                      if dev_stream else None),
+        compile_cache=trainer.compile_cache)
     if dev_stream:
         def feed():
             return ()
@@ -157,6 +178,10 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
         float(jax.device_get(metrics["loss"]))  # full drain
         dt = time.perf_counter() - t0
         rates.append(chunks * chunk_k * cfg.batch_size / dt / n_chips)
+    # One extra (unused) batch before the pipeline closes: its avals let
+    # the flops probe below look the TIMED chunk program up in the
+    # compile cache without rebuilding shardings by hand.
+    probe_batch = () if dev_stream else next(prefetch)
     prefetch.close()
 
     med = statistics.median(rates)
@@ -168,20 +193,39 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
         "reps": reps,
     }
 
-    # FLOPs per step from the SCAN-FREE single step (exact for the CNN,
-    # no scan-body accounting assumption; XLA cost analysis reports the
-    # per-device share of the partitioned program). AOT lower().compile()
-    # does not share the call-path executable cache — this recompiles, so
-    # it runs after the timed section.
+    # Per-step FLOPs. With the compile cache armed both figures come
+    # from CACHED artifacts — zero recompiles after the timed section:
+    # the primary source is the cost analysis of the chunk executable
+    # the timed loop actually ran (read back through the cache entry),
+    # cross-checked against the SCAN-FREE single step (exact for the
+    # CNN; also cache-served) to verify the backend counted the K-step
+    # scan body once — a chunk/step ratio near K means it was unrolled
+    # and the chunk figure scales back by K. XLA cost analysis reports
+    # the per-device share of the partitioned program in both cases.
     d = cfg.data
     import numpy as np
     img_abs = jax.ShapeDtypeStruct(
         (cfg.batch_size, d.crop_height, d.crop_width, d.num_channels),
         np.float32)
     lab_abs = jax.ShapeDtypeStruct((cfg.batch_size,), np.int32)
-    flops = compiled_flops(trainer.train_step,
-                           (abstractify(state), img_abs, lab_abs))
+    step_flops = compiled_flops(trainer.train_step,
+                                (abstractify(state), img_abs, lab_abs))
+    flops = step_flops
+    flops_source = "step_probe"
+    cached = getattr(chunk, "cached", None)
+    if cached is not None:
+        ev = cached.last_event or {}
+        row["cache_hit"] = bool(ev.get("hit"))
+        row["compile_s"] = ev.get("compile_s")
+        chunk_f = chunk.cached_flops(abstractify((state, *probe_batch)))
+        if chunk_f and step_flops and \
+                chunk_f >= (1 + chunk_k) / 2 * step_flops:
+            chunk_f /= chunk_k
+        if chunk_f:
+            flops = chunk_f
+            flops_source = "chunk_artifact"
     if flops:
+        row["flops_source"] = flops_source
         # Per-DEVICE flop share x GLOBAL steps/sec (matches the verified
         # train/loop.py formula — no extra device_count divide): each
         # step's program runs once per step across the mesh, each chip
@@ -198,6 +242,11 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
 
 
 def main() -> None:
+    # Before any jax backend use: the native persistent compilation
+    # cache (the warm start when executable swapping is off — the
+    # default) is read at client creation; arming later is a no-op.
+    from dml_cnn_cifar10_tpu.compilecache import arm_native_cache
+    arm_native_cache(_bench_cache_dir() or None)
     rows = {
         # Headline pair: K=100 — the largest dispatch that still lands
         # on the reference's 200/500 observable-boundary cadence, i.e.

@@ -19,6 +19,9 @@ Layers (the reference's implicit TF-runtime layers made explicit):
   (replaces the gRPC PS cluster, ``cifar10cnn.py:184-196``).
 - :mod:`~dml_cnn_cifar10_tpu.ckpt`     — checkpoint/restore
   (replaces MonitoredTrainingSession's saver, ``cifar10cnn.py:222``).
+- :mod:`~dml_cnn_cifar10_tpu.compilecache` — persistent XLA executable
+  cache + AOT warm-start (the explicit form of the cross-session graph
+  amortization TF's runtime did implicitly; ``docs/COMPILECACHE.md``).
 - :mod:`~dml_cnn_cifar10_tpu.cli`      — reference-compatible CLI
   (``cifar10cnn.py:245-274``).
 """

@@ -371,6 +371,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="steps between multi-host preemption/clock-save "
                         "agreement allgathers (single-process reacts "
                         "immediately)")
+    p.add_argument("--compile_cache_dir", type=str, default=None,
+                   help="persistent compilation cache directory "
+                        "(compilecache/, docs/COMPILECACHE.md): compiled "
+                        "programs keyed by fingerprint persist here and "
+                        "warm restarts — supervisor recovery, elastic "
+                        "re-entry, serve warmup — skip the XLA recompile "
+                        "(jax's native persistent cache is armed under "
+                        "DIR/xla; executable deserialization is opt-in "
+                        "per backend via DML_COMPILECACHE_EXEC_BACKENDS). "
+                        "Fail-open; emits `compile` JSONL events")
+    p.add_argument("--compile_cache_max_bytes", type=int,
+                   default=2_000_000_000,
+                   help="LRU size bound for --compile_cache_dir "
+                        "(least-recently-used entries are evicted after "
+                        "each store)")
     p.add_argument("--peak_tflops", type=float, default=None,
                    help="per-chip peak TFLOP/s; enables the MFU metric "
                         "in the jsonl stream")
@@ -423,6 +438,8 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         recovery_backoff_s=args.recovery_backoff_s,
         rollback_lr_scale=args.rollback_lr_scale,
         fault_spec=args.fault_spec,
+        compile_cache_dir=args.compile_cache_dir,
+        compile_cache_max_bytes=args.compile_cache_max_bytes,
         ckpt_format=args.ckpt_format,
         tensorboard_dir=args.tensorboard_dir,
         profile_dir=args.profile_dir,
@@ -557,6 +574,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     if unparsed:
         print(f"[cli] ignoring unrecognized args: {unparsed}",
               file=sys.stderr)
+
+    # Before ANY jax backend use: the native persistent compilation
+    # cache (the warm start for backends where executable swapping is
+    # off — the default) is read at client creation; arming it later is
+    # a silent no-op.
+    from dml_cnn_cifar10_tpu.compilecache import arm_native_cache
+    arm_native_cache(args.compile_cache_dir)
 
     if args.job_name == "ps":
         # The reference blocks a whole process on server.join()

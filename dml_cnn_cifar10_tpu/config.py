@@ -465,6 +465,20 @@ class TrainConfig:
     # resume instead of killing the run. Per-process scope — multi-host
     # whole-job restarts stay the scheduler's job.
     supervise: bool = False
+    # Persistent compilation cache + AOT warm-start (compilecache/;
+    # docs/COMPILECACHE.md). A directory holding cached programs keyed
+    # by (StableHLO hash, mesh, shardings, donation, compute dtype,
+    # jax/backend version): supervisor restarts, elastic re-entries,
+    # and bench/serve warmups warm-start instead of recompiling —
+    # time-to-first-step after a fault drops from the compile cost to a
+    # disk load (jax's native persistent cache under <dir>/xla carries
+    # the warm start; raw executable deserialization is opt-in per
+    # backend). Fail-open: a corrupt/unwritable cache degrades to plain
+    # recompiles, never to a failed run. None = off (every seam
+    # compiles exactly as before).
+    compile_cache_dir: Optional[str] = None
+    # LRU size bound for the cache directory, applied after each store.
+    compile_cache_max_bytes: int = 2_000_000_000
     metrics_jsonl: Optional[str] = None   # structured metrics sink
     # Run-health telemetry (utils/telemetry.py): host-loop span tracing
     # (compile, data wait, dispatch, drain, eval, checkpoint, preemption

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dml_cnn_cifar10_tpu.compilecache import mesh_context
+from dml_cnn_cifar10_tpu.compilecache import wrap as _cc_wrap
 from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig, OptimConfig
 from dml_cnn_cifar10_tpu.models.registry import ModelDef
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
@@ -59,6 +61,7 @@ def init_train_state(
     optim_cfg: OptimConfig,
     mesh: Optional[Mesh] = None,
     state_sharding: Optional[TrainState] = None,
+    compile_cache=None,
 ) -> TrainState:
     """Initialize params/opt/model-state and place them on the mesh.
 
@@ -89,11 +92,18 @@ def init_train_state(
             opt["ema_mstate"] = jax.tree.map(jnp.array, model_state)
         return TrainState(params=params, opt=opt, model_state=model_state)
 
+    def _cached(jitted):
+        # The fused init is a single compiled dispatch — worth caching:
+        # a supervisor/elastic restart re-runs it before every restore.
+        return _cc_wrap(jitted, compile_cache, "init",
+                        mesh_context(mesh, compute_dtype=model_cfg.dtype,
+                                     model=model_cfg.name))
+
     if state_sharding is not None:
-        return jax.jit(build, out_shardings=state_sharding)(key)
+        return _cached(jax.jit(build, out_shardings=state_sharding))(key)
     if mesh is not None:
-        return jax.jit(build,
-                       out_shardings=mesh_lib.replicated(mesh))(key)
+        return _cached(jax.jit(
+            build, out_shardings=mesh_lib.replicated(mesh)))(key)
     return build(key)
 
 
@@ -318,6 +328,7 @@ def make_train_step(
     explicit_collectives: bool = False,
     state_sharding: Optional[TrainState] = None,
     health_metrics: bool = False,
+    compile_cache=None,
 ) -> Callable[[TrainState, jax.Array, jax.Array],
               Tuple[TrainState, dict]]:
     """Build the jitted train step:
@@ -363,8 +374,14 @@ def make_train_step(
         mesh, model_cfg, state_sharding)
     step = _step_body(loss_fn, optim_cfg, health_metrics=health_metrics)
 
+    def _cached(jitted):
+        return _cc_wrap(jitted, compile_cache, "train_step",
+                        mesh_context(mesh, donate=(0,),
+                                     compute_dtype=model_cfg.compute_dtype,
+                                     model=model_cfg.name))
+
     if mesh is None:
-        return jax.jit(step, donate_argnums=0)
+        return _cached(jax.jit(step, donate_argnums=0))
     repl = mesh_lib.replicated(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
     # Conv models use a nontrivial ``seq`` axis for spatial partitioning:
@@ -373,12 +390,12 @@ def make_train_step(
     spatial = mesh_lib.spatial_enabled(model_def, mesh)
     data = mesh_lib.batch_sharding(mesh, 4, spatial=spatial)
     lab = mesh_lib.batch_sharding(mesh, 1)
-    return jax.jit(
+    return _cached(jax.jit(
         step,
         in_shardings=(state_sh, data, lab),
         out_shardings=(state_sh, repl),
         donate_argnums=0,
-    )
+    ))
 
 
 def _chunk_body(loss_fn, optim_cfg: OptimConfig,
@@ -452,6 +469,7 @@ def make_train_chunk(
     state_sharding: Optional[TrainState] = None,
     data_cfg: Optional[DataConfig] = None,
     health_metrics: bool = False,
+    compile_cache=None,
 ) -> Callable[[TrainState, jax.Array, jax.Array],
               Tuple[TrainState, dict]]:
     """K training steps per dispatch: ``(state, images [K,B,...], labels
@@ -475,19 +493,25 @@ def make_train_chunk(
             mesh, model_cfg, state_sharding),
         optim_cfg, data_cfg, health_metrics=health_metrics)
 
+    def _cached(jitted):
+        return _cc_wrap(jitted, compile_cache, "train_chunk",
+                        mesh_context(mesh, donate=(0,),
+                                     compute_dtype=model_cfg.compute_dtype,
+                                     model=model_cfg.name))
+
     if mesh is None:
-        return jax.jit(chunk, donate_argnums=0)
+        return _cached(jax.jit(chunk, donate_argnums=0))
     repl = mesh_lib.replicated(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
     spatial = mesh_lib.spatial_enabled(model_def, mesh)
     data = mesh_lib.batch_sharding(mesh, 5, leading_dims=1, spatial=spatial)
     lab = mesh_lib.batch_sharding(mesh, 2, leading_dims=1)
-    return jax.jit(
+    return _cached(jax.jit(
         chunk,
         in_shardings=(state_sh, data, lab),
         out_shardings=(state_sh, repl),
         donate_argnums=0,
-    )
+    ))
 
 
 def make_train_chunk_resident(
@@ -501,6 +525,7 @@ def make_train_chunk_resident(
     data_cfg: Optional[DataConfig] = None,
     index_stream: Optional[Tuple[int, int, int]] = None,
     health_metrics: bool = False,
+    compile_cache=None,
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, dict]]:
     """Chunked training against an HBM-resident dataset:
     ``(state, idx [K, B] int32) -> (new_state, metrics of the LAST step)``.
@@ -548,6 +573,17 @@ def make_train_chunk_resident(
     gathered_sh = mesh_lib.batch_sharding(mesh, 5, leading_dims=1,
                                           spatial=spatial)
 
+    def _cached(jitted, donate):
+        # Wrapped BEFORE the dataset-binding partial: the cache key then
+        # covers the dataset avals too (a different split size is a
+        # different program). ``fn.cached`` exposes the wrapper so
+        # bench.py can read the timed artifact's cost analysis and
+        # hit/compile_s record without a second compile.
+        return _cc_wrap(jitted, compile_cache, "train_chunk_resident",
+                        mesh_context(mesh, donate=(donate,),
+                                     compute_dtype=model_cfg.compute_dtype,
+                                     model=model_cfg.name))
+
     if index_stream is not None:
         from dml_cnn_cifar10_tpu.data import device_stream
 
@@ -568,12 +604,12 @@ def make_train_chunk_resident(
                 images = lax.with_sharding_constraint(images, gathered_sh)
             return body(state, images, ds_labels[idx])
 
-        jitted_dev = jax.jit(
+        jitted_dev = _cached(jax.jit(
             chunk_dev,
             in_shardings=(repl, repl, state_sh),
             out_shardings=(state_sh, repl),
             donate_argnums=2,
-        )
+        ), donate=2)
         fn = functools.partial(jitted_dev, dataset_images, dataset_labels)
 
         def lower_dev(*abs_args):
@@ -583,6 +619,14 @@ def make_train_chunk_resident(
                                     *abs_args)
 
         fn.lower = lower_dev
+        fn.cached = jitted_dev if compile_cache is not None else None
+        if fn.cached is not None:
+            def flops_dev(abs_args):
+                from dml_cnn_cifar10_tpu.utils.profiling import abstractify
+                return jitted_dev.cached_flops(
+                    (*abstractify((dataset_images, dataset_labels)),
+                     *abs_args))
+            fn.cached_flops = flops_dev
         return fn
 
     def chunk(dataset_images, dataset_labels, state: TrainState, idx):
@@ -596,12 +640,12 @@ def make_train_chunk_resident(
         return body(state, images, dataset_labels[idx])
 
     idx_sh = mesh_lib.batch_sharding(mesh, 2, leading_dims=1)
-    jitted = jax.jit(
+    jitted = _cached(jax.jit(
         chunk,
         in_shardings=(repl, repl, state_sh, idx_sh),
         out_shardings=(state_sh, repl),
         donate_argnums=2,
-    )
+    ), donate=2)
     fn = functools.partial(jitted, dataset_images, dataset_labels)
 
     def lower(*abs_args):
@@ -613,6 +657,14 @@ def make_train_chunk_resident(
                                           dataset_labels)), *abs_args)
 
     fn.lower = lower
+    fn.cached = jitted if compile_cache is not None else None
+    if fn.cached is not None:
+        def flops_idx(abs_args):
+            from dml_cnn_cifar10_tpu.utils.profiling import abstractify
+            return jitted.cached_flops(
+                (*abstractify((dataset_images, dataset_labels)),
+                 *abs_args))
+        fn.cached_flops = flops_idx
     return fn
 
 
@@ -653,6 +705,7 @@ def make_eval_resident(
     num_shards: int = 1,
     total_records: Optional[int] = None,
     expected_batches: Optional[int] = None,
+    compile_cache=None,
 ):
     """Full-split eval in ONE dispatch against an HBM-resident split:
     returns ``(fn, total)`` with ``fn(state) -> GLOBAL correct count``
@@ -729,8 +782,12 @@ def make_eval_resident(
         mesh, ims.ndim, leading_dims=1,
         spatial=mesh_lib.spatial_enabled(model_def, mesh))
     lab_sh = mesh_lib.batch_sharding(mesh, 2, leading_dims=1)
-    jitted = jax.jit(ev, in_shardings=(data_sh, lab_sh, state_sh),
-                     out_shardings=repl)
+    jitted = _cc_wrap(
+        jax.jit(ev, in_shardings=(data_sh, lab_sh, state_sh),
+                out_shardings=repl),
+        compile_cache, "eval_resident",
+        mesh_context(mesh, compute_dtype=model_cfg.compute_dtype,
+                     model=model_cfg.name))
     ims_d = mesh_lib.place_local(data_sh, ims)
     lbs_d = mesh_lib.place_local(lab_sh, lbs)
     return functools.partial(jitted, ims_d, lbs_d), total
@@ -744,6 +801,7 @@ def make_batch_eval_resident(
     dataset_labels: jax.Array,
     data_cfg: DataConfig,
     state_sharding: Optional[TrainState] = None,
+    compile_cache=None,
 ):
     """Single-batch accuracy against an HBM-resident dataset:
     ``fn(state, idx [B] int32) -> accuracy`` (device scalar). The
@@ -767,12 +825,16 @@ def make_batch_eval_resident(
 
     repl = mesh_lib.replicated(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    jitted = jax.jit(
-        ev,
-        in_shardings=(repl, repl, state_sh,
-                      mesh_lib.batch_sharding(mesh, 1)),
-        out_shardings=repl,
-    )
+    jitted = _cc_wrap(
+        jax.jit(
+            ev,
+            in_shardings=(repl, repl, state_sh,
+                          mesh_lib.batch_sharding(mesh, 1)),
+            out_shardings=repl,
+        ),
+        compile_cache, "eval_batch_resident",
+        mesh_context(mesh, compute_dtype=model_cfg.compute_dtype,
+                     model=model_cfg.name))
     return functools.partial(jitted, dataset_images, dataset_labels)
 
 
@@ -835,6 +897,7 @@ def make_eval_step(
     model_cfg: ModelConfig,
     mesh: Optional[Mesh] = None,
     state_sharding: Optional[TrainState] = None,
+    compile_cache=None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], dict]:
     """Jitted eval: ``(state, images, labels) -> {"accuracy", "correct"}`` —
     single-batch accuracy for faithful parity eval (``cifar10cnn.py:
@@ -850,15 +913,21 @@ def make_eval_step(
             "correct": metrics_lib.correct_count(logits, labels),
         }
 
+    def _cached(jitted):
+        return _cc_wrap(jitted, compile_cache, "eval_step",
+                        mesh_context(mesh,
+                                     compute_dtype=model_cfg.compute_dtype,
+                                     model=model_cfg.name))
+
     if mesh is None:
-        return jax.jit(step)
+        return _cached(jax.jit(step))
     repl = mesh_lib.replicated(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
     spatial = mesh_lib.spatial_enabled(model_def, mesh)
-    return jax.jit(
+    return _cached(jax.jit(
         step,
         in_shardings=(state_sh,
                       mesh_lib.batch_sharding(mesh, 4, spatial=spatial),
                       mesh_lib.batch_sharding(mesh, 1)),
         out_shardings=repl,
-    )
+    ))

@@ -103,19 +103,30 @@ class _MetricsFlusher(threading.Thread):
         self._stop.set()
 
 
-def resolve_engine(cfg, task_index: int = 0) -> ServingEngine:
+def resolve_engine(cfg, task_index: int = 0,
+                   logger=None) -> ServingEngine:
     """Artifact if configured/present, else live params from the latest
-    checkpoint (the same EMA-preferring selection as ``--mode export``)."""
+    checkpoint (the same EMA-preferring selection as ``--mode export``).
+    ``--compile_cache_dir`` arms the persistent bucket-warmup cache
+    (compilecache/): a restarted server deserializes its bucket
+    executables instead of recompiling them."""
+    from dml_cnn_cifar10_tpu.compilecache import CompileCache
+
+    cache = CompileCache.from_config(cfg, logger=logger)
     serve_cfg = cfg.serve
     if serve_cfg.artifact_path:
         if not os.path.exists(serve_cfg.artifact_path):
             raise SystemExit(
                 f"--serve_artifact {serve_cfg.artifact_path} does not "
                 f"exist (refusing to fall back to fresh weights)")
-        return ServingEngine.from_artifact(serve_cfg.artifact_path)
+        return ServingEngine.from_artifact(serve_cfg.artifact_path,
+                                           compile_cache=cache,
+                                           logger=logger)
     default_artifact = os.path.join(cfg.log_dir, "model.jaxexport")
     if os.path.exists(default_artifact):
-        return ServingEngine.from_artifact(default_artifact)
+        return ServingEngine.from_artifact(default_artifact,
+                                           compile_cache=cache,
+                                           logger=logger)
 
     from dml_cnn_cifar10_tpu.train.loop import Trainer
     trainer = Trainer(cfg, task_index=task_index)
@@ -124,7 +135,8 @@ def resolve_engine(cfg, task_index: int = 0) -> ServingEngine:
     mstate = state.opt.get("ema_mstate", state.model_state) \
         if trainer.model_def.has_state else None
     return ServingEngine.from_params(trainer.model_def, cfg.model,
-                                     cfg.data, params, mstate)
+                                     cfg.data, params, mstate,
+                                     compile_cache=cache, logger=logger)
 
 
 def main_serve(cfg, task_index: int = 0,
@@ -151,10 +163,13 @@ def main_serve(cfg, task_index: int = 0,
     from dml_cnn_cifar10_tpu.utils.preemption import PreemptionGuard
 
     serve_cfg = cfg.serve
-    engine = resolve_engine(cfg, task_index)
-    metrics = ServeMetrics()
+    # Logger before the engine: bucket warmups emit `compile` JSONL
+    # events through it (per-bucket hit/compile_s — the serving
+    # section of tools/telemetry_report.py totals them).
     logger = MetricsLogger(jsonl_path=cfg.metrics_jsonl,
                            task_index=task_index)
+    engine = resolve_engine(cfg, task_index, logger=logger)
+    metrics = ServeMetrics()
     batcher = MicroBatcher(
         engine, buckets=serve_cfg.buckets,
         max_queue_depth=serve_cfg.max_queue_depth,

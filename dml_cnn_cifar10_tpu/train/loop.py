@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dml_cnn_cifar10_tpu import ckpt as ckpt_lib
+from dml_cnn_cifar10_tpu import compilecache
 from dml_cnn_cifar10_tpu.config import TrainConfig
 from dml_cnn_cifar10_tpu.data import pipeline as pipe
 from dml_cnn_cifar10_tpu.models.registry import get_model
@@ -72,6 +73,23 @@ class Trainer:
         self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(
             cfg.parallel)
         self.model_def = get_model(cfg.model.name)
+        # Logger before the step builders: the compile cache logs a
+        # `compile` JSONL event at every seam, including the ones armed
+        # below.
+        self.logger = MetricsLogger(
+            cfg.metrics_jsonl, task_index=task_index,
+            tensorboard_dir=(cfg.tensorboard_dir
+                             if jax.process_index() == 0 else None))
+        # Persistent compilation cache (compilecache/): every compile
+        # seam this Trainer builds — train step/chunk, init, eval —
+        # routes through it when --compile_cache_dir is set, so a
+        # supervisor restart or elastic re-entry deserializes the
+        # executables its predecessor compiled instead of recompiling.
+        # The on_event hook feeds obtain-time into the goodput `compile`
+        # fraction (the tracer exists only while fit() runs).
+        self._tracer = None
+        self.compile_cache = compilecache.CompileCache.from_config(
+            cfg, logger=self.logger, on_event=self._note_compile_event)
         # One sharding tree, computed once, used everywhere state is placed
         # (init, restore, train/eval in_shardings). The explicit-collectives
         # path is dp-only and expects replicated state.
@@ -88,7 +106,8 @@ class Trainer:
             self.model_def, cfg.model, cfg.optim, self.mesh,
             explicit_collectives=cfg.parallel.explicit_collectives,
             state_sharding=self.state_sharding,
-            health_metrics=cfg.health_metrics)
+            health_metrics=cfg.health_metrics,
+            compile_cache=self.compile_cache)
         self.steps_per_dispatch = max(1, cfg.steps_per_dispatch)
         if self.steps_per_dispatch > 1:
             k = self.steps_per_dispatch
@@ -107,14 +126,12 @@ class Trainer:
             self.train_chunk = step_lib.make_train_chunk(
                 self.model_def, cfg.model, cfg.optim, self.mesh,
                 state_sharding=self.state_sharding, data_cfg=cfg.data,
-                health_metrics=cfg.health_metrics)
+                health_metrics=cfg.health_metrics,
+                compile_cache=self.compile_cache)
         self.eval_step = step_lib.make_eval_step(
             self.model_def, cfg.model, self.mesh,
-            state_sharding=self.state_sharding)
-        self.logger = MetricsLogger(
-            cfg.metrics_jsonl, task_index=task_index,
-            tensorboard_dir=(cfg.tensorboard_dir
-                             if jax.process_index() == 0 else None))
+            state_sharding=self.state_sharding,
+            compile_cache=self.compile_cache)
         # Cluster-resilience monitor (parallel/cluster.py): heartbeats,
         # collective watchdog, eviction checks at the dispatch seam.
         # The supervisor passes ONE monitor across restart attempts
@@ -132,13 +149,23 @@ class Trainer:
         self._idx1_sharding = None
         self._resident_idx = None
 
+    def _note_compile_event(self, ev: dict) -> None:
+        """Compile-cache event hook: attribute obtain time (trace +
+        load-or-compile) to the goodput `compile` fraction. Only while a
+        fit()'s tracer is live — pre-loop compiles (init before the
+        tracer epoch) are logged as JSONL events but not attributed."""
+        tracer = self._tracer
+        if tracer is not None and tracer.enabled:
+            tracer.add_secs("compile", ev.get("compile_s") or 0.0)
+
     def init_or_restore(self) -> step_lib.TrainState:
         key = jax.random.key(self.cfg.seed)
         sharding = self.state_sharding if self.state_sharding is not None \
             else mesh_lib.replicated(self.mesh)
         state = step_lib.init_train_state(
             key, self.model_def, self.cfg.model, self.cfg.data,
-            self.cfg.optim, self.mesh, state_sharding=sharding)
+            self.cfg.optim, self.mesh, state_sharding=sharding,
+            compile_cache=self.compile_cache)
 
         def note_fallback(step, path, reason):
             # A skipped candidate during the newest-verifiable walk
@@ -333,7 +360,8 @@ class Trainer:
                 state_sharding=self.state_sharding, data_cfg=cfg.data,
                 index_stream=((cfg.data.seed, cfg.batch_size, k)
                               if dev_stream else None),
-                health_metrics=cfg.health_metrics)
+                health_metrics=cfg.health_metrics,
+                compile_cache=self.compile_cache)
             idx_sh = mesh_lib.batch_sharding(self.mesh, 2, leading_dims=1)
             # Eval also goes resident: boundary train-accuracy is index-fed
             # from the in-HBM train split, test eval is one dispatch over
@@ -346,7 +374,8 @@ class Trainer:
                 self._idx1_sharding, to_global(a))
             self._resident_acc_eval = step_lib.make_batch_eval_resident(
                 self.model_def, cfg.model, self.mesh, ds_images, ds_labels,
-                cfg.data, state_sharding=self.state_sharding)
+                cfg.data, state_sharding=self.state_sharding,
+                compile_cache=self.compile_cache)
             if cfg.eval_full_test_set:
                 # Multi-host included (round 3): each process contributes
                 # its padded strided shard as its slice of the global
@@ -361,7 +390,8 @@ class Trainer:
                     batch_size=per_process_batch,
                     num_shards=num_shards,
                     total_records=test_it.total_records,
-                    expected_batches=test_it.num_padded_sweep_batches())
+                    expected_batches=test_it.num_padded_sweep_batches(),
+                    compile_cache=self.compile_cache)
             else:
                 t_imgs, t_lbls = _full_split_arrays(
                     test_it, lambda: pipe.input_pipeline(
@@ -372,7 +402,8 @@ class Trainer:
                                                 t_lbls.astype(np.int32))
                 self._resident_test_eval = step_lib.make_batch_eval_resident(
                     self.model_def, cfg.model, self.mesh, t_images,
-                    t_labels, cfg.data, state_sharding=self.state_sharding)
+                    t_labels, cfg.data, state_sharding=self.state_sharding,
+                    compile_cache=self.compile_cache)
 
             if dev_stream:
                 def produce():
@@ -577,10 +608,16 @@ class Trainer:
                     # First call traces + compiles before it enqueues
                     # (goodput cat "compile"); steady-state dispatches are
                     # async enqueue — traced but uncategorized, i.e. part
-                    # of the productive-train remainder.
+                    # of the productive-train remainder. With the compile
+                    # cache armed, the cache's own obtain-time events
+                    # carry the compile attribution (via
+                    # _note_compile_event) — the span stays uncategorized
+                    # so the seconds aren't counted twice.
                     with tracer.span("compile_first_dispatch" if first
                                      else "dispatch",
-                                     cat="compile" if first else None):
+                                     cat="compile" if first
+                                     and self.compile_cache is None
+                                     else None):
                         state, metrics = step_fn(state, *batch)
                     if self.cluster is not None:
                         # The dispatch came back: disarm the watchdog.

@@ -60,12 +60,25 @@ def abstractify(tree):
 
 
 def compiled_flops(jitted_fn, abstract_args) -> Optional[float]:
-    """FLOPs of one dispatch from XLA's cost analysis via AOT
-    ``lower().compile()``. NOTE: the AOT path keeps its own executable
-    cache — the first call recompiles (hundreds of ms to seconds for a
-    real train step) even when the call path already compiled, so the
-    driver runs this on a background thread, never inline in the step
-    loop. None when the backend doesn't report flops."""
+    """FLOPs of one dispatch from XLA's cost analysis.
+
+    A cache-wrapped function (``compilecache.CachedFunction``, or the
+    resident-chunk partial's shim) serves the figure from the persistent
+    compile cache — the already-obtained executable's analysis or the
+    entry's recorded one — with NO recompile. The bare AOT fallback
+    ``lower().compile()`` keeps its own executable cache and recompiles
+    (hundreds of ms to seconds for a real train step) even when the call
+    path already compiled, so the driver runs this on a background
+    thread, never inline in the step loop. None when the backend doesn't
+    report flops."""
+    cached = getattr(jitted_fn, "cached_flops", None)
+    if cached is not None:
+        try:
+            flops = cached(abstract_args)
+            if flops and flops > 0:
+                return float(flops)
+        except Exception:
+            pass
     try:
         cost = jitted_fn.lower(*abstract_args).compile().cost_analysis()
         flops = cost.get("flops", 0.0)

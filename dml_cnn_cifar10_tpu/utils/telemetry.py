@@ -129,6 +129,20 @@ class SpanTracer:
         if depth == 0 and cat is not None:
             self._cat_secs[cat] = self._cat_secs.get(cat, 0.0) + dur
 
+    def add_secs(self, cat: str, secs: float) -> None:
+        """Attribute externally-measured seconds to a goodput category
+        without a span — the compile cache reports its obtain time
+        (trace + executable load-or-compile) here, so startup/restart
+        compile cost lands in the `compile` fraction instead of the
+        train-as-remainder bucket even when it happens outside any
+        categorized span (eval-seam first compiles, warm-start loads).
+        Caveat: seconds added while a categorized span is ALSO open are
+        counted in both categories; ``goodput()`` clamps the sum to 1.0,
+        so the overlap only softens the remainder, never inflates it."""
+        if not self.enabled or secs <= 0:
+            return
+        self._cat_secs[cat] = self._cat_secs.get(cat, 0.0) + secs
+
     def drain(self) -> list:
         """Spans finished since the last drain (and forget them)."""
         out = list(self._pending)

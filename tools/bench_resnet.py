@@ -53,11 +53,21 @@ def measure(norm: str, batch: int, k: int, chunks: int, reps: int,
     optim_cfg = OptimConfig(learning_rate=0.1)
     model_def = get_model(name)
 
+    # Persistent compile cache, shared with bench.py's dir convention:
+    # re-runs skip recompiles where the platform allows and the FLOPs
+    # probe below reads the entry's cost analysis instead of paying a
+    # second AOT compile.
+    from bench import _bench_cache_dir
+    from dml_cnn_cifar10_tpu.compilecache import CompileCache
+    cache = (CompileCache(_bench_cache_dir())
+             if _bench_cache_dir() else None)
+
     sh = step_lib.train_state_shardings(mesh, model_def, model_cfg,
                                         data_cfg, optim_cfg)
     state = step_lib.init_train_state(jax.random.key(0), model_def,
                                       model_cfg, data_cfg, optim_cfg, mesh,
-                                      state_sharding=sh)
+                                      state_sharding=sh,
+                                      compile_cache=cache)
 
     # Synthetic uint8 dataset resident in HBM (2 batches worth — the
     # gather indexes modulo n), decoded in-scan (the >1 GB rule).
@@ -71,7 +81,7 @@ def measure(norm: str, batch: int, k: int, chunks: int, reps: int,
     chunk = step_lib.make_train_chunk_resident(
         model_def, model_cfg, optim_cfg, mesh, ds_images, ds_labels,
         state_sharding=sh, data_cfg=data_cfg,
-        index_stream=(0, batch, k))
+        index_stream=(0, batch, k), compile_cache=cache)
 
     state, metrics = chunk(state)
     float(jax.device_get(metrics["loss"]))          # compile + drain
@@ -95,7 +105,8 @@ def measure(norm: str, batch: int, k: int, chunks: int, reps: int,
     # FLOPs from the SCAN-FREE single step (the bench.py convention —
     # exact, no scan-body accounting assumption).
     train_step = step_lib.make_train_step(model_def, model_cfg, optim_cfg,
-                                          mesh, state_sharding=sh)
+                                          mesh, state_sharding=sh,
+                                          compile_cache=cache)
     img_abs = jax.ShapeDtypeStruct((batch, hw, hw, 3), np.float32)
     lab_abs = jax.ShapeDtypeStruct((batch,), np.int32)
     flops = compiled_flops(train_step,
@@ -115,6 +126,10 @@ def measure(norm: str, batch: int, k: int, chunks: int, reps: int,
 
 
 def main():
+    # Before any jax backend use (see compilecache.arm_native_cache).
+    from bench import _bench_cache_dir
+    from dml_cnn_cifar10_tpu.compilecache import arm_native_cache
+    arm_native_cache(_bench_cache_dir() or None)
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--k", type=int, default=20)

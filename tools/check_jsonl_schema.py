@@ -63,6 +63,16 @@ KIND_KEYS = {
     "peer_lost": ("step", "process_id", "reason"),
     "elastic_restart": ("step", "restore_step", "world_size", "epoch",
                         "attempt"),
+    # Compilation cache (compilecache/; docs/COMPILECACHE.md). One
+    # record per compile-seam lookup: `key` is the program fingerprint
+    # (null when no cache is configured but the seam still reports its
+    # compile, e.g. serve warmup), `phase` names the seam (train_step /
+    # train_chunk / train_chunk_resident / init / eval_* /
+    # serve_warmup / analysis), `hit` whether an executable was reused,
+    # `compile_s` the obtain time (trace + load or compile), `source`
+    # one of memory | executable | stablehlo | miss | corrupt | error |
+    # uncached.
+    "compile": ("key", "phase", "hit", "compile_s", "source"),
     # Serving runtime (serve/metrics.py; docs/SERVING.md). Percentile
     # values are null until the window has completions.
     "serve": ("requests", "completed", "shed_queue", "shed_deadline",

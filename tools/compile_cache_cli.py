@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Inspect / verify / prune a persistent compilation cache directory
+(``--compile_cache_dir``, ``compilecache/``; layout and failure modes in
+``docs/COMPILECACHE.md``).
+
+Subcommands (all operate on the flat on-disk layout, no JAX import —
+usable on a machine without the accelerator stack):
+
+- ``inspect DIR`` — one row per committed entry: key, phase, backend,
+  executable/HLO sizes, compile seconds, hit count, last use.
+- ``verify DIR`` — re-digest every entry's executable payload against
+  its sha256 sidecar (the same walk the load path performs); exit 1
+  when any entry fails. Corrupt entries are reported, not deleted —
+  the fail-open load path drops them lazily, and ``prune --corrupt``
+  does it eagerly.
+- ``prune DIR [--max_bytes N] [--corrupt] [--all]`` — apply the LRU
+  size bound offline / drop corrupt entries / wipe the cache.
+
+Usage: ``python tools/compile_cache_cli.py verify /path/to/cache``
+(exit 1 on violation; ``tests/test_compilecache.py`` runs the verify
+smoke in the tier-1 suite).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dml_cnn_cifar10_tpu.compilecache import CompileCache  # noqa: E402
+
+
+def _fmt_bytes(n) -> str:
+    n = n or 0
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024:
+            return f"{n:.0f} {unit}" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def _age(ts) -> str:
+    if not ts:
+        return "-"
+    s = max(0.0, time.time() - float(ts))
+    for div, unit in ((86400, "d"), (3600, "h"), (60, "m")):
+        if s >= div:
+            return f"{s / div:.1f}{unit}"
+    return f"{s:.0f}s"
+
+
+def cmd_inspect(cache: CompileCache) -> int:
+    entries = sorted(cache.entries(),
+                     key=lambda km: km[1].get("last_used", 0),
+                     reverse=True)
+    if not entries:
+        print(f"{cache.cache_dir}: empty cache")
+        return 0
+    total = 0
+    print(f"{'key':<34} {'phase':<22} {'backend':<8} {'exec':>10} "
+          f"{'hlo':>10} {'compile_s':>9} {'hits':>5} {'last_used':>9}")
+    for key, meta in entries:
+        nbytes = cache.entry_bytes(key)
+        total += nbytes
+        print(f"{key:<34} {meta.get('phase') or '-':<22} "
+              f"{meta.get('backend') or '-':<8} "
+              f"{_fmt_bytes(meta.get('exec_bytes')):>10} "
+              f"{_fmt_bytes(meta.get('hlo_bytes')):>10} "
+              f"{meta.get('compile_s') if meta.get('compile_s') is not None else '-':>9} "
+              f"{meta.get('hits') or 0:>5} "
+              f"{_age(meta.get('last_used')):>9}")
+    print(f"{len(entries)} entries, {_fmt_bytes(total)} on disk "
+          f"(bound {_fmt_bytes(cache.max_bytes)})")
+    return 0
+
+
+def cmd_verify(cache: CompileCache) -> int:
+    entries = cache.entries()
+    if not entries:
+        print(f"{cache.cache_dir}: empty cache")
+        return 0
+    bad = 0
+    for key, _ in sorted(entries):
+        ok, reason = cache.verify_entry(key)
+        print(f"{key}: {'OK' if ok else 'CORRUPT'} ({reason})")
+        if not ok:
+            bad += 1
+    print(f"{len(entries) - bad}/{len(entries)} entries verified"
+          + (f"; {bad} CORRUPT (the load path will drop + recompile "
+             f"them; `prune --corrupt` drops them now)" if bad else ""))
+    return 1 if bad else 0
+
+
+def cmd_prune(cache: CompileCache, wipe: bool, corrupt: bool) -> int:
+    entries = cache.entries()
+    before = sum(cache.entry_bytes(k) for k, _ in entries)
+    dropped = 0
+    if wipe:
+        for key, _ in entries:
+            cache.drop(key)
+            dropped += 1
+    else:
+        if corrupt:
+            for key, _ in entries:
+                ok, _reason = cache.verify_entry(key)
+                if not ok:
+                    cache.drop(key)
+                    dropped += 1
+        n_before = len(cache.entries())
+        cache._evict()
+        dropped += n_before - len(cache.entries())
+    after = sum(cache.entry_bytes(k) for k, _ in cache.entries())
+    print(f"pruned {dropped} entr{'y' if dropped == 1 else 'ies'}: "
+          f"{_fmt_bytes(before)} -> {_fmt_bytes(after)} "
+          f"(bound {_fmt_bytes(cache.max_bytes)})")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="compile_cache_cli",
+        description="inspect/verify/prune a --compile_cache_dir")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name in ("inspect", "verify", "prune"):
+        sp = sub.add_parser(name)
+        sp.add_argument("dir", help="cache directory")
+        if name == "prune":
+            sp.add_argument("--max_bytes", type=int, default=None,
+                            help="LRU bound to apply (default: the "
+                                 "config default, 2e9)")
+            sp.add_argument("--corrupt", action="store_true",
+                            help="also drop entries that fail "
+                                 "integrity verification")
+            sp.add_argument("--all", action="store_true",
+                            help="wipe every entry")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.dir):
+        print(f"{args.dir}: not a directory", file=sys.stderr)
+        return 2
+    max_bytes = getattr(args, "max_bytes", None)
+    cache = CompileCache(args.dir,
+                         max_bytes=max_bytes if max_bytes is not None
+                         else 2_000_000_000)
+    if args.cmd == "inspect":
+        return cmd_inspect(cache)
+    if args.cmd == "verify":
+        return cmd_verify(cache)
+    return cmd_prune(cache, wipe=args.all, corrupt=args.corrupt)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

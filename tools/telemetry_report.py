@@ -96,6 +96,36 @@ def summarize(path: str) -> str:
             f"  run-average throughput: {done['images_per_sec']:.1f} "
             f"images/sec (drain-anchored, post-compile)")
 
+    # Compile cost (compilecache/, docs/COMPILECACHE.md): where the
+    # startup/restart compile seconds went and how much the cache saved
+    # — the detail behind the goodput `compile` fraction below.
+    compiles = [r for r in records if r.get("kind") == "compile"]
+    if compiles:
+        hits = [r for r in compiles if r.get("hit")]
+        misses = [r for r in compiles if not r.get("hit")]
+        total_s = sum(r.get("compile_s") or 0.0 for r in compiles)
+        miss_s = sum(r.get("compile_s") or 0.0 for r in misses)
+        lines.append(
+            f"  compile cost: {len(compiles)} seam lookup(s), "
+            f"{len(hits)} hit / {len(misses)} miss, {total_s:.2f} s "
+            f"total ({miss_s:.2f} s compiling)")
+        by_phase = {}
+        for r in compiles:
+            ph = by_phase.setdefault(r.get("phase") or "?",
+                                     {"n": 0, "hits": 0, "s": 0.0})
+            ph["n"] += 1
+            ph["hits"] += 1 if r.get("hit") else 0
+            ph["s"] += r.get("compile_s") or 0.0
+        for phase in sorted(by_phase):
+            d = by_phase[phase]
+            lines.append(f"    {phase:<22} {d['n']:>3} lookup(s)  "
+                         f"{d['hits']:>3} hit  {d['s']:8.2f} s")
+        corrupt = sum(1 for r in compiles if r.get("source") == "corrupt")
+        if corrupt:
+            lines.append(f"    [{corrupt} corrupt cache entr"
+                         f"{'y' if corrupt == 1 else 'ies'} dropped and "
+                         f"recompiled (fail-open)]")
+
     gp = _last(records, "goodput") or _goodput_from_spans(records)
     if gp:
         total = gp.get("total_s") or 0.0
@@ -151,6 +181,14 @@ def summarize(path: str) -> str:
             lines.append(
                 f"    {serve.get('batches')} batches, mean fill "
                 f"{100 * serve['batch_fill']:.1f} %")
+        warm = [r for r in compiles if r.get("phase") == "serve_warmup"]
+        if warm:
+            whits = sum(1 for r in warm if r.get("hit"))
+            wtotal = sum(r.get("compile_s") or 0.0 for r in warm)
+            lines.append(
+                f"    warmup: {len(warm)} bucket(s) ready in "
+                f"{wtotal:.2f} s total ({whits} cache hit(s), "
+                f"{len(warm) - whits} compile(s))")
     # Resilience events (docs/RESILIENCE.md): how many faults the run
     # absorbed, and what the recovery path did about them.
     faults = [r for r in records if r.get("kind") == "fault"]
