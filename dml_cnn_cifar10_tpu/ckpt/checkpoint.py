@@ -305,6 +305,18 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
     return _ckpt_path(ckpt_dir, step, fmt)
 
 
+def checkpoint_path_at_step(ckpt_dir: str,
+                            step: int) -> Optional[str]:
+    """The committed checkpoint at EXACTLY ``step`` (any format), or
+    None. The fleet publisher pins versions to specific steps and must
+    not drift to a neighbor the way latest_checkpoint would."""
+    matches = [(s, fmt) for s, fmt in _checkpoints(ckpt_dir)
+               if s == step]
+    if not matches:
+        return None
+    return _ckpt_path(ckpt_dir, *max(matches))
+
+
 def _restore_one(path: str, target: Any, host_target: Any,
                  sharding=None) -> Any:
     """Restore ONE specific checkpoint into ``target``'s structure;
@@ -407,6 +419,25 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
         f"({'; '.join(skipped)})")
 
 
+def restore_checkpoint_at(path: str, target: Any, sharding=None) -> Any:
+    """Restore ONE SPECIFIC checkpoint path into ``target``'s structure.
+
+    Unlike :func:`restore_checkpoint` there is no newest→oldest walk:
+    the caller already chose the candidate (the serving fleet's
+    hot-swap restores exactly the PUBLISHED version, never "whatever is
+    newest"). Integrity failure or a decode mismatch raises — the
+    hot-swap seam answers by rejecting the candidate and keeping the
+    old weights live.
+    """
+    ok, reason = verify_checkpoint(path)
+    if not ok:
+        raise ValueError(f"checkpoint {path} failed integrity "
+                         f"verification: {reason}")
+    host_target = None if path.endswith(".sharded") \
+        else fetch_to_host(target)
+    return _restore_one(path, target, host_target, sharding=sharding)
+
+
 class CheckpointManager:
     """Periodic chief-only saver (the CheckpointSaverHook role).
 
@@ -416,16 +447,23 @@ class CheckpointManager:
     the msgpack encode and file IO run on a single background writer
     thread. Saves stay ordered (a new save first drains the previous one);
     writer exceptions surface at the next ``maybe_save``/``flush``.
+
+    ``on_committed(step, path)`` — optional chief-only callback invoked
+    AFTER a checkpoint and its integrity sidecar are fully committed
+    (on the writer thread under ``async_save``). The trainer's fleet
+    publish hook rides it: publishing before the sidecar lands would
+    hand serve workers a version they must reject.
     """
 
     def __init__(self, ckpt_dir: str, every_steps: int, keep: int = 3,
                  is_chief: Optional[bool] = None, async_save: bool = False,
                  every_secs: Optional[float] = None,
-                 fmt: str = "msgpack", logger=None):
+                 fmt: str = "msgpack", logger=None, on_committed=None):
         self.ckpt_dir = ckpt_dir
         self.every_steps = max(1, every_steps)
         self.keep = keep
         self.fmt = fmt
+        self.on_committed = on_committed
         # Optional MetricsLogger-shaped sink for checkpoint-maintenance
         # events (ckpt_prune_error); the writer thread may call it.
         self.logger = logger
@@ -561,6 +599,8 @@ class CheckpointManager:
                                  logger=self.logger)
             if data_state is not None:
                 save_data_state(self.ckpt_dir, step, data_state)
+            if self.on_committed is not None:
+                self.on_committed(step, path)
 
     def _write_with_sidecar(self, host_state: Any, step: int,
                             data_state: Optional[dict]) -> str:
@@ -569,4 +609,6 @@ class CheckpointManager:
                                  logger=self.logger)
         if data_state is not None:
             save_data_state(self.ckpt_dir, step, data_state)
+        if self.on_committed is not None:
+            self.on_committed(step, path)
         return path

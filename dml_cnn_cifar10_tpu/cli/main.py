@@ -83,13 +83,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "step cadence (the reference's MTS saved every "
                         "600 s by default)")
     p.add_argument("--mode", type=str, default="train",
-                   choices=["train", "eval", "export", "serve"],
+                   choices=["train", "eval", "export", "serve", "fleet"],
                    help="train; eval = restore latest checkpoint and sweep "
                         "the full test split; export = restore and write a "
                         "self-contained jax.export serving artifact; serve "
                         "= run the micro-batching inference engine over "
                         "the artifact (or latest checkpoint) behind an "
-                        "HTTP endpoint (docs/SERVING.md)")
+                        "HTTP endpoint; fleet = router + N replicated "
+                        "serve workers with heartbeat liveness, "
+                        "zero-downtime checkpoint hot-swap, and a "
+                        "closed-loop autoscaler (docs/SERVING.md)")
     p.add_argument("--export_path", type=str, default=None,
                    help="output file for --mode export "
                         "(default <log_dir>/model.jaxexport)")
@@ -121,6 +124,42 @@ def build_parser() -> argparse.ArgumentParser:
                         "SIGTERM/SIGINT stop accepting, let queued "
                         "batches finish for at most this long, shed the "
                         "rest, flush metrics, exit 0")
+    p.add_argument("--serve_slo_ms", type=float, default=None,
+                   help="p99 latency objective in ms; the fleet "
+                        "autoscaler scales up while the replicas' p99 "
+                        "sits above it (declarative elsewhere)")
+    p.add_argument("--fleet_min_replicas", type=int, default=2,
+                   help="serving-fleet floor: the pool starts this many "
+                        "workers and a fleet below it always scales "
+                        "back up (self-healing after a worker death)")
+    p.add_argument("--fleet_max_replicas", type=int, default=4,
+                   help="serving-fleet ceiling for the autoscaler")
+    p.add_argument("--fleet_port", type=int, default=8100,
+                   help="router HTTP port for --mode fleet (0 = "
+                        "ephemeral; workers always bind ephemeral ports "
+                        "and advertise them via heartbeats)")
+    p.add_argument("--fleet_dir", type=str, default=None,
+                   help="fleet coordination directory (heartbeats, "
+                        "published-version file, per-replica telemetry); "
+                        "default <log_dir>/fleet. Shared filesystem in "
+                        "production, a tmpdir in tests")
+    p.add_argument("--fleet_autoscale", type="bool", default=True,
+                   help="closed-loop autoscaling from the replicas' "
+                        "serve JSONL windows (queue depth, shed "
+                        "fraction, p99 vs --serve_slo_ms); false pins "
+                        "the fleet at --fleet_min_replicas (deaths are "
+                        "still replaced)")
+    p.add_argument("--fleet_replica_dead_after_s", type=float,
+                   default=3.0,
+                   help="a worker whose newest heartbeat is older than "
+                        "this is evicted from routing and its in-flight "
+                        "requests re-routed to surviving replicas")
+    p.add_argument("--fleet_publish", type="bool", default=False,
+                   help="trainer-side hot-swap publish hook: every "
+                        "committed checkpoint (with its integrity "
+                        "sidecar) is published to the fleet dir so live "
+                        "serve workers swap to it between micro-batches "
+                        "(the online train-and-serve scenario)")
     p.add_argument("--learning_rate", type=float, default=0.1)
     p.add_argument("--fidelity", type=str, default="faithful",
                    choices=["faithful", "fixed"],
@@ -557,6 +596,20 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.serve.artifact_path = args.serve_artifact
     cfg.serve.metrics_every_s = args.serve_metrics_every_s
     cfg.serve.drain_deadline_s = args.serve_drain_deadline_s
+    cfg.serve.slo_ms = args.serve_slo_ms
+    if args.fleet_min_replicas < 1 \
+            or args.fleet_max_replicas < args.fleet_min_replicas:
+        raise SystemExit(
+            f"--fleet_min_replicas/--fleet_max_replicas must satisfy "
+            f"1 <= min <= max, got {args.fleet_min_replicas}/"
+            f"{args.fleet_max_replicas}")
+    cfg.fleet.min_replicas = args.fleet_min_replicas
+    cfg.fleet.max_replicas = args.fleet_max_replicas
+    cfg.fleet.port = args.fleet_port
+    cfg.fleet.dir = args.fleet_dir
+    cfg.fleet.autoscale = args.fleet_autoscale
+    cfg.fleet.replica_dead_after_s = args.fleet_replica_dead_after_s
+    cfg.fleet.publish = args.fleet_publish
     # The worker set also names the cluster-resilience world: process_id
     # feeds chiefness (multihost.is_chief) and the heartbeat identity
     # even when jax.distributed never initializes (the lockstep CPU
@@ -662,6 +715,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.mode == "serve":
         from dml_cnn_cifar10_tpu.serve.server import main_serve
         return main_serve(cfg, task_index=args.task_index)
+
+    if args.mode == "fleet":
+        from dml_cnn_cifar10_tpu.fleet.controller import main_fleet
+        return main_fleet(cfg)
 
     if cfg.supervise:
         from dml_cnn_cifar10_tpu.train.supervisor import fit_supervised

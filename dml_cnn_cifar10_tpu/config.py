@@ -369,6 +369,71 @@ class ServeConfig:
     # long, sheds the remainder, flushes metrics, and exits 0
     # (serve/server.py; reuses PreemptionGuard).
     drain_deadline_s: float = 5.0
+    # Latency objective for the serving path (milliseconds at p99).
+    # Purely declarative for a single server; under --mode fleet the
+    # autoscaler treats a p99 above it as a scale-up signal
+    # (fleet/autoscaler.py). None = no objective.
+    slo_ms: Optional[float] = None
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Serving fleet (``--mode fleet``, ``fleet/`` package).
+
+    One router/load-balancer process fronting N serve worker replicas
+    (each a :class:`~serve.engine.ServingEngine` subprocess), with
+    heartbeat liveness, zero-downtime checkpoint hot-swap, and a
+    closed-loop autoscaler — docs/SERVING.md fleet section.
+    """
+
+    # Replica count bounds the autoscaler operates within. The pool
+    # starts min_replicas workers; a fleet below min is always scaled
+    # back up (the self-healing path after a worker death).
+    min_replicas: int = 2
+    max_replicas: int = 4
+    # Router HTTP port (0 = ephemeral, printed at startup). Workers
+    # always bind ephemeral ports and advertise them via heartbeats.
+    port: int = 8100
+    # Fleet coordination directory (heartbeats, the published-version
+    # file, per-replica telemetry). None = <log_dir>/fleet. Shared
+    # filesystem in production, a tmpdir in tests — same contract as
+    # --cluster_dir.
+    dir: Optional[str] = None
+    # Worker beat cadence and the staleness threshold past which the
+    # router evicts a replica and re-routes its traffic. Beats carry
+    # {replica_id, version, queue_depth, phase, port}.
+    heartbeat_interval_s: float = 0.25
+    replica_dead_after_s: float = 3.0
+    # Worker-side poll cadence on the published-version file, and
+    # publisher-side watch cadence on the checkpoint dir.
+    swap_poll_s: float = 0.25
+    publish_poll_s: float = 0.5
+    # Trainer-side publish hook: when true, every committed checkpoint
+    # (integrity sidecar included) is published to <fleet dir> for the
+    # online train-and-serve scenario (train/loop.py). The fleet's own
+    # directory publisher watches the checkpoint dir regardless.
+    publish: bool = False
+    # Closed-loop autoscaler (fleet/autoscaler.py): decision cadence,
+    # post-decision cooldown, and the queue-depth-per-replica level
+    # treated as a scale-up signal. Decisions additionally key on shed
+    # fraction and p99 vs serve.slo_ms from the replicas' serve JSONL
+    # windows. autoscale=False pins the fleet at min_replicas (deaths
+    # are still replaced — below-min always scales up).
+    autoscale: bool = True
+    autoscale_every_s: float = 2.0
+    scale_cooldown_s: float = 10.0
+    scale_up_queue_depth: float = 8.0
+    # Max re-route attempts for one client request before the router
+    # sheds it (each failed attempt also evicts the failing replica).
+    route_retries: int = 3
+    # Per-attempt router->worker proxy timeout.
+    route_timeout_s: float = 30.0
+    # Cadence of `fleet` JSONL window records from the router.
+    metrics_every_s: float = 2.0
+    # Test/drill hook: "<replica_id>:<kind>@<n>" arms utils/faults.py
+    # kind (host_lost | heartbeat_stall) on that replica after n batch
+    # dispatches — the fleet analogue of --fault_spec. None disables.
+    worker_fault: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -509,6 +574,37 @@ class TrainConfig:
     optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+
+
+#: TrainConfig's nested dataclass fields, the single list the JSON
+#: round-trip below and any future config tooling derive from.
+_SUBCONFIGS = {"data": DataConfig, "model": ModelConfig,
+               "optim": OptimConfig, "parallel": ParallelConfig,
+               "serve": ServeConfig, "fleet": FleetConfig}
+
+
+def config_to_dict(cfg: TrainConfig) -> dict:
+    """Plain-JSON-serializable dict of the full config tree. The fleet
+    controller ships worker configs through this (one file, no CLI
+    re-marshalling); ``config_from_dict`` inverts it."""
+    return dataclasses.asdict(cfg)
+
+
+def config_from_dict(d: dict) -> TrainConfig:
+    """Rebuild a :class:`TrainConfig` from :func:`config_to_dict`
+    output. Unknown keys fail loudly (a version-skewed worker must not
+    silently drop a knob it was asked to honor)."""
+    kw = {}
+    for k, v in d.items():
+        if k in _SUBCONFIGS:
+            kw[k] = _SUBCONFIGS[k](**v)
+        else:
+            kw[k] = v
+    cfg = TrainConfig(**kw)
+    # JSON has no tuples; restore the fields typed as such.
+    cfg.serve.buckets = tuple(cfg.serve.buckets)
+    return cfg
 
 
 def reference_config(**overrides) -> TrainConfig:

@@ -53,6 +53,36 @@ def make_serving_fn(model_def: ModelDef, model_cfg: ModelConfig,
     return fn
 
 
+def make_variable_serving_fn(model_def: ModelDef, model_cfg: ModelConfig,
+                             data_cfg: DataConfig):
+    """``fn((params, model_state), images_u8) -> logits`` — the same
+    eval forward as :func:`make_serving_fn` with the weights passed as
+    ARGUMENTS instead of closed over. One jit of this function serves
+    every checkpoint of the same model config: swapping weights is a
+    pytree replacement with no recompile, which is what makes the
+    serving fleet's checkpoint hot-swap zero-downtime
+    (``serve/engine.py::ServingEngine.try_swap``)."""
+    from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
+
+    eval_cfg = data_cfg.without_augmentation()
+
+    def fn(variables, images_u8):
+        params, model_state = variables
+        images = device_preprocess(images_u8, eval_cfg)
+        if model_def.has_state:
+            logits, _ = model_def.apply(params, model_state, images,
+                                        model_cfg, train=False)
+        elif model_def.has_aux:
+            logits, _ = model_def.apply(params, images, model_cfg,
+                                        train=False)
+        else:
+            logits = model_def.apply(params, images, model_cfg,
+                                     train=False)
+        return logits
+
+    return fn
+
+
 def export_forward(model_def: ModelDef, model_cfg: ModelConfig,
                    data_cfg: DataConfig, params: Any,
                    model_state: Any = None,

@@ -1,0 +1,33 @@
+"""The serving fleet: replicated workers behind a router, with
+zero-downtime checkpoint hot-swap and closed-loop autoscaling.
+
+``serve/`` is one engine in one process; this package is the layer
+that makes it a deployment (``--mode fleet``, docs/SERVING.md):
+
+- ``controller.py`` — ``main_fleet``: owns the worker subprocess pool,
+  the router threads, the checkpoint publisher, and the autoscaler
+  control loop.
+- ``router.py`` — heartbeat-discovered membership, least-queue-depth
+  placement, eviction + in-flight re-route on worker death.
+- ``worker.py`` — one serve replica: engine + batcher + HTTP plus
+  heartbeats and the hot-swap watcher.
+- ``publisher.py`` — which checkpoint version the fleet serves
+  (integrity-sidecar-gated, atomic, monotone).
+- ``autoscaler.py`` — the pure decision table over the replicas' own
+  serve JSONL metrics.
+
+The ingredients are deliberately reused, not reinvented:
+``parallel/cluster.py`` heartbeats carry the fleet's liveness (the
+beat payload generalized to ``extra``), PR-3 integrity sidecars gate
+what is publishable, and the PR-5 compile cache is what makes replica
+spin-up cheap enough for an autoscaler to be worth closing the loop.
+"""
+
+from dml_cnn_cifar10_tpu.fleet.autoscaler import (FleetSignals,  # noqa: F401
+                                                  ScaleDecision, decide)
+from dml_cnn_cifar10_tpu.fleet.publisher import (  # noqa: F401
+    DirectoryPublisher, PublishedVersion, publish_checkpoint,
+    read_published)
+from dml_cnn_cifar10_tpu.fleet.router import (ReplicaView,  # noqa: F401
+                                              Router, live_views,
+                                              pick_replica)

@@ -1,0 +1,139 @@
+"""Closed-loop autoscaling from the fleet's own telemetry.
+
+The signals are the serving metrics that already exist — nothing new is
+measured. Each worker appends ``serve`` window records to its own JSONL
+stream (``<fleet_dir>/telemetry/replica_<id>.jsonl``) and advertises
+its queue depth in every heartbeat; the autoscaler tails the streams,
+aggregates one :class:`FleetSignals`, and feeds it to the pure decision
+function :func:`decide`:
+
+==================================  ===========================  ======
+condition                           reading                      action
+==================================  ===========================  ======
+replicas below ``min_replicas``     a worker died / fleet young  up
+shed fraction > ``shed_up``         admission control rejecting  up
+p99 above ``serve.slo_ms``          latency objective violated   up
+queue depth/replica > threshold     backpressure building        up
+all quiet and above ``min``         paying for idle capacity     down
+otherwise                           steady                       hold
+==================================  ===========================  ======
+
+Up-conditions are checked against ``max_replicas`` and include workers
+still warming up (``starting``) so a slow spin-up is not answered with
+a second, third, fourth spawn. Scale-down retires ONE replica per
+decision and only when every signal is quiet — capacity exits slowly,
+enters fast (the standard asymmetry: shedding user traffic costs more
+than an idle worker). The controller enforces a post-action cooldown so
+the loop measures the effect of one action before taking another.
+
+``decide`` is a pure function of its inputs — the decision table above
+IS the unit test (``tests/test_fleet.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+#: Shed fraction above which the fleet scales up (admission control is
+#: actively rejecting traffic — the loudest signal).
+SHED_UP = 0.01
+#: Scale-down requires p99 below this fraction of the SLO (when one is
+#: configured): "comfortably inside", not "barely passing".
+SLO_DOWN_FRACTION = 0.5
+#: Scale-down also requires mean queue depth per replica below this.
+QUIET_QUEUE_DEPTH = 1.0
+
+
+@dataclasses.dataclass
+class FleetSignals:
+    """One aggregated reading of the fleet's load state."""
+
+    live: int                 # replicas in the routing rotation
+    starting: int             # spawned, not yet phase=serve
+    mean_queue_depth: float   # per live replica, from heartbeats
+    shed_fraction: float      # across replicas' last serve windows
+    p99_ms: Optional[float]   # worst replica's last-window p99
+
+
+@dataclasses.dataclass
+class ScaleDecision:
+    action: str               # "up" | "down" | "hold"
+    reason: str
+
+
+def decide(signals: FleetSignals, min_replicas: int, max_replicas: int,
+           slo_ms: Optional[float] = None,
+           scale_up_queue_depth: float = 8.0) -> ScaleDecision:
+    """The decision table (module docstring). Pure — no IO, no clock."""
+    total = signals.live + signals.starting
+    if total < min_replicas:
+        return ScaleDecision("up", "below_min")
+    if signals.live > 0 and total < max_replicas:
+        if signals.shed_fraction > SHED_UP:
+            return ScaleDecision("up", "shedding")
+        if slo_ms is not None and signals.p99_ms is not None \
+                and signals.p99_ms > slo_ms:
+            return ScaleDecision("up", "slo_violation")
+        if signals.mean_queue_depth > scale_up_queue_depth:
+            return ScaleDecision("up", "queue_depth")
+    if total > min_replicas and signals.starting == 0 \
+            and signals.shed_fraction == 0.0 \
+            and signals.mean_queue_depth < QUIET_QUEUE_DEPTH \
+            and (slo_ms is None or signals.p99_ms is None
+                 or signals.p99_ms < SLO_DOWN_FRACTION * slo_ms):
+        return ScaleDecision("down", "idle")
+    return ScaleDecision("hold", "steady")
+
+
+def last_serve_window(jsonl_path: str,
+                      tail_bytes: int = 65536) -> Optional[dict]:
+    """The newest ``serve`` window record in a replica's JSONL stream
+    (tail-read — these files grow for the life of the worker)."""
+    try:
+        size = os.path.getsize(jsonl_path)
+        with open(jsonl_path, "rb") as f:
+            f.seek(max(0, size - tail_bytes))
+            chunk = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    for line in reversed(chunk.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue   # the seek may have landed mid-line
+        if rec.get("kind") == "serve":
+            return rec
+    return None
+
+
+def aggregate_signals(live_views, starting: int,
+                      telemetry_dir: str) -> FleetSignals:
+    """Fold the live replicas' heartbeat payloads + last serve windows
+    into one :class:`FleetSignals`."""
+    live = list(live_views)
+    depths = [v.queue_depth for v in live]
+    shed = completed = 0
+    p99 = None
+    for v in live:
+        rec = last_serve_window(os.path.join(
+            telemetry_dir, f"replica_{v.replica_id}.jsonl"))
+        if rec is None:
+            continue
+        completed += (rec.get("completed") or 0)
+        shed += (rec.get("shed_queue") or 0) + (rec.get("shed_deadline")
+                                                or 0)
+        if rec.get("p99_ms") is not None:
+            p99 = rec["p99_ms"] if p99 is None else max(p99,
+                                                        rec["p99_ms"])
+    total = completed + shed
+    return FleetSignals(
+        live=len(live), starting=int(starting),
+        mean_queue_depth=(sum(depths) / len(depths)) if depths else 0.0,
+        shed_fraction=(shed / total) if total else 0.0,
+        p99_ms=p99)

@@ -1,0 +1,166 @@
+"""Checkpoint publishing: which weights version the fleet should serve.
+
+One small piece of shared truth, same rules as every other piece in
+this repo (checkpoints, restart decisions): a JSON file committed by
+atomic rename with a monotone sequence number, pollable by any number
+of readers without locks.
+
+Two producers write it:
+
+- the **trainer-side hook** (``--fleet_publish``,
+  ``train/loop.py`` → :func:`publish_checkpoint`) — publishes each
+  checkpoint the moment its integrity sidecar commits, the online
+  train-and-serve path;
+- the **directory publisher** (:class:`DirectoryPublisher`, started by
+  the fleet controller) — polls the checkpoint dir so checkpoints
+  dropped there by anything else (a separate trainer, a copy from
+  another cluster) get published too.
+
+Both gate on the PR-3 integrity sidecars, and STRICTER than restore
+does: restore tolerates a missing sidecar (pre-integrity checkpoints
+must stay restorable), but publishing one would hand every serve
+worker a version it cannot verify — so no sidecar means not
+publishable. A checkpoint that fails verification is skipped (and
+remembered, so the watcher does not re-hash it every poll).
+
+Workers poll :func:`read_published` (``fleet/worker.py``) and hot-swap
+when ``seq`` advances; the version string is the checkpoint step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+
+PUBLISHED_FILE = "published.json"
+
+
+def fleet_coord_dir(cfg) -> str:
+    """The fleet's shared coordination directory (heartbeats, the
+    published-version file, per-replica telemetry): ``cfg.fleet.dir``
+    or ``<log_dir>/fleet``."""
+    return cfg.fleet.dir or os.path.join(cfg.log_dir, "fleet")
+
+
+@dataclasses.dataclass
+class PublishedVersion:
+    seq: int          # monotone publish counter (swap trigger)
+    version: str      # the tag responses will carry (checkpoint step)
+    step: int
+    path: str         # the checkpoint to restore
+    published_at: float
+
+
+def read_published(fleet_dir: str) -> Optional[PublishedVersion]:
+    """Latest published version, or None (no publish yet; torn reads
+    self-heal on the next poll, like heartbeats)."""
+    try:
+        with open(os.path.join(fleet_dir, PUBLISHED_FILE)) as f:
+            return PublishedVersion(**json.load(f))
+    except (OSError, ValueError, TypeError):
+        return None
+
+
+def publishable(path: str) -> tuple:
+    """(ok, reason) — stricter than restore's verify: the sidecar must
+    EXIST and match. See the module docstring for why."""
+    if not os.path.exists(ckpt_lib.checksum_path(path)):
+        return False, "no integrity sidecar"
+    return ckpt_lib.verify_checkpoint(path)
+
+
+def publish_checkpoint(fleet_dir: str, ckpt_path: str, step: int,
+                       logger=None) -> Optional[PublishedVersion]:
+    """Gate on the integrity sidecar, then commit ``published.json``
+    (atomic rename, monotone seq). Returns the published record, or
+    None when the candidate was rejected or is not newer than what is
+    already published."""
+    ok, reason = publishable(ckpt_path)
+    if not ok:
+        print(f"[fleet] NOT publishing {ckpt_path}: {reason}")
+        return None
+    prior = read_published(fleet_dir)
+    if prior is not None and prior.step >= step:
+        return None
+    rec = PublishedVersion(
+        seq=(prior.seq + 1) if prior is not None else 1,
+        version=str(step), step=int(step), path=os.path.abspath(ckpt_path),
+        published_at=time.time())
+    os.makedirs(fleet_dir, exist_ok=True)
+    target = os.path.join(fleet_dir, PUBLISHED_FILE)
+    tmp = target + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(dataclasses.asdict(rec), f)
+    os.replace(tmp, target)
+    if logger is not None:
+        logger.log("fleet_publish", seq=rec.seq, version=rec.version,
+                   step=rec.step, path=rec.path)
+    print(f"[fleet] published version {rec.version} (seq {rec.seq}): "
+          f"{ckpt_path}")
+    return rec
+
+
+class DirectoryPublisher(threading.Thread):
+    """Watch a checkpoint dir; publish each new verifiable checkpoint.
+
+    Polling, not inotify: the checkpoint dir may be NFS/GCS-fuse where
+    file-event APIs don't exist — the same reasoning as the heartbeat
+    store.
+    Checkpoints that fail the publish gate are remembered per (step,
+    mtime) so a corrupt file is not re-hashed every poll but a repaired
+    one (re-copied with a fresh sidecar) is re-considered.
+    """
+
+    def __init__(self, ckpt_dir: str, fleet_dir: str,
+                 poll_s: float = 0.5, logger=None):
+        super().__init__(name="fleet-publisher", daemon=True)
+        self.ckpt_dir = ckpt_dir
+        self.fleet_dir = fleet_dir
+        self.poll_s = poll_s
+        self.logger = logger
+        self._stop = threading.Event()
+        self._rejected = set()   # (step, sidecar_mtime) seen-bad cache
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def scan_once(self) -> Optional[PublishedVersion]:
+        """One watch pass: publish the newest publishable checkpoint
+        beyond the current published step (also called directly by
+        tests — the poll loop is just this on a timer)."""
+        prior = read_published(self.fleet_dir)
+        floor = prior.step if prior is not None else -1
+        steps = sorted(ckpt_lib.all_checkpoint_steps(self.ckpt_dir),
+                       reverse=True)
+        for step in steps:
+            if step <= floor:
+                break
+            path = ckpt_lib.checkpoint_path_at_step(self.ckpt_dir, step)
+            if path is None:
+                continue
+            sidecar = ckpt_lib.checksum_path(path)
+            try:
+                key = (step, os.path.getmtime(sidecar))
+            except OSError:
+                key = (step, None)
+            if key in self._rejected:
+                continue
+            rec = publish_checkpoint(self.fleet_dir, path, step,
+                                     logger=self.logger)
+            if rec is not None:
+                return rec
+            self._rejected.add(key)
+        return None
+
+    def run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.scan_once()
+            except Exception as e:   # keep watching; a bad pass is not fatal
+                print(f"[fleet] publisher scan error: {e!r}")

@@ -1,0 +1,368 @@
+"""The fleet's front door: route, balance, evict, re-route.
+
+A deliberately thin HTTP proxy (stdlib ``ThreadingHTTPServer``, same
+transport reasoning as ``serve/server.py``) in front of N serve worker
+replicas. The router holds NO model state — its job is membership and
+placement:
+
+- **Discovery** — workers advertise themselves by heartbeat
+  (:class:`~dml_cnn_cifar10_tpu.parallel.cluster.HeartbeatStore` under
+  ``<fleet_dir>``, beats carrying ``{replica_id, version, queue_depth,
+  phase, port}``). Anyone who beats with ``phase == "serve"`` is in the
+  rotation; the router never needs a static member list, which is what
+  lets the autoscaler add workers by just spawning them.
+- **Placement** — least ``queue_depth`` first (the beat payload), round
+  robin among ties: cheap, heartbeat-driven load awareness without a
+  second RPC.
+- **Eviction** — a replica whose newest beat is older than
+  ``replica_dead_after_s``, or that fails at the socket, leaves the
+  rotation immediately (``peer_lost`` JSONL, ``reason
+  replica_evicted_*``). Its in-flight requests are NOT failed back to
+  the client: the proxy attempt that broke is retried on a surviving
+  replica (``route_retries``), so a worker kill under load costs zero
+  client errors — the tier-1 acceptance pin (``tests/test_fleet.py``).
+- **Shed passthrough** — a worker 503 (its admission control) is
+  returned to the client as-is, NOT retried: overload must surface as
+  shed, not as the router amplifying the load 3x by re-submitting it.
+
+The decision logic (:func:`live_views`, :func:`pick_replica`) is pure —
+unit-testable without sockets or processes; the HTTP machinery is a
+shell around it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence
+
+from dml_cnn_cifar10_tpu.parallel.cluster import HeartbeatStore
+
+
+@dataclasses.dataclass
+class ReplicaView:
+    """One replica as the router sees it, built from its latest beat."""
+
+    replica_id: int
+    port: Optional[int]
+    version: Optional[str]
+    queue_depth: int
+    phase: str
+    age_s: float
+
+
+def view_from_beat(beat, now: Optional[float] = None) -> ReplicaView:
+    extra = beat.extra or {}
+    return ReplicaView(
+        replica_id=beat.process_id,
+        port=extra.get("port"),
+        version=extra.get("version"),
+        queue_depth=int(extra.get("queue_depth") or 0),
+        phase=beat.phase,
+        age_s=beat.age_s(now))
+
+
+def live_views(views: Sequence[ReplicaView], dead_after_s: float,
+               exclude=()) -> List[ReplicaView]:
+    """Routable replicas: beating recently, past warmup (phase
+    ``serve``), with an advertised port, and not excluded (evicted /
+    draining / already tried for this request)."""
+    return [v for v in views
+            if v.replica_id not in exclude
+            and v.phase == "serve"
+            and v.port
+            and v.age_s <= dead_after_s]
+
+
+def pick_replica(live: Sequence[ReplicaView],
+                 rr: int) -> Optional[ReplicaView]:
+    """Least queue depth wins; ``rr`` (the caller's monotone request
+    counter) breaks ties round-robin so equally-idle replicas share
+    load instead of the lowest id eating all of it."""
+    if not live:
+        return None
+    min_depth = min(v.queue_depth for v in live)
+    tied = [v for v in live if v.queue_depth == min_depth]
+    return tied[rr % len(tied)]
+
+
+class _RouterWindow:
+    __slots__ = ("routed", "rerouted", "evictions", "shed",
+                 "by_version", "t0")
+
+    def __init__(self):
+        self.routed = 0
+        self.rerouted = 0
+        self.evictions = 0
+        self.shed = 0
+        self.by_version: Dict[str, int] = {}
+        self.t0 = time.perf_counter()
+
+
+class RouterMetrics:
+    """Routing counters, windowed + cumulative — the same dual view as
+    ``serve/metrics.py``: each periodic ``fleet`` record is a true
+    per-window delta (summable by the report), ``fleet_done`` is the
+    run-cumulative total."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._win = _RouterWindow()
+        self._total = _RouterWindow()
+
+    def _bump(self, field: str, version: Optional[str] = None) -> None:
+        with self._lock:
+            for w in (self._win, self._total):
+                setattr(w, field, getattr(w, field) + 1)
+                if version is not None:
+                    w.by_version[version] = \
+                        w.by_version.get(version, 0) + 1
+
+    def record_routed(self, version: Optional[str]) -> None:
+        self._bump("routed", version)
+
+    def record_rerouted(self) -> None:
+        self._bump("rerouted")
+
+    def record_eviction(self) -> None:
+        self._bump("evictions")
+
+    def record_shed(self) -> None:
+        self._bump("shed")
+
+    @property
+    def total_routed(self) -> int:
+        with self._lock:
+            return self._total.routed
+
+    @staticmethod
+    def _snap(w: _RouterWindow, replicas: int, live: int,
+              now: float) -> dict:
+        return {"replicas": replicas, "live": live,
+                "routed": w.routed, "rerouted": w.rerouted,
+                "evictions": w.evictions, "shed": w.shed,
+                "version_mix": dict(w.by_version),
+                "window_s": round(now - w.t0, 3)}
+
+    def window(self, replicas: int, live: int) -> dict:
+        """Counts since the last window (the periodic fleet record)."""
+        with self._lock:
+            out = self._snap(self._win, replicas, live,
+                             time.perf_counter())
+            self._win = _RouterWindow()
+        return out
+
+    def cumulative(self, replicas: int, live: int) -> dict:
+        with self._lock:
+            return self._snap(self._total, replicas, live,
+                              time.perf_counter())
+
+
+class Router:
+    """Membership + placement + the proxy loop (see module docstring)."""
+
+    def __init__(self, fleet_dir: str, dead_after_s: float = 3.0,
+                 route_retries: int = 3, route_timeout_s: float = 30.0,
+                 logger=None, host: str = "127.0.0.1"):
+        # process_id -1: the router reads every beat but publishes none.
+        self.store = HeartbeatStore(fleet_dir, process_id=-1)
+        self.dead_after_s = dead_after_s
+        self.route_retries = max(1, int(route_retries))
+        self.route_timeout_s = route_timeout_s
+        self.logger = logger
+        self.host = host
+        self.metrics = RouterMetrics()
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._evicted: set = set()    # replica ids out of rotation
+        self._draining: set = set()   # retiring: no NEW requests
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    # -- membership -----------------------------------------------------
+
+    def views(self, now: Optional[float] = None) -> List[ReplicaView]:
+        beats = self.store.read_all()
+        return [view_from_beat(b, now) for pid, b in sorted(beats.items())
+                if pid >= 0]
+
+    def live(self, extra_exclude=()) -> List[ReplicaView]:
+        with self._lock:
+            exclude = self._evicted | self._draining | set(extra_exclude)
+        views = self.views()
+        alive = live_views(views, self.dead_after_s, exclude=exclude)
+        # Staleness-driven eviction: a replica that WAS routable but
+        # stopped beating leaves the rotation here (socket errors evict
+        # via evict() directly).
+        with self._lock:
+            known = {v.replica_id for v in views}
+            stale = [v.replica_id for v in views
+                     if v.phase == "serve"
+                     and v.age_s > self.dead_after_s
+                     and v.replica_id not in self._evicted]
+        for rid in stale:
+            self.evict(rid, "replica_evicted_stale_heartbeat")
+        return [v for v in alive if v.replica_id in known]
+
+    def evict(self, replica_id: int, reason: str) -> None:
+        with self._lock:
+            if replica_id in self._evicted:
+                return
+            self._evicted.add(replica_id)
+        self.metrics.record_eviction()
+        if self.logger is not None:
+            self.logger.log("peer_lost", step=self.metrics.total_routed,
+                            process_id=replica_id, reason=reason)
+        print(f"[fleet] evicted replica {replica_id} ({reason})")
+
+    def drain_replica(self, replica_id: int) -> None:
+        """Retirement half-step: stop routing NEW requests to the
+        replica while its in-flight work finishes (the worker's own
+        SIGTERM drain completes it)."""
+        with self._lock:
+            self._draining.add(replica_id)
+
+    def forget(self, replica_id: int) -> None:
+        """Drop a retired replica's bookkeeping once its process is
+        gone (so a reused id, which the pool never does, would not be
+        born evicted)."""
+        with self._lock:
+            self._evicted.discard(replica_id)
+            self._draining.discard(replica_id)
+
+    # -- the proxy ------------------------------------------------------
+
+    def proxy_predict(self, body: bytes) -> tuple:
+        """Route one request; returns ``(status, payload_dict)``.
+
+        Worker failure at the socket (refused / reset mid-read /
+        timeout) evicts that replica and retries the SAME body on the
+        next pick — the re-route that turns a worker kill into zero
+        client errors. Worker 4xx/5xx HTTP answers pass through (they
+        are the worker speaking, not dying).
+        """
+        tried: set = set()
+        for attempt in range(self.route_retries + 1):
+            with self._lock:
+                rr = self._rr
+                self._rr += 1
+            target = pick_replica(self.live(extra_exclude=tried), rr)
+            if target is None:
+                self.metrics.record_shed()
+                return 503, {"shed": "no_live_replicas"}
+            if attempt:
+                self.metrics.record_rerouted()
+            req = urllib.request.Request(
+                f"http://{self.host}:{target.port}/predict", data=body,
+                headers={"Content-Type": "application/octet-stream"})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.route_timeout_s) as resp:
+                    payload = json.loads(resp.read())
+                self.metrics.record_routed(payload.get("version"))
+                payload["replica_id"] = target.replica_id
+                return 200, payload
+            except urllib.error.HTTPError as e:
+                # The worker answered: shed/size errors pass through
+                # untouched (retrying a 503 would amplify overload).
+                try:
+                    payload = json.loads(e.read())
+                except Exception:
+                    payload = {"error": f"worker http {e.code}"}
+                if e.code == 503:
+                    self.metrics.record_shed()
+                return e.code, payload
+            except (urllib.error.URLError, http.client.HTTPException,
+                    ConnectionError, TimeoutError, OSError):
+                # The worker DIED mid-conversation (or never answered):
+                # evict and re-route this same request.
+                tried.add(target.replica_id)
+                self.evict(target.replica_id,
+                           "replica_evicted_connect_error")
+        self.metrics.record_shed()
+        return 503, {"shed": "route_retries_exhausted"}
+
+    def healthz(self) -> dict:
+        views = self.views()
+        live_ids = {v.replica_id for v in self.live()}
+        return {
+            "ok": bool(live_ids),
+            "live": len(live_ids),
+            "replicas": {
+                str(v.replica_id): {
+                    "port": v.port, "version": v.version,
+                    "queue_depth": v.queue_depth, "phase": v.phase,
+                    "age_s": round(v.age_s, 3),
+                    "live": v.replica_id in live_ids}
+                for v in views},
+        }
+
+    def emit(self, final: bool = False) -> None:
+        """One ``fleet`` window record; when ``final``, the cumulative
+        ``fleet_done`` follows (mirroring serve/serve_done)."""
+        if self.logger is None:
+            return
+        replicas, live = len(self.views()), len(self.live())
+        self.logger.log("fleet", **self.metrics.window(replicas, live))
+        if final:
+            self.logger.log("fleet_done",
+                            **self.metrics.cumulative(replicas, live))
+
+    # -- HTTP shell -----------------------------------------------------
+
+    def make_handler(self):
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, router.healthz())
+                elif self.path == "/stats":
+                    # Cumulative and read-only: probing stats must not
+                    # consume the periodic record's window.
+                    views = router.views()
+                    self._reply(200, router.metrics.cumulative(
+                        replicas=len(views), live=len(router.live())))
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                code, payload = router.proxy_predict(self.rfile.read(n))
+                self._reply(code, payload)
+
+        return Handler
+
+    def serve(self, port: int) -> ThreadingHTTPServer:
+        """Bind + start the accept loop on a daemon thread; returns the
+        server (its ``server_address[1]`` is the bound port)."""
+        self._server = ThreadingHTTPServer(("", port),
+                                           self.make_handler())
+        threading.Thread(target=self._server.serve_forever,
+                         name="fleet-router-accept", daemon=True).start()
+        return self._server
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

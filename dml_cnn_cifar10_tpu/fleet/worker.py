@@ -1,0 +1,351 @@
+"""One serve replica of the fleet: engine + batcher + HTTP, plus the
+three fleet duties a lone ``--mode serve`` process doesn't have:
+
+1. **Advertise** — publish heartbeats to the fleet dir
+   (``HeartbeatStore`` beats with ``extra = {replica_id, version,
+   queue_depth, port}``; ``step`` is the completed-request counter).
+   Phase ``warmup`` until the HTTP socket is up and every bucket is
+   compiled, then ``serve`` — the router only routes to ``serve``.
+2. **Hot-swap** — poll the published-version file
+   (``fleet/publisher.py``); when ``seq`` advances, restore exactly the
+   published checkpoint (integrity-verified,
+   ``ckpt.restore_checkpoint_at``) and
+   :meth:`~dml_cnn_cifar10_tpu.serve.engine.ServingEngine.try_swap` it
+   in between micro-batches. A candidate that fails restore or the
+   engine's shape/dtype contract is rejected (``swap_rejected`` JSONL)
+   and the old version keeps serving.
+3. **Die loudly or drain cleanly** — SIGTERM takes the same
+   PreemptionGuard drain as ``--mode serve`` (the autoscaler retires
+   replicas with SIGTERM); the ``--worker_fault`` drill hook arms a
+   ``utils/faults.py`` kind (``host_lost`` = ``os._exit``, no cleanup)
+   after N batch dispatches so the router's evict/re-route path is
+   testable on CPU in tier-1.
+
+Spawned by the fleet controller as ``python -m
+dml_cnn_cifar10_tpu.fleet.worker <config.json> <replica_id> [fault]``;
+its telemetry stream is ``<fleet_dir>/telemetry/replica_<id>.jsonl``
+(serve windows, compile events, swap events) — the same files the
+autoscaler reads its signals from.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Optional
+
+from dml_cnn_cifar10_tpu.fleet import publisher as publisher_lib
+from dml_cnn_cifar10_tpu.parallel.cluster import HeartbeatStore
+from dml_cnn_cifar10_tpu.serve.batcher import MicroBatcher
+from dml_cnn_cifar10_tpu.serve.metrics import ServeMetrics
+from dml_cnn_cifar10_tpu.serve.server import _make_handler, _MetricsFlusher
+
+
+def replica_jsonl_path(fleet_dir: str, replica_id: int) -> str:
+    return os.path.join(fleet_dir, "telemetry",
+                        f"replica_{replica_id}.jsonl")
+
+
+class _FaultingEngine:
+    """Engine proxy arming one ``utils/faults.py`` kind at the Nth
+    TRAFFIC dispatch (warmup forwards go through the real engine and
+    don't count). The fleet analogue of the trainer's ``--fault_spec``
+    seam — how tier-1 kills a worker mid-load without mocking."""
+
+    def __init__(self, engine, kind: str, at_n: int, on_stall=None):
+        self._engine = engine
+        self._kind = kind
+        self._at_n = int(at_n)
+        self._n = 0
+        self._fired = False
+        self._on_stall = on_stall
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+    def forward_timed_versioned(self, batch):
+        self._n += 1
+        if not self._fired and self._n >= self._at_n:
+            self._fired = True
+            from dml_cnn_cifar10_tpu.utils.faults import EXIT_HOST_LOST
+            print(f"[fleet] replica fault {self._kind} at dispatch "
+                  f"{self._n}", flush=True)
+            if self._kind == "host_lost":
+                os._exit(EXIT_HOST_LOST)
+            elif self._kind == "heartbeat_stall" \
+                    and self._on_stall is not None:
+                self._on_stall()
+        return self._engine.forward_timed_versioned(batch)
+
+
+def _parse_fault(fault: Optional[str]):
+    """``"kind@n"`` with kind in {host_lost, heartbeat_stall}."""
+    if not fault:
+        return None
+    kind, sep, n = fault.partition("@")
+    if not sep or kind not in ("host_lost", "heartbeat_stall"):
+        raise ValueError(f"bad worker fault {fault!r}: want "
+                         f"host_lost@N or heartbeat_stall@N")
+    return kind, int(n)
+
+
+class _SwapWatcher(threading.Thread):
+    """Poll the published-version file; restore + try_swap on advance.
+
+    The restore target is the worker's own TrainState (structure from
+    its first restore), so a published checkpoint from a DIFFERENT
+    model config fails restore — which is handled exactly like an
+    engine-contract mismatch: ``swap_rejected``, keep serving."""
+
+    def __init__(self, fleet_dir: str, engine, trainer, state,
+                 poll_s: float, last_seq: int, logger=None):
+        super().__init__(name="fleet-swap-watcher", daemon=True)
+        self.fleet_dir = fleet_dir
+        self.engine = engine
+        self.trainer = trainer
+        self.state = state
+        self.poll_s = poll_s
+        self.last_seq = last_seq
+        self.logger = logger
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def check_once(self) -> bool:
+        """One poll; True when a swap was installed."""
+        rec = publisher_lib.read_published(self.fleet_dir)
+        if rec is None or rec.seq <= self.last_seq:
+            return False
+        # Whatever happens below, this seq is handled: a bad candidate
+        # must not be retried every poll_s forever.
+        self.last_seq = rec.seq
+        from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+        try:
+            new_state = ckpt_lib.restore_checkpoint_at(rec.path,
+                                                       self.state)
+        except Exception as e:
+            if self.logger is not None:
+                self.logger.log("swap_rejected",
+                                replica_id=self.engine.replica_id,
+                                version=rec.version,
+                                reason=f"restore failed: {e}")
+            print(f"[fleet] REJECTED published version {rec.version}: "
+                  f"restore failed ({e})")
+            return False
+        self.state = new_state
+        params = new_state.opt.get("ema", new_state.params)
+        mstate = new_state.opt.get("ema_mstate", new_state.model_state) \
+            if self.trainer.model_def.has_state else None
+        ok, _ = self.engine.try_swap(params, mstate, version=rec.version)
+        return ok
+
+    def run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.check_once()
+            except Exception as e:
+                print(f"[fleet] swap watcher error: {e!r}")
+
+
+class _BeatPublisher(threading.Thread):
+    """Advertise this replica: liveness + placement signals per beat."""
+
+    def __init__(self, store: HeartbeatStore, batcher, engine,
+                 interval_s: float, port_ref: dict, phase_ref: dict):
+        super().__init__(name="fleet-beat-publisher", daemon=True)
+        self.store = store
+        self.batcher = batcher
+        self.engine = engine
+        self.interval_s = interval_s
+        self.port_ref = port_ref
+        self.phase_ref = phase_ref
+        self._stop = threading.Event()
+        self._stalled = False
+
+    def stall(self) -> None:
+        """Fault hook: stop beating while serving continues — from the
+        router's side, indistinguishable from a dead worker."""
+        self._stalled = True
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def beat_once(self) -> None:
+        if self._stalled:
+            return
+        self.store.publish(
+            self.batcher.metrics.cumulative()["completed"],
+            self.phase_ref["phase"],
+            extra={"replica_id": self.store.process_id,
+                   "version": self.engine.version,
+                   "queue_depth": self.batcher.queue_depth(),
+                   "port": self.port_ref.get("port")})
+
+    def run(self) -> None:
+        self.beat_once()
+        while not self._stop.wait(self.interval_s):
+            self.beat_once()
+
+
+def main_worker(cfg, replica_id: int, fault: Optional[str] = None,
+                ready_event: Optional[threading.Event] = None,
+                stop_event: Optional[threading.Event] = None) -> int:
+    """Blocking worker loop (the fleet's ``main_serve`` analogue)."""
+    from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+    from dml_cnn_cifar10_tpu.utils.preemption import PreemptionGuard
+
+    fleet_dir = publisher_lib.fleet_coord_dir(cfg)
+    jsonl = replica_jsonl_path(fleet_dir, replica_id)
+    os.makedirs(os.path.dirname(jsonl), exist_ok=True)
+    # The replica's whole stream — serve windows, compile events, swap
+    # events, and anything the Trainer-based restore logs — goes to one
+    # per-replica file; the autoscaler and telemetry_report read these.
+    cfg.metrics_jsonl = jsonl
+    logger = MetricsLogger(jsonl_path=jsonl, task_index=replica_id)
+
+    # Engine over the PUBLISHED version when there is one (every
+    # replica of a fleet must serve the same weights regardless of
+    # spawn order), else the latest checkpoint — structure restored
+    # through the Trainer exactly like --mode serve, so fleet outputs
+    # pin bit-equal to the single-process path.
+    import jax
+
+    from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+    from dml_cnn_cifar10_tpu.serve.engine import ServingEngine
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+
+    trainer = Trainer(cfg, task_index=replica_id)
+    state = trainer.init_or_restore()
+    published = publisher_lib.read_published(fleet_dir)
+    last_seq = 0
+    if published is not None:
+        if int(jax.device_get(state.step)) != published.step:
+            state = ckpt_lib.restore_checkpoint_at(published.path, state)
+        last_seq = published.seq
+    version = str(int(jax.device_get(state.step)))
+    params = state.opt.get("ema", state.params)
+    mstate = state.opt.get("ema_mstate", state.model_state) \
+        if trainer.model_def.has_state else None
+    engine = ServingEngine.from_params(
+        trainer.model_def, cfg.model, cfg.data, params, mstate,
+        compile_cache=trainer.compile_cache, logger=logger,
+        version=version, replica_id=replica_id)
+
+    store = HeartbeatStore(fleet_dir, process_id=replica_id)
+    phase_ref = {"phase": "warmup"}
+    port_ref: dict = {}
+    parsed_fault = _parse_fault(fault)
+
+    serve_cfg = cfg.serve
+    metrics = ServeMetrics()
+    beats = None
+    front = engine
+    if parsed_fault is not None:
+        front = _FaultingEngine(engine, parsed_fault[0], parsed_fault[1],
+                                on_stall=lambda: beats.stall())
+    batcher = MicroBatcher(
+        front, buckets=serve_cfg.buckets,
+        max_queue_depth=serve_cfg.max_queue_depth,
+        batch_window_s=serve_cfg.batch_window_ms / 1e3,
+        default_deadline_s=None if serve_cfg.deadline_ms is None
+        else serve_cfg.deadline_ms / 1e3,
+        metrics=metrics)
+    beats = _BeatPublisher(store, batcher, engine,
+                           cfg.fleet.heartbeat_interval_s, port_ref,
+                           phase_ref)
+    beats.start()
+
+    server = ThreadingHTTPServer(
+        ("", serve_cfg.port),
+        _make_handler(batcher, metrics, replica_id=replica_id))
+    port_ref["port"] = server.server_address[1]
+    watcher = _SwapWatcher(fleet_dir, engine, trainer, state,
+                           cfg.fleet.swap_poll_s, last_seq,
+                           logger=logger)
+    flusher = _MetricsFlusher(metrics, logger, serve_cfg.metrics_every_s)
+    accept = threading.Thread(target=server.serve_forever,
+                              name="fleet-worker-accept", daemon=True)
+    drained = True
+    try:
+        with PreemptionGuard() as guard:
+            accept.start()
+            watcher.start()
+            flusher.start()
+            phase_ref["phase"] = "serve"
+            beats.beat_once()   # don't wait one interval to go routable
+            print(f"[fleet] replica {replica_id} serving version "
+                  f"{engine.version} on :{port_ref['port']} "
+                  f"(compile_s={batcher.compile_secs})", flush=True)
+            if ready_event is not None:
+                ready_event.set()
+            try:
+                while not guard.requested and (
+                        stop_event is None or not stop_event.is_set()):
+                    time.sleep(0.05)
+                why = (f"signal {guard.signum}" if guard.requested
+                       else "stop requested")
+            except KeyboardInterrupt:
+                why = "keyboard interrupt"
+            phase_ref["phase"] = "drain"
+            beats.beat_once()
+            print(f"[fleet] replica {replica_id} {why}: draining "
+                  f"(deadline {serve_cfg.drain_deadline_s:.1f}s)")
+            server.shutdown()
+            accept.join()
+            drained = batcher.drain(timeout=serve_cfg.drain_deadline_s)
+    finally:
+        server.server_close()
+        watcher.stop()
+        flusher.stop()
+        beats.stop()
+        if batcher._worker.is_alive():
+            batcher.close()
+        phase_ref["phase"] = "stopped"
+        beats.beat_once()
+        metrics.emit(logger, final=True)
+        logger.flush()
+        logger.close()
+    print(f"[fleet] replica {replica_id} exiting cleanly "
+          f"({'drained' if drained else 'drain deadline hit'})")
+    return 0
+
+
+def main_from_argv(argv) -> int:
+    """``worker.py <config.json> <replica_id> [fault]`` — the spawn
+    contract of the fleet controller's worker pool (a JSON config file,
+    not a re-marshalled CLI, so workers can't drift from the fleet's
+    flags)."""
+    if len(argv) < 2:
+        print("usage: python -m dml_cnn_cifar10_tpu.fleet.worker "
+              "<config.json> <replica_id> [fault_kind@n]",
+              file=sys.stderr)
+        return 2
+    from dml_cnn_cifar10_tpu.config import config_from_dict
+    with open(argv[0]) as f:
+        cfg = config_from_dict(json.load(f))
+    fault = argv[2] if len(argv) > 2 and argv[2] else None
+    return main_worker(cfg, int(argv[1]), fault=fault)
+
+
+def _pin_platform() -> None:
+    """Re-assert the platform the controller spawned us for. A plain
+    env inheritance is not enough on hosts whose sitecustomize
+    overwrites ``JAX_PLATFORMS`` at interpreter startup (the reason
+    ``utils/platform.force_cpu`` exists) — so the pool passes the
+    intent on a var sitecustomize doesn't touch."""
+    plat = os.environ.get("DML_FLEET_WORKER_PLATFORM")
+    if plat == "cpu":
+        from dml_cnn_cifar10_tpu.utils.platform import force_cpu
+        force_cpu()
+    elif plat:
+        os.environ["JAX_PLATFORMS"] = plat
+
+
+if __name__ == "__main__":
+    _pin_platform()
+    sys.exit(main_from_argv(sys.argv[1:]))

@@ -80,6 +80,11 @@ class Beat:
     step: int
     wallclock: float
     phase: str
+    # Free-form payload beyond the train-loop fields. The serving fleet
+    # publishes {replica_id, version, queue_depth, port} here (its
+    # "step" is the batch-dispatch counter); train phases leave it
+    # None. Old beat files without the key still decode (default).
+    extra: Optional[Dict] = None
 
     def age_s(self, now: Optional[float] = None) -> float:
         return (now if now is not None else time.time()) - self.wallclock
@@ -111,9 +116,16 @@ class HeartbeatStore:
     def _path(self, pid: int) -> str:
         return os.path.join(self.dir, f"proc_{pid}.json")
 
-    def publish(self, step: int, phase: str) -> Beat:
-        beat = Beat(self.process_id, int(step), time.time(), phase)
-        tmp = self._path(self.process_id) + f".tmp{os.getpid()}"
+    def publish(self, step: int, phase: str,
+                extra: Optional[Dict] = None) -> Beat:
+        beat = Beat(self.process_id, int(step), time.time(), phase,
+                    extra=extra)
+        # Tmp name unique per pid AND thread: the background publisher
+        # thread and a dispatch-seam publish from the main thread would
+        # otherwise race on one tmp file (write/replace interleaving →
+        # FileNotFoundError on the loser's replace).
+        tmp = self._path(self.process_id) \
+            + f".tmp{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "w") as f:
             json.dump(dataclasses.asdict(beat), f)
         os.replace(tmp, self._path(self.process_id))
@@ -132,6 +144,28 @@ class HeartbeatStore:
     def read_peers(self, expected: Sequence[int]) -> Dict[int, Optional[Beat]]:
         return {pid: self.read(pid) for pid in expected
                 if pid != self.process_id}
+
+    def read_all(self) -> Dict[int, Beat]:
+        """Every beat present on disk, keyed by process id — discovery
+        for consumers that do NOT know the membership up front (the
+        fleet router learns replicas, and their advertised ports, from
+        whoever beats here). Self included; unreadable files skipped."""
+        out: Dict[int, Beat] = {}
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("proc_") and name.endswith(".json")):
+                continue
+            try:
+                pid = int(name[len("proc_"):-len(".json")])
+            except ValueError:
+                continue
+            beat = self.read(pid)
+            if beat is not None:
+                out[pid] = beat
+        return out
 
 
 class RestartCoordinator:

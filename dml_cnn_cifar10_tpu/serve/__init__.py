@@ -10,6 +10,6 @@ JSONL telemetry stream. See ``docs/SERVING.md``.
 """
 
 from dml_cnn_cifar10_tpu.serve.batcher import (MicroBatcher,  # noqa: F401
-                                               ShedError)
+                                               ShedError, VersionedLogits)
 from dml_cnn_cifar10_tpu.serve.engine import ServingEngine  # noqa: F401
 from dml_cnn_cifar10_tpu.serve.metrics import ServeMetrics  # noqa: F401

@@ -53,6 +53,23 @@ class ShedError(RuntimeError):
         self.reason = reason
 
 
+class VersionedLogits(np.ndarray):
+    """A logits row tagged with the model ``version`` that computed it.
+
+    Still a plain ndarray for every numeric purpose (tests and clients
+    that ignore versions keep working); the tag is what lets the HTTP
+    front end put ``"version"`` in each response, making a checkpoint
+    hot-swap observable end-to-end (docs/SERVING.md fleet section)."""
+
+    version: Optional[str] = None
+
+
+def _versioned_row(row, version) -> VersionedLogits:
+    out = np.array(row).view(VersionedLogits)
+    out.version = version
+    return out
+
+
 class _Request:
     __slots__ = ("image", "future", "t_enqueue", "deadline")
 
@@ -128,6 +145,12 @@ class MicroBatcher:
             raise ShedError("queue_full") from None
         self.metrics.record_submit()
         return req.future
+
+    def queue_depth(self) -> int:
+        """Requests currently waiting (approximate — the queue is live).
+        Published in fleet heartbeats and ``/healthz`` so the router and
+        autoscaler can see backpressure without submitting traffic."""
+        return self._q.qsize()
 
     def close(self, drain: bool = True) -> None:
         """Stop admitting; by default let the worker drain what is
@@ -214,7 +237,16 @@ class MicroBatcher:
         for i, r in enumerate(live):
             padded[i] = r.image
         try:
-            logits, device_s = self.engine.forward_timed(padded)
+            # Engines expose the versioned forward so each response can
+            # carry the exact weights version that computed it (hot-swap
+            # observability); plain engines/stubs fall back to the
+            # 2-tuple contract with their static version attribute.
+            fwd = getattr(self.engine, "forward_timed_versioned", None)
+            if fwd is not None:
+                logits, device_s, version = fwd(padded)
+            else:
+                logits, device_s = self.engine.forward_timed(padded)
+                version = getattr(self.engine, "version", None)
         except Exception as e:                    # pragma: no cover
             # A device failure must not strand clients on futures that
             # never resolve.
@@ -226,7 +258,7 @@ class MicroBatcher:
         for i, r in enumerate(live):
             self.metrics.record_done(t_done - r.t_enqueue,
                                      t_start - r.t_enqueue)
-            r.future.set_result(np.array(logits[i]))
+            r.future.set_result(_versioned_row(logits[i], version))
 
     def _run(self) -> None:
         while True:

@@ -1,5 +1,5 @@
 """The device side of serving: one uint8-in/logits-out callable plus
-bucket pre-compilation.
+bucket pre-compilation and the checkpoint hot-swap seam.
 
 Two construction paths, one call contract:
 
@@ -8,9 +8,13 @@ Two construction paths, one call contract:
   input with the eval decode compiled in). The input image geometry is
   read back out of the artifact's own avals, so a server needs no
   ``DataConfig`` to validate requests against it.
-- :meth:`ServingEngine.from_params` — wrap live params through
-  :func:`~dml_cnn_cifar10_tpu.export.make_serving_fn` (identical
-  semantics to what export would serialize; the no-artifact dev loop).
+- :meth:`ServingEngine.from_params` — live params passed as ARGUMENTS
+  to one jitted program (:func:`~dml_cnn_cifar10_tpu.export.
+  make_variable_serving_fn`). Because the weights are traced inputs,
+  not constants, :meth:`try_swap` can install a new checkpoint's params
+  as a pytree replacement with NO recompile — the zero-downtime
+  hot-swap the serving fleet (``fleet/``) is built on. A batch in
+  flight finishes on the old weights; the next batch runs the new ones.
 
 Either way the callable is jitted, so each distinct batch size compiles
 exactly once. That is why the batcher quantizes to a fixed bucket set
@@ -23,39 +27,86 @@ With a :class:`~dml_cnn_cifar10_tpu.compilecache.CompileCache` armed
 process restarts: a redeployed/recovered server warm-starts its bucket
 programs from the cache (jax's native persistent cache by default;
 deserialized executables on opted-in backends), so time-to-ready drops
-from one XLA compile per bucket to one disk load per bucket. Warmup
-always emits one ``compile`` JSONL event per bucket (key null when
-uncached) so the serving section of ``tools/telemetry_report.py`` can
-price the warmup.
+from one XLA compile per bucket to one disk load per bucket — the cheap
+replica spin-up the fleet's autoscaler exploits. Warmup always emits one
+``compile`` JSONL event per bucket (key null when uncached) so the
+serving section of ``tools/telemetry_report.py`` can price the warmup.
+
+Every response is tagged with the engine's current ``version`` (the
+checkpoint step it serves, threaded by the batcher into
+:class:`~dml_cnn_cifar10_tpu.serve.batcher.VersionedLogits`), so a
+rollout is observable end-to-end: watch the version tags in the
+responses flip as the fleet swaps.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Optional, Tuple
 
 import numpy as np
 
 
+def _variable_spec(variables):
+    """Hashable (treedef, ((shape, dtype), ...)) signature of a
+    variables pytree — the contract :meth:`ServingEngine.try_swap`
+    checks a candidate checkpoint against. Anything the compiled
+    program is shape/dtype-sensitive to is in here; values are not."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(variables)
+    return treedef, tuple((tuple(np.shape(l)), np.dtype(
+        getattr(l, "dtype", type(l))).name) for l in leaves)
+
+
+def _spec_mismatch(want, got) -> str:
+    """Human-readable first divergence between two variable specs."""
+    if want[0] != got[0]:
+        return "param tree structure differs"
+    for i, (a, b) in enumerate(zip(want[1], got[1])):
+        if a != b:
+            return (f"leaf {i}: have {a[0]}/{a[1]}, "
+                    f"candidate {b[0]}/{b[1]}")
+    return "specs differ"
+
+
 class ServingEngine:
     """Uint8 image batches in, numpy logits out, with device timing.
 
-    ``fn`` maps ``uint8 [B, H, W, C] -> logits [B, K]``; ``image_shape``
+    ``fn`` maps ``uint8 [B, H, W, C] -> logits [B, K]`` (the
+    closed-over/artifact path; the live-params path installs a two-arg
+    jitted program instead — see :meth:`from_params`). ``image_shape``
     is the per-request ``(H, W, C)`` contract the batcher validates and
     pads against. ``compile_cache``/``logger`` arm the persistent
-    warmup path described in the module docstring.
+    warmup path described in the module docstring. ``version`` tags
+    every response; ``replica_id`` names this engine in swap telemetry.
     """
 
     def __init__(self, fn, image_shape: Tuple[int, int, int],
-                 source: str = "live", compile_cache=None, logger=None):
+                 source: str = "live", compile_cache=None, logger=None,
+                 version: str = "0", replica_id: int = 0):
         self._fn = fn
         self.image_shape = tuple(int(d) for d in image_shape)
         self.source = source
         self.compile_cache = compile_cache
         self.logger = logger
+        self.version = str(version)
+        self.replica_id = int(replica_id)
+        self.swap_count = 0
+        # Hot-swap seam state (live-params engines only): the two-arg
+        # jitted program, the current variables pytree, and its
+        # shape/dtype spec. The lock pairs (variables, version) reads
+        # with swap writes; compute happens outside it.
+        self._swap_lock = threading.Lock()
+        self._jitted_v = None
+        self._variables = None
+        self._var_spec = None
         # bucket size -> AOT executable obtained through the cache;
         # forward_timed prefers these, falling back to the jitted fn
-        # for sizes the warmup never saw.
+        # for sizes the warmup never saw. Swap-safe by construction:
+        # the executables are compiled for the variables' AVALS, which
+        # try_swap pins, so they serve every installed version.
         self._bucket_fns = {}
         #: last warmup's {bucket: event dict} (hit/source/compile_s).
         self.last_warmup: dict = {}
@@ -63,10 +114,14 @@ class ServingEngine:
     @classmethod
     def from_artifact(cls, path: Optional[str] = None,
                       blob: Optional[bytes] = None,
-                      compile_cache=None, logger=None) -> "ServingEngine":
+                      compile_cache=None, logger=None,
+                      version: str = "artifact",
+                      replica_id: int = 0) -> "ServingEngine":
         """Engine over a serialized ``export.py`` artifact (file path or
         raw bytes). Self-contained: weights, decode, and input geometry
-        all come from the artifact."""
+        all come from the artifact — which also means NOT hot-swappable
+        (the weights are baked into the program; :meth:`try_swap`
+        rejects)."""
         import jax
 
         from dml_cnn_cifar10_tpu import export as export_lib
@@ -80,23 +135,108 @@ class ServingEngine:
         shape = export_lib.artifact_image_shape(exported)
         return cls(jax.jit(exported.call), shape,
                    source=path or "<artifact bytes>",
-                   compile_cache=compile_cache, logger=logger)
+                   compile_cache=compile_cache, logger=logger,
+                   version=version, replica_id=replica_id)
 
     @classmethod
     def from_params(cls, model_def, model_cfg, data_cfg, params: Any,
                     model_state: Any = None, compile_cache=None,
-                    logger=None) -> "ServingEngine":
+                    logger=None, version: str = "0",
+                    replica_id: int = 0) -> "ServingEngine":
         """Engine over live params — the same eval forward export.py
-        would serialize, without the serialize/deserialize round trip."""
+        would serialize, with the weights as jit ARGUMENTS so
+        :meth:`try_swap` can replace them without a recompile."""
         import jax
 
-        from dml_cnn_cifar10_tpu.export import make_serving_fn
+        from dml_cnn_cifar10_tpu.export import make_variable_serving_fn
 
-        fn = jax.jit(make_serving_fn(model_def, model_cfg, data_cfg,
-                                     params, model_state))
-        return cls(fn, (data_cfg.image_height, data_cfg.image_width,
-                        data_cfg.num_channels),
-                   compile_cache=compile_cache, logger=logger)
+        eng = cls(None, (data_cfg.image_height, data_cfg.image_width,
+                         data_cfg.num_channels),
+                  compile_cache=compile_cache, logger=logger,
+                  version=version, replica_id=replica_id)
+        eng._jitted_v = jax.jit(
+            make_variable_serving_fn(model_def, model_cfg, data_cfg))
+        variables = jax.device_put((params, model_state
+                                    if model_def.has_state else None))
+        eng._variables = variables
+        eng._var_spec = _variable_spec(variables)
+        return eng
+
+    # --- hot-swap seam ---
+
+    @property
+    def swappable(self) -> bool:
+        return self._jitted_v is not None
+
+    def try_swap(self, params: Any, model_state: Any = None,
+                 version: str = "?") -> Tuple[bool, str]:
+        """Validate + atomically install a new weight set.
+
+        The candidate must match the engine's compiled contract — same
+        param tree structure, same leaf shapes and dtypes — because the
+        warm bucket executables were compiled for exactly those avals.
+        A mismatch (wrong --model, changed width, different dtype...)
+        is REJECTED: a clear ``swap_rejected`` JSONL event, return
+        ``(False, reason)``, and the old version keeps serving — never
+        a mid-batch failure. On success the swap is a pytree pointer
+        replacement under the lock: the in-flight batch completes on
+        the old weights, the next batch runs the new ones, and every
+        response's version tag says which.
+        """
+        import jax
+
+        t0 = time.perf_counter()
+        version = str(version)
+        if not self.swappable:
+            return False, self._reject(
+                version, "engine is artifact-backed (weights baked "
+                         "into the program); not swappable")
+        candidate = (params, model_state)
+        spec = _variable_spec(candidate)
+        if spec != self._var_spec:
+            return False, self._reject(
+                version, _spec_mismatch(self._var_spec, spec))
+        # Place on device BEFORE taking the lock: the transfer is the
+        # slow part and must not stall a concurrent forward.
+        candidate = jax.device_put(candidate)
+        with self._swap_lock:
+            from_version = self.version
+            self._variables = candidate
+            self.version = version
+            self.swap_count += 1
+        swap_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        if self.logger is not None:
+            self.logger.log("swap", replica_id=self.replica_id,
+                            version=version, from_version=from_version,
+                            swap_ms=swap_ms)
+        print(f"[serve] hot-swapped params {from_version} -> {version} "
+              f"in {swap_ms:.1f} ms (swap #{self.swap_count})")
+        return True, "swapped"
+
+    def _reject(self, version: str, reason: str) -> str:
+        if self.logger is not None:
+            self.logger.log("swap_rejected", replica_id=self.replica_id,
+                            version=version, reason=reason)
+        print(f"[serve] REJECTED candidate version {version}: {reason} "
+              f"(still serving {self.version})")
+        return reason
+
+    # --- warmup ---
+
+    def _avals(self, zeros: np.ndarray):
+        """Lowering avals for one bucket: (variables?, batch)."""
+        import jax
+
+        batch = jax.ShapeDtypeStruct(zeros.shape, zeros.dtype)
+        if not self.swappable:
+            return (batch,)
+        var_avals = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype),
+            self._variables)
+        return (var_avals, batch)
+
+    def _jitted(self):
+        return self._jitted_v if self.swappable else self._fn
 
     def _warm_bucket(self, b: int) -> None:
         """Obtain bucket ``b``'s executable through the cache (hit =
@@ -105,6 +245,7 @@ class ServingEngine:
         import jax
 
         zeros = np.zeros((b, *self.image_shape), np.uint8)
+        avals = self._avals(zeros)
         if self.compile_cache is not None \
                 and self.compile_cache.degraded():
             # Backend off the executable allowlist: compile on the jit
@@ -114,22 +255,21 @@ class ServingEngine:
             t0 = time.perf_counter()
             self.forward_timed(zeros)
             ev = self.compile_cache.note_degraded(
-                self._fn,
-                (jax.ShapeDtypeStruct(zeros.shape, zeros.dtype),),
-                "serve_warmup", {"bucket": b},
+                self._jitted(), avals, "serve_warmup", {"bucket": b},
                 time.perf_counter() - t0)
             self.last_warmup[b] = ev
             return
         if self.compile_cache is not None:
             compiled, ev = self.compile_cache.obtain(
-                self._fn, (jax.ShapeDtypeStruct(zeros.shape, zeros.dtype),),
-                "serve_warmup", {"bucket": b})
+                self._jitted(), avals, "serve_warmup", {"bucket": b})
             if compiled is not None:
                 self._bucket_fns[b] = compiled
                 # One zeros forward through the obtained executable:
                 # warms the dispatch/transfer path and proves the
                 # deserialized program actually runs before traffic.
-                jax.block_until_ready(compiled(zeros))
+                jax.block_until_ready(
+                    compiled(self._variables, zeros) if self.swappable
+                    else compiled(zeros))
             else:
                 # fail-open: the "error" event is already emitted; the
                 # plain call-path compile serves this bucket.
@@ -158,13 +298,34 @@ class ServingEngine:
             out[b] = round(time.perf_counter() - t0, 3)
         return out
 
+    # --- forward ---
+
+    def forward_timed_versioned(self, batch_u8: np.ndarray):
+        """``(logits ndarray [B, K], device_seconds, version)`` — the
+        version is read under the swap lock TOGETHER with the weights
+        that compute this batch, so the tag can never name a version
+        other than the one that produced the logits."""
+        import jax
+
+        b = int(batch_u8.shape[0])
+        if self.swappable:
+            with self._swap_lock:
+                variables = self._variables
+                version = self.version
+            fn = self._bucket_fns.get(b)
+            t0 = time.perf_counter()
+            out = fn(variables, batch_u8) if fn is not None \
+                else self._jitted_v(variables, batch_u8)
+            logits = np.asarray(jax.device_get(out))
+            return logits, time.perf_counter() - t0, version
+        fn = self._bucket_fns.get(b, self._fn)
+        t0 = time.perf_counter()
+        logits = np.asarray(jax.device_get(fn(batch_u8)))
+        return logits, time.perf_counter() - t0, self.version
+
     def forward_timed(self, batch_u8: np.ndarray):
         """``(logits ndarray [B, K], device_seconds)`` — the fetch blocks
         until the device result is ready, so the timing covers dispatch +
         execution + transfer (what a request actually waits for)."""
-        import jax
-
-        fn = self._bucket_fns.get(int(batch_u8.shape[0]), self._fn)
-        t0 = time.perf_counter()
-        logits = np.asarray(jax.device_get(fn(batch_u8)))
-        return logits, time.perf_counter() - t0
+        logits, secs, _ = self.forward_timed_versioned(batch_u8)
+        return logits, secs

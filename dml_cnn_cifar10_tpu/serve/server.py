@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -32,10 +33,12 @@ from dml_cnn_cifar10_tpu.serve.engine import ServingEngine
 from dml_cnn_cifar10_tpu.serve.metrics import ServeMetrics
 
 
-def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics):
+def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics,
+                  replica_id: int = 0):
     image_bytes = 1
     for d in batcher.engine.image_shape:
         image_bytes *= d
+    started_at = time.time()
 
     class Handler(BaseHTTPRequestHandler):
         def _reply(self, code: int, payload: dict) -> None:
@@ -51,9 +54,18 @@ def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._reply(200, {"ok": True,
-                                  "image_shape": batcher.engine.image_shape,
-                                  "buckets": batcher.buckets})
+                # Everything a fleet router (or a human with curl)
+                # needs to judge this worker without submitting
+                # inference traffic: identity, the weights version it
+                # serves, current backpressure, and age.
+                self._reply(200, {
+                    "ok": True,
+                    "replica_id": replica_id,
+                    "version": getattr(batcher.engine, "version", None),
+                    "queue_depth": batcher.queue_depth(),
+                    "uptime_s": round(time.time() - started_at, 3),
+                    "image_shape": batcher.engine.image_shape,
+                    "buckets": batcher.buckets})
             elif self.path == "/stats":
                 self._reply(200, metrics.cumulative())
             else:
@@ -79,8 +91,14 @@ def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics):
             except ShedError as e:
                 self._reply(503, {"shed": e.reason})
                 return
-            self._reply(200, {"class": int(logits.argmax()),
-                              "logits": [float(v) for v in logits]})
+            payload = {"class": int(logits.argmax()),
+                       "logits": [float(v) for v in logits]}
+            version = getattr(logits, "version", None)
+            if version is not None:
+                # The weights version that computed THIS response —
+                # what makes a hot-swap rollout observable end-to-end.
+                payload["version"] = version
+            self._reply(200, payload)
 
     return Handler
 
@@ -103,13 +121,14 @@ class _MetricsFlusher(threading.Thread):
         self._stop.set()
 
 
-def resolve_engine(cfg, task_index: int = 0,
-                   logger=None) -> ServingEngine:
+def resolve_engine(cfg, task_index: int = 0, logger=None,
+                   replica_id: int = 0) -> ServingEngine:
     """Artifact if configured/present, else live params from the latest
     checkpoint (the same EMA-preferring selection as ``--mode export``).
     ``--compile_cache_dir`` arms the persistent bucket-warmup cache
     (compilecache/): a restarted server deserializes its bucket
-    executables instead of recompiling them."""
+    executables instead of recompiling them. Live-params engines are
+    versioned with the restored checkpoint step (hot-swappable)."""
     from dml_cnn_cifar10_tpu.compilecache import CompileCache
 
     cache = CompileCache.from_config(cfg, logger=logger)
@@ -121,12 +140,16 @@ def resolve_engine(cfg, task_index: int = 0,
                 f"exist (refusing to fall back to fresh weights)")
         return ServingEngine.from_artifact(serve_cfg.artifact_path,
                                            compile_cache=cache,
-                                           logger=logger)
+                                           logger=logger,
+                                           replica_id=replica_id)
     default_artifact = os.path.join(cfg.log_dir, "model.jaxexport")
     if os.path.exists(default_artifact):
         return ServingEngine.from_artifact(default_artifact,
                                            compile_cache=cache,
-                                           logger=logger)
+                                           logger=logger,
+                                           replica_id=replica_id)
+
+    import jax
 
     from dml_cnn_cifar10_tpu.train.loop import Trainer
     trainer = Trainer(cfg, task_index=task_index)
@@ -134,9 +157,11 @@ def resolve_engine(cfg, task_index: int = 0,
     params = state.opt.get("ema", state.params)
     mstate = state.opt.get("ema_mstate", state.model_state) \
         if trainer.model_def.has_state else None
-    return ServingEngine.from_params(trainer.model_def, cfg.model,
-                                     cfg.data, params, mstate,
-                                     compile_cache=cache, logger=logger)
+    return ServingEngine.from_params(
+        trainer.model_def, cfg.model, cfg.data, params, mstate,
+        compile_cache=cache, logger=logger,
+        version=str(int(jax.device_get(state.step))),
+        replica_id=replica_id)
 
 
 def main_serve(cfg, task_index: int = 0,
@@ -157,8 +182,6 @@ def main_serve(cfg, task_index: int = 0,
     at most ``serve.drain_deadline_s``, shed the remainder, flush the
     final ``serve_done`` metrics record, exit 0.
     """
-    import time
-
     from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
     from dml_cnn_cifar10_tpu.utils.preemption import PreemptionGuard
 
@@ -182,7 +205,8 @@ def main_serve(cfg, task_index: int = 0,
           f"compile_s={batcher.compile_secs}")
 
     server = ThreadingHTTPServer(("", serve_cfg.port),
-                                 _make_handler(batcher, metrics))
+                                 _make_handler(batcher, metrics,
+                                               replica_id=task_index))
     flusher = _MetricsFlusher(metrics, logger, serve_cfg.metrics_every_s)
     flusher.start()
     # The accept loop runs on its own thread so the main thread can

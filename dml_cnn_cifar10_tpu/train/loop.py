@@ -443,11 +443,25 @@ class Trainer:
         # to a shared no-op context manager.
         tracer = telemetry_lib.SpanTracer(enabled=cfg.telemetry)
         self._tracer = tracer  # exposed for tests/diagnostics
+        # Online train-and-serve (--fleet_publish): every committed
+        # checkpoint is published to the fleet's coordination dir so
+        # live serve workers hot-swap to it between micro-batches. The
+        # hook runs AFTER the integrity sidecar commits (it rides the
+        # manager's on_committed seam, writer thread under async_save)
+        # because the workers' swap gate requires a verifiable sidecar.
+        on_committed = None
+        if cfg.fleet.publish:
+            from dml_cnn_cifar10_tpu.fleet.publisher import (
+                fleet_coord_dir, publish_checkpoint)
+            pub_dir = fleet_coord_dir(cfg)
+
+            def on_committed(step, path, _dir=pub_dir):
+                publish_checkpoint(_dir, path, step, logger=self.logger)
         ckpt_mgr = ckpt_lib.CheckpointManager(
             cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints,
             async_save=cfg.async_checkpoint,
             every_secs=cfg.checkpoint_every_secs, fmt=cfg.ckpt_format,
-            logger=self.logger)
+            logger=self.logger, on_committed=on_committed)
         train_loss, test_accuracy = [], []
         last_metrics = None
         # on_nonfinite="skip" keeps a device-side snapshot of the last

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import threading
 import time
 from typing import Optional
 
@@ -28,6 +29,10 @@ class MetricsLogger:
     def __init__(self, jsonl_path: Optional[str] = None, task_index: int = 0,
                  tensorboard_dir: Optional[str] = None):
         self.task_index = task_index
+        # Writers span threads (serve metrics flusher, fleet swap
+        # watcher, router handler threads, cluster watchdog); a line
+        # must never interleave with another mid-write.
+        self._lock = threading.Lock()
         self._file = None
         if jsonl_path:
             os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
@@ -50,7 +55,10 @@ class MetricsLogger:
             rec = {"kind": kind, "t": round(time.time() - self._t0, 4),
                    "task": self.task_index,
                    **{k: _finite(v) for k, v in fields.items()}}
-            self._file.write(json.dumps(rec, allow_nan=False) + "\n")
+            line = json.dumps(rec, allow_nan=False) + "\n"
+            with self._lock:
+                if self._file is not None:
+                    self._file.write(line)
         if self._tb is not None and "step" in fields:
             step = fields["step"]
             for k, v in fields.items():
@@ -75,15 +83,17 @@ class MetricsLogger:
         """Force both sinks to disk — tensorboardX's event writer is a
         daemon thread (flush_secs=120) that dies unflushed at interpreter
         exit, so the driver flushes at every fit() end."""
-        if self._file is not None:
-            self._file.flush()
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
         if self._tb is not None:
             self._tb.flush()
 
     def close(self) -> None:
-        if self._file is not None:
-            self._file.close()
-            self._file = None
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
         if self._tb is not None:
             self._tb.close()
             self._tb = None

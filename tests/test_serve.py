@@ -338,11 +338,17 @@ def test_main_serve_graceful_stop_drains_and_flushes(tmp_path):
 
     health = json.loads(urllib.request.urlopen(
         f"http://127.0.0.1:{port}/healthz", timeout=60).read())
+    # The probe-without-traffic contract the fleet router relies on.
+    assert health["replica_id"] == 0
+    assert health["version"] == "0"          # fresh init, no checkpoint
+    assert health["queue_depth"] == 0
+    assert health["uptime_s"] >= 0
     img = np.zeros(tuple(health["image_shape"]), np.uint8).tobytes()
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/predict", data=img, method="POST")
     resp = json.loads(urllib.request.urlopen(req, timeout=60).read())
     assert "class" in resp
+    assert resp["version"] == "0"            # responses carry the tag
 
     stop.set()
     t.join(120)

@@ -81,6 +81,22 @@ KIND_KEYS = {
     "serve_done": ("requests", "completed", "shed_queue",
                    "shed_deadline", "qps", "p50_ms", "p95_ms", "p99_ms",
                    "batch_fill", "shed_fraction", "total_s"),
+    # Serving fleet (fleet/; docs/SERVING.md fleet section). `fleet` is
+    # the router's periodic window (replica membership + routing
+    # counters; `fleet_done` the final cumulative one); `swap` a
+    # worker's successful checkpoint hot-swap and `swap_rejected` a
+    # candidate refused (contract mismatch / failed restore — the old
+    # version keeps serving); `scale` an autoscaler action (up/down
+    # with its decision-table reason); `fleet_publish` a checkpoint
+    # version committed for the fleet to serve.
+    "fleet": ("replicas", "live", "routed", "rerouted", "evictions",
+              "shed", "version_mix", "window_s"),
+    "fleet_done": ("replicas", "live", "routed", "rerouted",
+                   "evictions", "shed", "version_mix", "window_s"),
+    "swap": ("replica_id", "version", "from_version", "swap_ms"),
+    "swap_rejected": ("replica_id", "version", "reason"),
+    "scale": ("action", "reason", "replicas"),
+    "fleet_publish": ("seq", "version", "step", "path"),
 }
 
 

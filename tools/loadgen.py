@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Load generator for the serving subsystem: closed- or open-loop
-traffic against the micro-batching engine, with a BENCH-style report.
+traffic against the micro-batching engine — single-profile runs or
+multi-client traffic MIXES — with a BENCH-style report.
 
 Two drive modes (the standard serving-bench dichotomy):
 
@@ -14,6 +15,20 @@ Two drive modes (the standard serving-bench dichotomy):
   report's ``shed_fraction`` says so (closed-loop clients would instead
   silently slow down — coordinated omission).
 
+Traffic mixes (``--mix``): named open-loop profiles modeling real
+multi-client traffic, one BENCH-style report row each
+(``p50/p99/qps/shed/version_mix``):
+
+- ``steady`` — constant ``--qps`` (the plain open loop);
+- ``diurnal`` — a half-sine ramp 25% → 100% → 25% of ``--qps`` over the
+  duration: the day/night cycle compressed, exercising the autoscaler's
+  up AND down decisions in one run;
+- ``burst`` — alternating 2x / 0.2x ``--qps`` eighth-duration phases:
+  thundering herds against admission control;
+- ``adversarial`` — steady rate with 25% oversize requests (wrong byte
+  count): input validation under load; rejects are counted separately
+  (``rejected``) and must never poison well-formed traffic.
+
 Two targets:
 
 - **in-process** (default): builds a CPU/TPU engine right here —
@@ -21,24 +36,29 @@ Two targets:
   fresh-initialized CNN (geometry from ``--image_size``) so the tool
   runs on a bare checkout.
 - ``--target http://host:port``: drives a running ``--mode serve``
-  process over HTTP (raw-bytes POST /predict), measuring end-to-end
-  including transport.
+  server or ``--mode fleet`` router over HTTP (raw-bytes POST
+  /predict), measuring end-to-end including transport.
 
 Requests replay CIFAR test images (``--source dataset``, raw uint8 from
 the on-disk records) or synthetic pixels (``--source random``). The
 JSON report (``--report``) carries achieved QPS, latency percentiles,
-shed fraction, and batch-fill — the serving analogue of BENCH_*.json.
+shed fraction, batch-fill, and ``version_mix`` — the count of responses
+per model version tag, which is how a zero-downtime hot-swap rollout is
+measured from the client side.
 
 Usage:
     python tools/loadgen.py --mode closed --concurrency 8 --duration_s 10
     python tools/loadgen.py --mode open --qps 500 --deadline_ms 50 \\
         --artifact /tmp/logs/model.jaxexport --report /tmp/serve_bench.json
+    python tools/loadgen.py --mix diurnal,burst,adversarial --qps 200 \\
+        --duration_s 10 --target http://localhost:8100
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import threading
@@ -46,6 +66,17 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+#: Oversize fraction of the adversarial mix.
+ADVERSARIAL_OVERSIZE = 0.25
+
+#: mix name -> rate multiplier over u = elapsed/duration in [0, 1].
+MIX_RATE = {
+    "steady": lambda u: 1.0,
+    "diurnal": lambda u: 0.25 + 0.75 * math.sin(math.pi * u),
+    "burst": lambda u: 2.0 if int(u * 8) % 2 == 0 else 0.2,
+    "adversarial": lambda u: 1.0,
+}
 
 
 def build_engine(args):
@@ -92,33 +123,60 @@ def load_images(args, image_shape):
     return rng.integers(0, 256, (256, *image_shape), dtype=np.uint8)
 
 
+class ClientStats:
+    """Client-side accounting shared by every drive mode: completions
+    with latency + the responding model version, sheds, and (the
+    adversarial mix) malformed-request rejects."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.completed = 0
+        self.shed = 0
+        self.rejected = 0
+        self.latencies = []
+        self.versions = {}
+
+    def record(self, outcome: str, dt: float = 0.0, version=None):
+        with self.lock:
+            if outcome == "ok":
+                self.completed += 1
+                self.latencies.append(dt)
+                if version is not None:
+                    key = str(version)
+                    self.versions[key] = self.versions.get(key, 0) + 1
+            elif outcome == "shed":
+                self.shed += 1
+            else:
+                self.rejected += 1
+
+
 class _HttpClient:
-    """Minimal stand-in for MicroBatcher.submit over HTTP — blocking
-    POST, so it only supports the closed-loop drive."""
+    """Blocking POST /predict against a serve worker or fleet router."""
 
-    def __init__(self, target: str, image_shape):
+    def __init__(self, target: str):
         self.target = target.rstrip("/")
-        self.image_shape = image_shape
 
-    def predict(self, image) -> bool:
-        """True = completed, False = shed (HTTP 503)."""
+    def predict(self, body: bytes):
+        """("ok", version) | ("shed", None) | ("rejected", None)."""
         import urllib.error
         import urllib.request
 
         req = urllib.request.Request(
-            f"{self.target}/predict", data=image.tobytes(),
+            f"{self.target}/predict", data=body,
             headers={"Content-Type": "application/octet-stream"})
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
-                resp.read()
-            return True
+                payload = json.loads(resp.read())
+            return "ok", payload.get("version")
         except urllib.error.HTTPError as e:
             if e.code == 503:
-                return False
+                return "shed", None
+            if e.code == 400:
+                return "rejected", None
             raise
 
 
-def run_closed(submit, images, args, client_stats):
+def run_closed(submit, images, args, stats):
     """``--concurrency`` threads in submit→wait→repeat lockstep."""
     stop_at = time.perf_counter() + args.duration_s
     counter = {"i": 0}
@@ -128,7 +186,7 @@ def run_closed(submit, images, args, client_stats):
         while time.perf_counter() < stop_at:
             with lock:
                 idx = counter["i"] = (counter["i"] + 1) % len(images)
-            submit(images[idx], client_stats)
+            submit(images[idx], stats, False)
     threads = [threading.Thread(target=worker)
                for _ in range(args.concurrency)]
     for t in threads:
@@ -137,27 +195,56 @@ def run_closed(submit, images, args, client_stats):
         t.join()
 
 
-def run_open(submit, images, args, client_stats):
-    """Fixed-rate arrivals for ``--duration_s``, fire-and-collect: each
-    request runs on its own short-lived thread so a slow engine cannot
-    slow the arrival schedule (no coordinated omission)."""
-    period = 1.0 / args.qps
-    t_end = time.perf_counter() + args.duration_s
+def run_open(submit, images, args, stats, rate_fn=None,
+             oversize_frac: float = 0.0):
+    """Open-loop arrivals, fire-and-collect: each request runs on its
+    own short-lived thread so a slow engine cannot slow the arrival
+    schedule (no coordinated omission). ``rate_fn(u)`` scales the
+    ``--qps`` base rate over normalized elapsed time — the traffic-mix
+    hook; ``oversize_frac`` of arrivals are malformed (adversarial)."""
+    import numpy as np
+
+    rate_fn = rate_fn or MIX_RATE["steady"]
+    rng = np.random.default_rng(args.seed)
+    t0 = time.perf_counter()
+    t_end = t0 + args.duration_s
     pending = []
     i = 0
-    next_at = time.perf_counter()
+    next_at = t0
     while next_at < t_end:
         now = time.perf_counter()
         if now < next_at:
             time.sleep(next_at - now)
+        oversize = bool(oversize_frac) and rng.random() < oversize_frac
         img = images[i % len(images)]
         i += 1
-        th = threading.Thread(target=submit, args=(img, client_stats))
+        th = threading.Thread(target=submit, args=(img, stats, oversize))
         th.start()
         pending.append(th)
-        next_at += period
+        rate = max(args.qps * rate_fn((next_at - t0) / args.duration_s),
+                   1e-6)
+        next_at += 1.0 / rate
     for th in pending:
         th.join(timeout=30)
+
+
+def _row(stats: ClientStats, wall: float, latency_summary) -> dict:
+    total = stats.completed + stats.shed
+    lat = latency_summary(stats.latencies)
+    return {
+        "requests": total,
+        "completed": stats.completed,
+        "shed": stats.shed,
+        "rejected": stats.rejected,
+        "shed_fraction": round(stats.shed / total, 4) if total else 0.0,
+        "achieved_qps": round(stats.completed / wall, 2) if wall else 0.0,
+        "latency_ms": {
+            "p50": lat["p50_ms"], "p95": lat["p95_ms"],
+            "p99": lat["p99_ms"], "mean": lat["mean_ms"],
+            "max": lat["max_ms"],
+        },
+        "version_mix": dict(stats.versions),
+    }
 
 
 def main(argv=None) -> int:
@@ -165,11 +252,17 @@ def main(argv=None) -> int:
         description=__doc__.splitlines()[0],
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--mode", choices=["closed", "open"], default="closed")
+    ap.add_argument("--mix", type=str, default=None,
+                    help="comma-separated traffic mixes to run "
+                         "(steady, diurnal, burst, adversarial), one "
+                         "report row per mix; open-loop drive, "
+                         "--mode is ignored")
     ap.add_argument("--concurrency", type=int, default=8,
                     help="closed-loop client threads")
     ap.add_argument("--qps", type=float, default=100.0,
-                    help="open-loop arrival rate")
-    ap.add_argument("--duration_s", type=float, default=10.0)
+                    help="open-loop arrival rate (mixes scale it)")
+    ap.add_argument("--duration_s", type=float, default=10.0,
+                    help="duration per profile (each mix runs this long)")
     ap.add_argument("--deadline_ms", type=float, default=None)
     ap.add_argument("--buckets", type=str, default="1,8,32,128")
     ap.add_argument("--queue_depth", type=int, default=256)
@@ -178,9 +271,8 @@ def main(argv=None) -> int:
                     help="serve this export.py artifact instead of a "
                          "fresh-initialized model")
     ap.add_argument("--target", type=str, default=None,
-                    help="drive a running --mode serve HTTP endpoint "
-                         "instead of an in-process engine (closed mode "
-                         "only)")
+                    help="drive a running --mode serve/fleet HTTP "
+                         "endpoint instead of an in-process engine")
     ap.add_argument("--model", type=str, default="cnn")
     ap.add_argument("--image_size", type=int, default=32)
     ap.add_argument("--crop_size", type=int, default=24)
@@ -195,40 +287,35 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
+    import numpy as np
+
     from dml_cnn_cifar10_tpu.utils.telemetry import latency_summary
 
-    client_stats = {"completed": 0, "shed": 0, "latencies": [],
-                    "lock": threading.Lock()}
+    mixes = None
+    if args.mix:
+        mixes = [m.strip() for m in args.mix.split(",") if m.strip()]
+        unknown = [m for m in mixes if m not in MIX_RATE]
+        if unknown:
+            raise SystemExit(f"unknown mix(es) {unknown}; choose from "
+                             f"{sorted(MIX_RATE)}")
 
-    def record(ok: bool, dt: float, stats) -> None:
-        with stats["lock"]:
-            if ok:
-                stats["completed"] += 1
-                stats["latencies"].append(dt)
-            else:
-                stats["shed"] += 1
-
+    batcher = None
+    metrics = None
     if args.target:
-        if args.mode != "closed":
-            raise SystemExit("--target supports --mode closed only (the "
-                             "server's own deadline handles open-loop "
-                             "overload)")
-        client = _HttpClient(args.target, None)
-        import numpy as np
+        client = _HttpClient(args.target)
         rng = np.random.default_rng(args.seed)
         images = rng.integers(
             0, 256, (256, args.image_size, args.image_size, 3),
             dtype=np.uint8)
 
-        def submit(img, stats):
+        def submit(img, stats, oversize):
+            # Oversize = wrong byte count on the wire; the server (or
+            # any worker behind the router) must answer 400 without
+            # disturbing in-flight well-formed requests.
+            body = img.tobytes() + (b"\x00" if oversize else b"")
             t0 = time.perf_counter()
-            ok = client.predict(img)
-            record(ok, time.perf_counter() - t0, stats)
-
-        t0 = time.perf_counter()
-        run_closed(submit, images, args, client_stats)
-        wall = time.perf_counter() - t0
-        engine_side = {}
+            outcome, version = client.predict(body)
+            stats.record(outcome, time.perf_counter() - t0, version)
     else:
         from dml_cnn_cifar10_tpu.serve.batcher import (MicroBatcher,
                                                        ShedError)
@@ -245,64 +332,90 @@ def main(argv=None) -> int:
             else args.deadline_ms / 1e3,
             metrics=metrics)
         print(f"[loadgen] engine ready (compile_s="
-              f"{batcher.compile_secs}); driving {args.mode} loop for "
-              f"{args.duration_s}s", flush=True)
+              f"{batcher.compile_secs}); driving for "
+              f"{args.duration_s}s per profile", flush=True)
 
-        def submit(img, stats):
+        def submit(img, stats, oversize):
+            # Oversize = wrong image shape; admission validation
+            # rejects it before it can reach the queue.
+            if oversize:
+                img = np.zeros((img.shape[0] + 1, *img.shape[1:]),
+                               np.uint8)
             t0 = time.perf_counter()
             try:
-                batcher.submit(img).result()
-                record(True, time.perf_counter() - t0, stats)
+                row = batcher.submit(img).result()
+                stats.record("ok", time.perf_counter() - t0,
+                             getattr(row, "version", None))
             except ShedError:
-                record(False, time.perf_counter() - t0, stats)
+                stats.record("shed", time.perf_counter() - t0)
+            except ValueError:
+                stats.record("rejected")
 
+    def engine_side_stats(reset: bool) -> dict:
+        if metrics is None:
+            return {}
+        return metrics.window(reset=True) if reset \
+            else metrics.cumulative()
+
+    loadgen_meta = {
+        "mode": args.mode if mixes is None else "mix",
+        "engine": "http" if args.target else "inprocess",
+        "concurrency": args.concurrency,
+        "target_qps": args.qps if (mixes or args.mode == "open")
+        else None,
+        "duration_s": args.duration_s,
+        "deadline_ms": args.deadline_ms,
+        "buckets": args.buckets,
+        "queue_depth": args.queue_depth,
+        "batch_window_ms": args.batch_window_ms,
+        "source": args.source,
+        "seed": args.seed,
+    }
+
+    if mixes is None:
+        stats = ClientStats()
         t0 = time.perf_counter()
         if args.mode == "closed":
-            run_closed(submit, images, args, client_stats)
+            run_closed(submit, images, args, stats)
         else:
-            run_open(submit, images, args, client_stats)
+            run_open(submit, images, args, stats)
         wall = time.perf_counter() - t0
+        report = {"loadgen": loadgen_meta,
+                  **_row(stats, wall, latency_summary)}
+        engine_side = engine_side_stats(reset=False)
+        for key in ("batch_fill", "batches", "queue_wait_p50_ms",
+                    "device_p50_ms"):
+            if key in engine_side:
+                report[key] = engine_side[key]
+    else:
+        rows = []
+        for mix in mixes:
+            print(f"[loadgen] mix {mix!r}: open loop, base qps "
+                  f"{args.qps}, {args.duration_s}s", flush=True)
+            stats = ClientStats()
+            t0 = time.perf_counter()
+            run_open(submit, images, args, stats,
+                     rate_fn=MIX_RATE[mix],
+                     oversize_frac=ADVERSARIAL_OVERSIZE
+                     if mix == "adversarial" else 0.0)
+            wall = time.perf_counter() - t0
+            row = {"mix": mix, "duration_s": round(wall, 3),
+                   **_row(stats, wall, latency_summary)}
+            engine_side = engine_side_stats(reset=True)
+            for key in ("batch_fill", "batches"):
+                if key in engine_side:
+                    row[key] = engine_side[key]
+            rows.append(row)
+        report = {"loadgen": loadgen_meta, "mixes": rows}
+
+    if batcher is not None:
         batcher.close()
-        engine_side = metrics.cumulative()
         if args.metrics_jsonl:
             from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
             logger = MetricsLogger(jsonl_path=args.metrics_jsonl)
             metrics.emit(logger, final=True)
             logger.close()
 
-    completed = client_stats["completed"]
-    shed = client_stats["shed"]
-    total = completed + shed
-    lat = latency_summary(client_stats["latencies"])
-    report = {
-        "loadgen": {
-            "mode": args.mode,
-            "engine": "http" if args.target else "inprocess",
-            "concurrency": args.concurrency,
-            "target_qps": args.qps if args.mode == "open" else None,
-            "duration_s": round(wall, 3),
-            "deadline_ms": args.deadline_ms,
-            "buckets": args.buckets,
-            "queue_depth": args.queue_depth,
-            "batch_window_ms": args.batch_window_ms,
-            "source": args.source,
-            "seed": args.seed,
-        },
-        "requests": total,
-        "completed": completed,
-        "shed": shed,
-        "shed_fraction": round(shed / total, 4) if total else 0.0,
-        "achieved_qps": round(completed / wall, 2) if wall else 0.0,
-        "latency_ms": {
-            "p50": lat["p50_ms"], "p95": lat["p95_ms"],
-            "p99": lat["p99_ms"], "mean": lat["mean_ms"],
-            "max": lat["max_ms"],
-        },
-    }
-    for key in ("batch_fill", "batches", "queue_wait_p50_ms",
-                "device_p50_ms"):
-        if key in engine_side:
-            report[key] = engine_side[key]
     with open(args.report, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")

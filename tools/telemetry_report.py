@@ -189,6 +189,70 @@ def summarize(path: str) -> str:
                 f"    warmup: {len(warm)} bucket(s) ready in "
                 f"{wtotal:.2f} s total ({whits} cache hit(s), "
                 f"{len(warm) - whits} compile(s))")
+    # Fleet health (fleet/; docs/SERVING.md fleet section): replica
+    # count over time, routing/eviction counters, hot-swap latency, and
+    # what the autoscaler decided — the stream-side answer to "did the
+    # fleet layer keep the rollout invisible to clients".
+    fleets = [r for r in records if r.get("kind") == "fleet"]
+    fleet_done = _last(records, "fleet_done")
+    swaps = [r for r in records if r.get("kind") == "swap"]
+    swap_rejects = [r for r in records
+                    if r.get("kind") == "swap_rejected"]
+    scales = [r for r in records if r.get("kind") == "scale"]
+    publishes = [r for r in records if r.get("kind") == "fleet_publish"]
+    if fleets or fleet_done or swaps or swap_rejects or scales \
+            or publishes:
+        lines.append("  fleet health:")
+        if fleets or fleet_done:
+            series = fleets or [fleet_done]
+            live_series = [r.get("live") or 0 for r in series]
+            last = series[-1]
+            lines.append(
+                f"    replicas over {len(series)} window(s): live "
+                f"min {min(live_series)} / max {max(live_series)}, "
+                f"final {last.get('live')}/{last.get('replicas')}")
+            # Totals from the cumulative final record when the run
+            # flushed one; summed per-window deltas otherwise (a
+            # router that died mid-run).
+            total = fleet_done or {
+                k: sum(r.get(k) or 0 for r in fleets)
+                for k in ("routed", "rerouted", "evictions", "shed")}
+            lines.append(
+                f"    routed {total.get('routed')} request(s), "
+                f"{total.get('rerouted')} re-routed, "
+                f"{total.get('evictions')} eviction(s), "
+                f"{total.get('shed')} shed")
+            if fleet_done:
+                mix = dict(fleet_done.get("version_mix") or {})
+            else:
+                mix = {}
+                for r in fleets:
+                    for v, n in (r.get("version_mix") or {}).items():
+                        mix[v] = mix.get(v, 0) + n
+            if mix:
+                per = ", ".join(f"v{v}: {n}"
+                                for v, n in sorted(mix.items()))
+                lines.append(f"    version mix: {per}")
+        for r in publishes:
+            lines.append(f"    published version {r.get('version')} "
+                         f"(seq {r.get('seq')})")
+        if swaps:
+            ms = [r.get("swap_ms") or 0.0 for r in swaps]
+            lines.append(
+                f"    {len(swaps)} hot-swap(s), swap latency mean "
+                f"{sum(ms) / len(ms):.1f} / max {max(ms):.1f} ms")
+            for r in swaps:
+                lines.append(
+                    f"      replica {r.get('replica_id')}: "
+                    f"{r.get('from_version')} -> {r.get('version')}")
+        for r in swap_rejects:
+            lines.append(
+                f"    swap REJECTED on replica {r.get('replica_id')} "
+                f"(version {r.get('version')}): {r.get('reason')}")
+        for r in scales:
+            lines.append(
+                f"    autoscale {r.get('action')} "
+                f"({r.get('reason')}) -> {r.get('replicas')} worker(s)")
     # Resilience events (docs/RESILIENCE.md): how many faults the run
     # absorbed, and what the recovery path did about them.
     faults = [r for r in records if r.get("kind") == "fault"]
