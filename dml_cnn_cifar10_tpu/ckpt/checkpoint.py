@@ -148,20 +148,33 @@ def fetch_to_host(state: Any) -> Any:
     return jax.tree.map(to_host, state)
 
 
+def _logger_on_event(logger):
+    """shard_io telemetry bridge: ckpt/sharded.py emits per-shard IO
+    events through this into the MetricsLogger-shaped sink (None stays
+    None — the emit helper no-ops)."""
+    if logger is None:
+        return None
+    return lambda kind, **fields: logger.log(kind, **fields)
+
+
 def save_checkpoint(ckpt_dir: str, state: Any, step: int,
                     keep: int = 3, fmt: str = "msgpack",
-                    logger=None) -> str:
+                    logger=None, shard_io_threads: Optional[int] = None
+                    ) -> str:
     """Fetch (collective-safe) + atomically write ``ckpt_<step>.<fmt>``.
 
     ``fmt='sharded'`` skips the full-state gather entirely: every
     process writes only its own shards (O(state/N) bytes, no
-    allgather) — call it from ALL processes (see ckpt/sharded.py).
+    allgather, shard files written concurrently on up to
+    ``shard_io_threads`` threads) — call it from ALL processes (see
+    ckpt/sharded.py).
     """
     if fmt == "sharded":
         from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
         os.makedirs(ckpt_dir, exist_ok=True)
         path = _ckpt_path(ckpt_dir, step, fmt)
-        sharded_lib.save_sharded(path, state)
+        sharded_lib.save_sharded(path, state, threads=shard_io_threads,
+                                 on_event=_logger_on_event(logger))
         if jax.process_index() == 0:
             _finalize_checkpoint(ckpt_dir, path, keep, logger=logger)
         return path
@@ -318,7 +331,8 @@ def checkpoint_path_at_step(ckpt_dir: str,
 
 
 def _restore_one(path: str, target: Any, host_target: Any,
-                 sharding=None) -> Any:
+                 sharding=None, shard_io_threads: Optional[int] = None,
+                 on_event=None) -> Any:
     """Restore ONE specific checkpoint into ``target``'s structure;
     raises ValueError (with the standard classified message) on a
     config mismatch or corrupt bytes."""
@@ -331,7 +345,8 @@ def _restore_one(path: str, target: Any, host_target: Any,
         # values would be exactly the O(full-state) cost this codec
         # exists to avoid.
         try:
-            restored = sharded_lib.restore_sharded(path, target)
+            restored = sharded_lib.restore_sharded(
+                path, target, threads=shard_io_threads, on_event=on_event)
         except ValueError as e:
             raise ValueError(
                 f"failed to restore checkpoint {path}: {e}") from e
@@ -364,7 +379,9 @@ def _restore_one(path: str, target: Any, host_target: Any,
 
 
 def restore_checkpoint(ckpt_dir: str, target: Any,
-                       sharding=None, on_fallback=None) -> Any:
+                       sharding=None, on_fallback=None,
+                       shard_io_threads: Optional[int] = None,
+                       logger=None) -> Any:
     """Restore the newest VERIFIABLE checkpoint into ``target``'s
     structure, or return ``target`` unchanged if none exists.
 
@@ -378,8 +395,11 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
     failures everywhere raise a summary naming every skip).
 
     ``sharding`` (e.g. a replicated NamedSharding) places the restored
-    arrays back on the mesh.
+    arrays back on the mesh. ``shard_io_threads`` bounds the sharded
+    codec's concurrent shard reads; ``logger`` receives its per-shard
+    ``shard_io`` telemetry records.
     """
+    on_event = _logger_on_event(logger)
     candidates = sorted(_checkpoints(ckpt_dir), reverse=True)
     if not candidates:
         return target
@@ -405,7 +425,9 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
             host_target = fetch_to_host(target)
         try:
             return _restore_one(path, target, host_target,
-                                sharding=sharding)
+                                sharding=sharding,
+                                shard_io_threads=shard_io_threads,
+                                on_event=on_event)
         except ValueError as e:
             if first_error is None:
                 first_error = e
@@ -419,7 +441,9 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
         f"({'; '.join(skipped)})")
 
 
-def restore_checkpoint_at(path: str, target: Any, sharding=None) -> Any:
+def restore_checkpoint_at(path: str, target: Any, sharding=None,
+                          shard_io_threads: Optional[int] = None,
+                          logger=None) -> Any:
     """Restore ONE SPECIFIC checkpoint path into ``target``'s structure.
 
     Unlike :func:`restore_checkpoint` there is no newest→oldest walk:
@@ -435,7 +459,9 @@ def restore_checkpoint_at(path: str, target: Any, sharding=None) -> Any:
                          f"verification: {reason}")
     host_target = None if path.endswith(".sharded") \
         else fetch_to_host(target)
-    return _restore_one(path, target, host_target, sharding=sharding)
+    return _restore_one(path, target, host_target, sharding=sharding,
+                        shard_io_threads=shard_io_threads,
+                        on_event=_logger_on_event(logger))
 
 
 class CheckpointManager:
@@ -458,12 +484,16 @@ class CheckpointManager:
     def __init__(self, ckpt_dir: str, every_steps: int, keep: int = 3,
                  is_chief: Optional[bool] = None, async_save: bool = False,
                  every_secs: Optional[float] = None,
-                 fmt: str = "msgpack", logger=None, on_committed=None):
+                 fmt: str = "msgpack", logger=None, on_committed=None,
+                 shard_io_threads: Optional[int] = None):
         self.ckpt_dir = ckpt_dir
         self.every_steps = max(1, every_steps)
         self.keep = keep
         self.fmt = fmt
         self.on_committed = on_committed
+        # Bounded pool size for the sharded codec's concurrent per-shard
+        # writes (ckpt/sharded.py); None = its default.
+        self.shard_io_threads = shard_io_threads
         # Optional MetricsLogger-shaped sink for checkpoint-maintenance
         # events (ckpt_prune_error); the writer thread may call it.
         self.logger = logger
@@ -593,7 +623,9 @@ class CheckpointManager:
     def _finish_sharded(self, path: str, payload, state: Any, step: int,
                         data_state: Optional[dict]) -> None:
         from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
-        sharded_lib.finish_sharded_save(path, payload, state)
+        sharded_lib.finish_sharded_save(
+            path, payload, state, threads=self.shard_io_threads,
+            on_event=_logger_on_event(self.logger))
         if self.is_chief:
             _finalize_checkpoint(self.ckpt_dir, path, self.keep,
                                  logger=self.logger)

@@ -11,16 +11,29 @@ the SPMD-native alternative:
 - **Save** is collective-free in the data plane: every process fetches
   only its OWN addressable shards (``replica_id == 0`` dedups replicated
   copies so each unique slice is written exactly once, cluster-wide) and
-  writes ``shard_<p>.msgpack`` into ``ckpt_<step>.sharded/``. O(state/N)
-  bytes per process, no allgather.
+  writes its shard file set into ``ckpt_<step>.sharded/``. O(state/N)
+  bytes per process, no allgather. The local payload is split across up
+  to ``shard_io_threads`` part files written CONCURRENTLY by a bounded
+  thread pool, so one host's save is bounded by disk/NIC bandwidth, not
+  one serialize+write thread. Each data file commits (atomic rename)
+  and then its ``.sha256`` integrity sidecar commits after it; finally a
+  per-process ``shard_<p>.files.json`` index commits the file list.
 - One control-plane barrier, then the chief writes ``MANIFEST.json`` —
-  the commit point. A crash before the manifest leaves no valid
+  the commit point — with ``shard_files`` naming EVERY data file of
+  every process (gathered from the per-process index files on the
+  shared filesystem). A crash before the manifest leaves no valid
   checkpoint (restore requires it); a crash after has all shards by
   construction.
-- **Restore** reads the manifest + every shard file, assembles the
-  global arrays on host, and re-shards onto the target mesh — which
-  makes it elastic across process counts and mesh shapes for free (the
-  shard files record *index ranges*, not device ids).
+- **Restore** reads the manifest's shard files CONCURRENTLY (same
+  bounded pool), verifies each against its per-shard sha256 sidecar
+  before assembly (a corrupt shard raises the classified ``ValueError``
+  so ``restore_checkpoint``'s newest→oldest walk falls back, exactly
+  like the top-level sidecars from PR 3), assembles the global arrays
+  on host, and re-shards onto the target mesh — elastic across process
+  counts and mesh shapes for free (the shard files record *index
+  ranges*, not device ids). Every shard read/write emits a ``shard_io``
+  telemetry record (bytes, secs, verify result) so resume time is
+  observable per shard.
 
 Like the reference's checkpoint dir, ``--log_dir`` must be a filesystem
 every process can reach (multi-host restore reads all shard files; on a
@@ -30,9 +43,13 @@ made).
 
 from __future__ import annotations
 
+import concurrent.futures
+import hashlib
 import json
 import os
-from typing import Any, Dict, List, Tuple
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -40,6 +57,19 @@ import numpy as np
 from flax import serialization
 
 MANIFEST = "MANIFEST.json"
+
+#: Default bound for the per-shard save/restore thread pool
+#: (``--shard_io_threads``). 1 degrades to fully serial IO.
+DEFAULT_SHARD_IO_THREADS = 4
+
+#: on_event callback type: called as ``on_event("shard_io", **fields)``
+#: for every shard read/write (and for the legacy-manifest fallback).
+OnEvent = Callable[..., None]
+
+
+def _emit(on_event: Optional[OnEvent], **fields) -> None:
+    if on_event is not None:
+        on_event("shard_io", **fields)
 
 
 def _key_str(key_path) -> str:
@@ -102,16 +132,95 @@ def collect_local_shards(state: Any) -> Dict[str, list]:
     return payload
 
 
-def write_shard_file(ckpt_path: str, payload: Dict[str, list]) -> str:
-    """Atomically write this process's ``shard_<p>.msgpack``."""
-    os.makedirs(ckpt_path, exist_ok=True)
-    fname = os.path.join(ckpt_path, f"shard_{jax.process_index()}.msgpack")
-    data = serialization.msgpack_serialize(payload)
-    tmp = fname + ".tmp"
+def _split_payload(payload: Dict[str, list],
+                   parts: int) -> List[Dict[str, list]]:
+    """Partition the payload's leaf paths into up to ``parts`` groups,
+    greedily balanced by byte size (each path's entries stay together so
+    assembly semantics never change). Deterministic: sorted paths,
+    largest-first into the lightest bin."""
+    if parts <= 1 or len(payload) <= 1:
+        return [payload]
+    parts = min(parts, len(payload))
+    sized = sorted(
+        ((sum(e["data"].nbytes for e in entries), path)
+         for path, entries in payload.items()),
+        reverse=True)
+    bins: List[Dict[str, list]] = [{} for _ in range(parts)]
+    loads = [0] * parts
+    for nbytes, path in sized:
+        i = loads.index(min(loads))
+        bins[i][path] = payload[path]
+        loads[i] += nbytes
+    return [b for b in bins if b]
+
+
+def shard_checksum_path(fname: str) -> str:
+    return fname + ".sha256"
+
+
+def _write_one_shard(ckpt_path: str, fname: str, part: Dict[str, list],
+                     on_event: Optional[OnEvent]) -> Tuple[str, int, float]:
+    """Serialize + atomically write one shard data file, then commit its
+    sha256 sidecar AFTER the data file lands (same ordering contract as
+    the top-level checkpoint sidecars)."""
+    t0 = time.perf_counter()
+    data = serialization.msgpack_serialize(part)
+    full = os.path.join(ckpt_path, fname)
+    tmp = full + f".tmp{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(data)
-    os.replace(tmp, fname)
-    return fname
+    os.replace(tmp, full)
+    sc = shard_checksum_path(full)
+    tmp = sc + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"algo": "sha256",
+                   "digest": hashlib.sha256(data).hexdigest(),
+                   "bytes": len(data)}, f)
+    os.replace(tmp, sc)
+    secs = time.perf_counter() - t0
+    _emit(on_event, op="save", shard=fname, bytes=len(data),
+          secs=round(secs, 6), verify=None)
+    return fname, len(data), secs
+
+
+def write_shard_files(ckpt_path: str, payload: Dict[str, list],
+                      threads: Optional[int] = None,
+                      on_event: Optional[OnEvent] = None) -> List[str]:
+    """Write this process's shard file set (split across up to
+    ``threads`` part files, written concurrently), each with its sha256
+    sidecar, then commit ``shard_<p>.files.json`` naming the set. A
+    single-part payload keeps the legacy ``shard_<p>.msgpack`` name."""
+    threads = DEFAULT_SHARD_IO_THREADS if threads is None else max(1, threads)
+    os.makedirs(ckpt_path, exist_ok=True)
+    pidx = jax.process_index()
+    parts = _split_payload(payload, threads)
+    if len(parts) == 1:
+        names = [f"shard_{pidx}.msgpack"]
+    else:
+        names = [f"shard_{pidx}_{j}.msgpack" for j in range(len(parts))]
+    if len(parts) == 1:
+        _write_one_shard(ckpt_path, names[0], parts[0], on_event)
+    else:
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=threads,
+                thread_name_prefix="shard-io") as pool:
+            list(pool.map(
+                lambda np_: _write_one_shard(ckpt_path, np_[0], np_[1],
+                                             on_event),
+                zip(names, parts)))
+    index = os.path.join(ckpt_path, f"shard_{pidx}.files.json")
+    tmp = index + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"files": names}, f)
+    os.replace(tmp, index)
+    return names
+
+
+def write_shard_file(ckpt_path: str, payload: Dict[str, list]) -> str:
+    """Back-compat single-file write (serial, one part)."""
+    write_shard_files(ckpt_path, payload, threads=1)
+    return os.path.join(ckpt_path,
+                        f"shard_{jax.process_index()}.msgpack")
 
 
 def write_manifest(ckpt_path: str, state: Any) -> None:
@@ -119,14 +228,26 @@ def write_manifest(ckpt_path: str, state: Any) -> None:
 
     ``shard_files`` is the EXACT file list restore may read: a crashed
     (uncommitted) save can leave stale ``shard_*.msgpack`` from a larger
-    process count in the same dir, and an elastic restart that re-reaches
-    the step would otherwise commit a manifest whose restore sees too many
-    files. Enumerating the files in the commit record makes stale
-    leftovers inert."""
+    process count — or from a crashed save at the SAME process count —
+    in the same dir, and enumerating the committed files in the commit
+    record makes stale leftovers inert. The list is gathered from every
+    process's ``shard_<p>.files.json`` index (all durable before the
+    pre-manifest barrier released this chief)."""
+    shard_files: List[str] = []
+    for p in range(jax.process_count()):
+        index = os.path.join(ckpt_path, f"shard_{p}.files.json")
+        try:
+            with open(index) as f:
+                shard_files.extend(json.load(f)["files"])
+        except (OSError, ValueError, KeyError) as e:
+            raise ValueError(
+                f"sharded save of {ckpt_path} incomplete: process {p}'s "
+                f"shard index {index} is missing/unreadable ({e!r}) — "
+                f"unreachable filesystem? (every process must see "
+                f"--log_dir)")
     meta = {
         "process_count": jax.process_count(),
-        "shard_files": [f"shard_{p}.msgpack"
-                        for p in range(jax.process_count())],
+        "shard_files": shard_files,
         "leaves": {
             # .shape/.dtype are metadata — safe even on non-addressable
             # multi-host arrays (np.asarray would NOT be). Plain host
@@ -143,17 +264,22 @@ def write_manifest(ckpt_path: str, state: Any) -> None:
     os.replace(tmp, os.path.join(ckpt_path, MANIFEST))
 
 
-def save_sharded(ckpt_path: str, state: Any) -> None:
+def save_sharded(ckpt_path: str, state: Any,
+                 threads: Optional[int] = None,
+                 on_event: Optional[OnEvent] = None) -> None:
     """Full synchronous save: collect + write + barrier + manifest."""
     payload = collect_local_shards(state)
-    finish_sharded_save(ckpt_path, payload, state)
+    finish_sharded_save(ckpt_path, payload, state, threads=threads,
+                        on_event=on_event)
 
 
 def finish_sharded_save(ckpt_path: str, payload: Dict[str, list],
-                        state: Any) -> None:
+                        state: Any, threads: Optional[int] = None,
+                        on_event: Optional[OnEvent] = None) -> None:
     """Write phase (background-thread safe single-process; multi-host
     runs it synchronously — the barrier is a collective)."""
-    write_shard_file(ckpt_path, payload)
+    write_shard_files(ckpt_path, payload, threads=threads,
+                      on_event=on_event)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         # All shard files durable before the manifest commits.
@@ -163,10 +289,51 @@ def finish_sharded_save(ckpt_path: str, payload: Dict[str, list],
         write_manifest(ckpt_path, state)
 
 
-def restore_sharded(ckpt_path: str, target: Any) -> Any:
+def _read_one_shard(ckpt_path: str, fname: str,
+                    on_event: Optional[OnEvent]) -> Dict[str, Any]:
+    """Read + integrity-verify + unpack one shard file. A present
+    sidecar must match exactly (digest AND byte count); a missing
+    sidecar passes (pre-per-shard-integrity checkpoints stay
+    restorable); an unreadable sidecar fails like a mismatch. Failures
+    raise ``ValueError`` so the newest→oldest restore walk falls back
+    instead of crashing the run."""
+    t0 = time.perf_counter()
+    with open(os.path.join(ckpt_path, fname), "rb") as f:
+        data = f.read()
+    verify = None
+    sc = shard_checksum_path(os.path.join(ckpt_path, fname))
+    if os.path.isfile(sc):
+        try:
+            with open(sc) as f:
+                want = json.load(f)
+            verify = (hashlib.sha256(data).hexdigest() == want["digest"]
+                      and len(data) == want["bytes"])
+        except (OSError, ValueError, KeyError):
+            verify = False
+        if not verify:
+            _emit(on_event, op="restore", shard=fname, bytes=len(data),
+                  secs=round(time.perf_counter() - t0, 6), verify=False)
+            raise ValueError(
+                f"shard file {fname} in {ckpt_path} failed sha256 "
+                f"integrity verification (corrupt/truncated shard or "
+                f"sidecar)")
+    part = serialization.msgpack_restore(data)
+    _emit(on_event, op="restore", shard=fname, bytes=len(data),
+          secs=round(time.perf_counter() - t0, 6), verify=verify)
+    return part
+
+
+def restore_sharded(ckpt_path: str, target: Any,
+                    threads: Optional[int] = None,
+                    on_event: Optional[OnEvent] = None) -> Any:
     """Assemble global host arrays from all shard files onto ``target``'s
     STRUCTURE (its values are never read — device or host arrays both
-    fine). Elastic: any process count / mesh may restore."""
+    fine). Elastic: any process count / mesh may restore. Shard files
+    are read, verified, and unpacked CONCURRENTLY on a bounded pool of
+    ``threads`` (``--shard_io_threads``); the result is deterministic —
+    shards merge in manifest order regardless of IO completion order —
+    so concurrent restore is bit-identical to serial restore."""
+    threads = DEFAULT_SHARD_IO_THREADS if threads is None else max(1, threads)
     with open(os.path.join(ckpt_path, MANIFEST)) as f:
         meta = json.load(f)
     shards: Dict[str, list] = {}
@@ -185,6 +352,17 @@ def restore_sharded(ckpt_path: str, target: Any) -> Any:
                 f"files but was written by {expect} processes — incomplete "
                 f"save or unreachable filesystem (every process must see "
                 f"--log_dir)")
+        # The glob CANNOT tell a valid set from stale shards a crashed
+        # save at the SAME process count left behind (count matches,
+        # bytes may be half-written). Be loud about the weaker
+        # guarantee; new saves always commit `shard_files`.
+        print(f"[ckpt] WARNING: sharded checkpoint {ckpt_path} has a "
+              f"legacy manifest without `shard_files`; restoring via "
+              f"filename glob, which cannot distinguish stale shards "
+              f"from a crashed same-process-count save. Re-save to "
+              f"upgrade the manifest.", file=sys.stderr)
+        _emit(on_event, op="legacy_glob", shard=ckpt_path, bytes=None,
+              secs=None, verify=None)
     missing = [f for f in files
                if not os.path.exists(os.path.join(ckpt_path, f))]
     if missing:
@@ -192,9 +370,18 @@ def restore_sharded(ckpt_path: str, target: Any) -> Any:
             f"sharded checkpoint {ckpt_path} is missing manifest-listed "
             f"shard files {missing} — incomplete save or unreachable "
             f"filesystem (every process must see --log_dir)")
-    for fname in files:
-        with open(os.path.join(ckpt_path, fname), "rb") as f:
-            part = serialization.msgpack_restore(f.read())
+    if threads > 1 and len(files) > 1:
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=threads,
+                thread_name_prefix="shard-io") as pool:
+            # map() preserves submission order: shards merge in manifest
+            # order no matter which read finishes first.
+            parts = list(pool.map(
+                lambda fn: _read_one_shard(ckpt_path, fn, on_event),
+                files))
+    else:
+        parts = [_read_one_shard(ckpt_path, fn, on_event) for fn in files]
+    for part in parts:
         for path, entries in part.items():
             shards.setdefault(path, []).extend(
                 entries.values() if isinstance(entries, dict) else entries)

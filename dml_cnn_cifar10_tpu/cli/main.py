@@ -320,6 +320,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "files (pod-scale: no full-state gather, each "
                         "process writes only its own shards; restore "
                         "auto-detects and is elastic across meshes)")
+    p.add_argument("--shard_io_threads", type=int, default=4,
+                   help="bounded thread pool for the sharded codec's "
+                        "concurrent per-shard file IO: saves split the "
+                        "local payload across up to this many part "
+                        "files written in parallel, restores "
+                        "read+verify+unpack shard files in parallel "
+                        "(per-shard sha256 sidecars; shard_io JSONL "
+                        "telemetry). 1 = fully serial, same bytes")
     p.add_argument("--check_numerics", type="bool", default=False,
                    help="halt at the next metrics boundary on non-finite "
                         "loss without checkpointing the poisoned state "
@@ -358,9 +366,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fault_spec", type=str, default=None,
                    help="deterministic fault injection for recovery "
                         "drills: comma-separated kind@step with kinds "
-                        "nan, ckpt_corrupt, sigterm, data_stall — each "
-                        "fires once at the first dispatch at/after its "
-                        "global step (utils/faults.py)")
+                        "nan, ckpt_corrupt, sigterm, data_stall — plus "
+                        "the cluster kinds heartbeat_stall, host_lost, "
+                        "collective_hang, host_return (need "
+                        "--cluster_dir) — each fires once at the first "
+                        "dispatch at/after its global step "
+                        "(utils/faults.py)")
     p.add_argument("--cluster_dir", type=str, default=None,
                    help="shared directory arming the cluster-resilience "
                         "layer (parallel/cluster.py): per-process "
@@ -392,6 +403,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="floor for coordinated elastic restarts: the "
                         "chief halts instead of shrinking the world "
                         "below this many surviving hosts")
+    p.add_argument("--elastic_expand", type="bool", default=False,
+                   help="elastic scale-UP: a returning (or brand-new) "
+                        "host announces itself with a rejoin-phase "
+                        "heartbeat instead of staying fenced; the chief "
+                        "records a monotone-epoch expand decision "
+                        "growing the world to the live hosts and every "
+                        "process re-enters restore at the larger size "
+                        "(docs/RESILIENCE.md). false = shrink-only: "
+                        "evicted hosts stay fenced")
     p.add_argument("--cluster_lockstep", type="bool", default=False,
                    help="simulation only: make the dispatch seam a "
                         "software barrier over the heartbeat store so "
@@ -547,7 +567,9 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.parallel.peer_dead_after_s = args.peer_dead_after_s
     cfg.parallel.collective_timeout_s = args.collective_timeout_s
     cfg.parallel.min_hosts = args.min_hosts
+    cfg.parallel.elastic_expand = args.elastic_expand
     cfg.parallel.cluster_lockstep = args.cluster_lockstep
+    cfg.shard_io_threads = args.shard_io_threads
     cfg.parallel.coordinator_timeout_s = args.coordinator_timeout_s
     cfg.parallel.coordinator_retries = args.coordinator_retries
     if args.pipe_microbatches and args.pipe_axis <= 1:

@@ -312,6 +312,15 @@ class ParallelConfig:
     # Coordinated elastic restart shrinks the world by the lost hosts;
     # below this floor the chief halts instead of continuing degraded.
     min_hosts: int = 1
+    # Elastic scale-UP: let returning (or brand-new) hosts back in. A
+    # host a restart decision excluded announces itself with a
+    # `rejoin`-phase heartbeat instead of fencing; the chief records a
+    # monotone-epoch EXPAND decision growing the world to the live
+    # hosts, and everyone re-enters restore at the larger size (the
+    # device index stream reshards deterministically — no per-host
+    # sidecar state to migrate). Off = the PR-4 shrink-only contract:
+    # once evicted, fenced forever.
+    elastic_expand: bool = False
     # Simulation only: make the dispatch seam a software barrier over
     # the heartbeat store (wait for every live peer to reach the local
     # step) so multi-process CPU runs without real collectives still
@@ -464,6 +473,15 @@ class TrainConfig:
     # collective, which the chief-only writer would deadlock
     # (ckpt/checkpoint.py).
     ckpt_format: str = "msgpack"
+    # Bounded thread-pool size for the sharded codec's concurrent
+    # per-shard file IO (ckpt/sharded.py): saves split the local
+    # payload across up to this many part files written in parallel,
+    # restores read+verify+unpack shard files in parallel — elastic
+    # transitions at large world sizes become network-bound, not
+    # serialization-bound. 1 = fully serial (bit-identical results
+    # either way; per-shard sha256 sidecars verify each file before
+    # assembly).
+    shard_io_threads: int = 4
     # Overlap checkpoint serialize+write with training on a background
     # writer thread (the device->host fetch stays synchronous — donated
     # step buffers would otherwise race the reader).

@@ -23,10 +23,16 @@ of the contract, arXiv:1902.00465):
   ``collective_timeout_s``, the watchdog aborts the process itself
   (``os._exit``) after logging — a loud corpse beats a silent hang.
 - :class:`RestartCoordinator` — the chief records a restart decision
-  ``{epoch, world_size, restore_step, survivors}`` (atomic rename);
-  surviving non-chiefs poll for it; a process excluded from the
-  survivor set fences itself (:class:`EvictedError`) instead of
-  rejoining a world that already gave up on it.
+  ``{epoch, world_size, restore_step, survivors, kind}`` (atomic
+  rename); surviving non-chiefs poll for it; a process excluded from
+  the survivor set fences itself (:class:`EvictedError`) instead of
+  rejoining a world that already gave up on it — unless elastic
+  scale-UP (``elastic_expand``) is armed, in which case the fence is an
+  invitation: the excluded/returning process announces itself with a
+  ``rejoin``-phase beat, the chief records a monotone-epoch **expand**
+  decision growing the world to the live hosts, and everyone re-enters
+  restore at the larger world size (the device index stream reshards
+  deterministically — no per-host sidecar state to migrate).
 - :class:`ClusterMonitor` — the per-process façade the Trainer and the
   run supervisor use: background beat publisher, watchdog lifecycle,
   seam hooks (``begin_step`` / ``sync`` / ``end_step``), and the
@@ -51,6 +57,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from dml_cnn_cifar10_tpu.utils import backoff
+
 #: Exit code of a watchdog abort (dead peer while blocked in a
 #: collective, or self-classified hang) — distinct from a crash so the
 #: scheduler can tell "fenced by the resilience layer" from "bug".
@@ -60,7 +68,23 @@ EXIT_WATCHDOG_ABORT = 78
 class PeerLostError(RuntimeError):
     """One or more peers' heartbeats went stale past
     ``peer_dead_after_s`` — the run cannot continue at this world size.
-    Classified as recoverable by the supervisor (``peer_lost``)."""
+    Classified as recoverable by the supervisor (``peer_lost``). Also
+    raised (with an EMPTY ``process_ids``) when a newer coordinator
+    epoch is observed mid-step: the chief already committed a new world
+    and the clean move is to exit the step loop and adopt it, not to
+    race the decision file."""
+
+    def __init__(self, process_ids: Sequence[int], message: str):
+        super().__init__(message)
+        self.process_ids = sorted(process_ids)
+
+
+class PeerRejoinError(RuntimeError):
+    """A returning (or brand-new) host announced itself with a
+    ``rejoin``-phase beat while this chief was mid-run. Classified as
+    recoverable by the supervisor (``peer_rejoin``): the chief answers
+    with a coordinated **expand** restart growing the world to the live
+    hosts."""
 
     def __init__(self, process_ids: Sequence[int], message: str):
         super().__init__(message)
@@ -96,6 +120,10 @@ class RestartDecision:
     world_size: int
     restore_step: int
     survivors: List[int]
+    # "shrink" (a host was lost; PR 4) or "expand" (a host rejoined /
+    # arrived; the scale-UP half). Default keeps pre-expand decision
+    # files decodable.
+    kind: str = "shrink"
 
 
 class HeartbeatStore:
@@ -340,11 +368,13 @@ class ClusterMonitor:
                  peer_dead_after_s: float = 10.0,
                  collective_timeout_s: float = 120.0,
                  min_hosts: int = 1, lockstep: bool = False,
+                 elastic_expand: bool = False,
                  logger=None, abort_fn=None):
         self.cluster_dir = cluster_dir
         self.process_id = process_id
         self.min_hosts = min_hosts
         self.lockstep = lockstep
+        self.elastic_expand = elastic_expand
         self.heartbeat_interval_s = heartbeat_interval_s
         self.peer_dead_after_s = peer_dead_after_s
         self._logger = logger
@@ -355,6 +385,7 @@ class ClusterMonitor:
         self._phase = "init"
         self._stalled = False
         self._last_beat_log = 0.0
+        self._last_rejoin_scan = 0.0
         self.store = HeartbeatStore(cluster_dir, process_id)
         self.coordinator = RestartCoordinator(cluster_dir)
         self.watchdog = CollectiveWatchdog(
@@ -383,6 +414,7 @@ class ClusterMonitor:
             collective_timeout_s=parallel_cfg.collective_timeout_s,
             min_hosts=parallel_cfg.min_hosts,
             lockstep=parallel_cfg.cluster_lockstep,
+            elastic_expand=getattr(parallel_cfg, "elastic_expand", False),
             logger=logger, abort_fn=abort_fn)
 
     # -- identity / world ------------------------------------------------
@@ -449,6 +481,7 @@ class ClusterMonitor:
         self.check_evicted(step)
         self.watchdog.arm(step)
         self._raise_if_dead(step)
+        self._maybe_raise_rejoin(step)
 
     def sync(self, step: int, poll_s: float = 0.02) -> None:
         """Simulated collective barrier (``cluster_lockstep``): wait for
@@ -481,14 +514,45 @@ class ClusterMonitor:
                       f"{self.peer_dead_after_s:.1f}s) at step {step}")
 
     def check_evicted(self, step: int) -> None:
+        """Seam check against the coordinator's decision file. Three
+        outcomes for a decision at a NEWER epoch than ours:
+
+        - this process excluded → :class:`EvictedError` (fence; under
+          ``elastic_expand`` the supervisor turns the fence into a
+          rejoin request instead of exiting);
+        - this process included → the chief already committed a new
+          world while we were mid-step (a shrink we have not classified
+          yet, or an expand). Re-read with bounded backoff so we settle
+          on the NEWEST epoch instead of racing a chief that may be
+          writing again, then exit through the clean ``peer_lost`` path
+          (empty ``process_ids``) — the supervisor adopts the pending
+          decision rather than deciding one of its own."""
         d = self.coordinator.read()
-        if d is not None and d.epoch > self.epoch \
-                and self.process_id not in d.survivors:
+        if d is None or d.epoch <= self.epoch:
+            return
+        if self.process_id in d.survivors:
+            # Bounded re-read + backoff (utils/backoff.py): one decision
+            # write can be chased by another (e.g. shrink then expand in
+            # quick succession); settle before acting.
+            for attempt in range(1, 4):
+                time.sleep(backoff.delay_s(0.02, 0.2, attempt))
+                d2 = self.coordinator.read()
+                if d2 is None or d2.epoch <= d.epoch:
+                    break
+                d = d2
+        if self.process_id not in d.survivors:
             self.log("peer_lost", step=step, process_id=self.process_id,
                      reason="evicted")
             raise EvictedError(
                 f"restart epoch {d.epoch} excluded process "
                 f"{self.process_id} (survivors {d.survivors}); fencing")
+        self.watchdog.disarm()
+        self.log("peer_lost", step=step, process_id=self.process_id,
+                 reason="stale_epoch")
+        raise PeerLostError(
+            [], f"coordinator epoch {d.epoch} > adopted epoch "
+                f"{self.epoch} at step {step}: a new world was already "
+                f"committed; re-entering through the restart path")
 
     # -- coordinated elastic restart ------------------------------------
 
@@ -521,13 +585,106 @@ class ClusterMonitor:
         return d
 
     def adopt(self, decision: RestartDecision) -> None:
-        """Enter the new world: smaller survivor set, next epoch, dead
-        bookkeeping cleared (the dead are no longer expected, so their
-        stale beats must stop mattering)."""
+        """Enter the new world: the decision's survivor set (smaller on
+        a shrink, larger on an expand), next epoch, dead bookkeeping
+        cleared (the dead are no longer expected — and a rejoined host
+        must stop counting as a corpse)."""
         self.epoch = decision.epoch
         self._survivors = list(decision.survivors)
         self.watchdog.dead_peers.clear()
         self._phase = "restart"
+
+    # -- coordinated elastic scale-UP (expand) ---------------------------
+
+    def rejoin_candidates(self) -> List[int]:
+        """Process ids OUTSIDE the current survivor set with a FRESH
+        ``rejoin``-phase beat — hosts asking to be let back in (or
+        brand-new hosts announcing themselves). Read-only; any seat may
+        query it (the fault injector's ``host_return`` drill polls it
+        to make the 2→1→2 CPU sim deterministic)."""
+        out = []
+        now = time.time()
+        for pid, beat in self.store.read_all().items():
+            if pid == self.process_id or pid in self._survivors:
+                continue
+            if beat.phase == "rejoin" \
+                    and beat.age_s(now) <= self.peer_dead_after_s:
+                out.append(pid)
+        return sorted(out)
+
+    def _maybe_raise_rejoin(self, step: int) -> None:
+        """Chief-side expand trigger, rate-limited to the heartbeat
+        cadence: a fresh rejoin announcement raises
+        :class:`PeerRejoinError` so the supervisor coordinates the
+        expand. Off unless ``elastic_expand`` — the PR-4 shrink-only
+        behavior (returning hosts stay fenced) is the default."""
+        if not self.elastic_expand or not self.is_chief:
+            return
+        now = time.time()
+        if now - self._last_rejoin_scan < self.heartbeat_interval_s:
+            return
+        self._last_rejoin_scan = now
+        joiners = self.rejoin_candidates()
+        if not joiners:
+            return
+        self.watchdog.disarm()
+        for pid in joiners:
+            self.log("host_rejoin", step=step, process_id=pid,
+                     epoch=self.epoch)
+        raise PeerRejoinError(
+            joiners, f"process(es) {joiners} announced rejoin at step "
+                     f"{step}; coordinating elastic expand")
+
+    def decide_expand(self, joiners: Sequence[int],
+                      restore_step: int) -> RestartDecision:
+        """Chief half of the expand protocol: grow the survivor set by
+        the announced joiners and commit the monotone-epoch decision
+        (atomic rename, same file the shrink path uses). The joiners
+        poll it via :meth:`await_inclusion`; surviving non-chiefs
+        observe the newer epoch at their next seam check and re-enter
+        through the clean ``peer_lost`` path."""
+        survivors = sorted(set(self._survivors) | set(joiners))
+        return self.coordinator.record(RestartDecision(
+            epoch=self.epoch + 1, world_size=len(survivors),
+            restore_step=restore_step, survivors=survivors,
+            kind="expand"))
+
+    def request_rejoin(self) -> None:
+        """Returning-host half: adopt the world that excluded us as the
+        current truth (so :meth:`await_inclusion` waits for a STRICTLY
+        newer epoch), clear the stall/death bookkeeping a previous life
+        may have left, and start announcing with ``rejoin``-phase beats
+        (one published immediately; the background publisher keeps them
+        flowing)."""
+        d = self.coordinator.read()
+        if d is not None and d.epoch > self.epoch:
+            self.epoch = d.epoch
+            self._survivors = list(d.survivors)
+        self.watchdog.dead_peers.clear()
+        self.watchdog.disarm()
+        self._stalled = False
+        self._phase = "rejoin"
+        self.store.publish(self._step, "rejoin")
+
+    def await_inclusion(self, timeout_s: float,
+                        poll_s: float = 0.05) -> RestartDecision:
+        """Block until a decision at a NEWER epoch includes this
+        process. A chief that never answers within ``timeout_s`` is a
+        refused (or coordinator-lost) rejoin: raise ``PeerLostError``
+        so the caller can fence cleanly instead of polling forever."""
+        deadline = time.time() + timeout_s
+        while True:
+            d = self.coordinator.read()
+            if d is not None and d.epoch > self.epoch \
+                    and self.process_id in d.survivors:
+                return d
+            if time.time() > deadline:
+                raise PeerLostError(
+                    [], f"no expand decision including process "
+                        f"{self.process_id} at epoch > {self.epoch} "
+                        f"within {timeout_s:.1f}s — rejoin refused or "
+                        f"coordinator lost")
+            time.sleep(poll_s)
 
     # -- lifecycle -------------------------------------------------------
 

@@ -177,7 +177,9 @@ class Trainer:
 
         return ckpt_lib.restore_checkpoint(
             self.cfg.log_dir, state, sharding=sharding,
-            on_fallback=note_fallback)
+            on_fallback=note_fallback,
+            shard_io_threads=self.cfg.shard_io_threads,
+            logger=self.logger)
 
     def _placed(self, batch: pipe.Batch):
         return mesh_lib.shard_batch(
@@ -461,7 +463,8 @@ class Trainer:
             cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints,
             async_save=cfg.async_checkpoint,
             every_secs=cfg.checkpoint_every_secs, fmt=cfg.ckpt_format,
-            logger=self.logger, on_committed=on_committed)
+            logger=self.logger, on_committed=on_committed,
+            shard_io_threads=cfg.shard_io_threads)
         train_loss, test_accuracy = [], []
         last_metrics = None
         # on_nonfinite="skip" keeps a device-side snapshot of the last

@@ -38,9 +38,19 @@ through the same restore path — checkpoints are placement-free
 just another elastic restore — and a process the decision excludes
 fences itself (:class:`EvictedError`) instead of split-braining the
 run. World size decrements stop at ``--min_hosts``; below that the
-failure re-raises. Everything is testable on CPU in tier-1 via
-``--fault_spec`` (utils/faults.py) and the lockstep simulation
-harness (``tests/test_cluster.py``).
+failure re-raises.
+
+With ``--elastic_expand`` the world also grows back: a ``peer_rejoin``
+failure (a returning or brand-new host announced itself with a
+``rejoin``-phase beat) is recoverable by a coordinated **expand**
+restart through the same monotone-epoch decision file — the chief
+grows the survivor set to the live hosts and picks the restore step;
+the joiner, instead of fencing on :class:`EvictedError`, requests
+rejoin and awaits inclusion; surviving non-chiefs observe the newer
+epoch at the next seam check and adopt it. Everything is testable on
+CPU in tier-1 via ``--fault_spec`` (utils/faults.py, including
+``host_return@N``) and the lockstep simulation harness
+(``tests/test_cluster.py``, ``tests/test_elastic_expand.py``).
 """
 
 from __future__ import annotations
@@ -57,7 +67,8 @@ from dml_cnn_cifar10_tpu.utils import faults as faults_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
 
 #: Failure classes the supervisor may retry.
-RECOVERABLE_FAULTS = ("nonfinite", "data", "ckpt_restore", "peer_lost")
+RECOVERABLE_FAULTS = ("nonfinite", "data", "ckpt_restore", "peer_lost",
+                      "peer_rejoin")
 
 
 def classify_failure(exc: BaseException) -> Optional[str]:
@@ -70,7 +81,11 @@ def classify_failure(exc: BaseException) -> Optional[str]:
       restore path raises) → ``"ckpt_restore"``
     - a peer declared lost by the collective watchdog → ``"peer_lost"``
       (recoverable by coordinated world-shrink, not by plain retry)
+    - a returning host announced rejoin → ``"peer_rejoin"``
+      (recoverable by coordinated world-expand — chief seat only)
     """
+    if isinstance(exc, cluster_lib.PeerRejoinError):
+        return "peer_rejoin"
     if isinstance(exc, cluster_lib.PeerLostError):
         return "peer_lost"
     if isinstance(exc, (faults_lib.DataStallError, DataPipelineError)):
@@ -83,32 +98,92 @@ def classify_failure(exc: BaseException) -> Optional[str]:
     return None
 
 
+def _newest_restore_step(cfg: TrainConfig) -> int:
+    steps = ckpt_lib.all_checkpoint_steps(cfg.log_dir)
+    return max(steps) if steps else 0
+
+
+def _adopt_decision(cfg: TrainConfig, monitor, decision, logger,
+                    attempt: int, lost=()):
+    """Enter the decided world from any seat: adopt, resize the config,
+    and log ``elastic_restart`` (shrink) or ``elastic_expand`` (grow)
+    keyed on the decision's kind."""
+    prev = set(monitor.live_set())
+    monitor.adopt(decision)
+    cfg.parallel.num_processes = decision.world_size
+    expand = getattr(decision, "kind", "shrink") == "expand"
+    fields = dict(step=decision.restore_step,
+                  restore_step=decision.restore_step,
+                  world_size=decision.world_size, epoch=decision.epoch,
+                  attempt=attempt)
+    if expand:
+        joined = [p for p in decision.survivors if p not in prev]
+        logger.log("elastic_expand", joined=joined, **fields)
+        print(f"[supervisor] elastic expand epoch {decision.epoch}: "
+              f"joined {joined}, world size {decision.world_size}, "
+              f"restoring from step {decision.restore_step}")
+    else:
+        logger.log("elastic_restart", lost=list(lost), **fields)
+        print(f"[supervisor] elastic restart epoch {decision.epoch}: "
+              f"lost {list(lost)}, world size {decision.world_size}, "
+              f"restoring from step {decision.restore_step}")
+    return decision
+
+
 def _coordinate_restart(cfg: TrainConfig, monitor, exc, logger,
                         attempt: int):
     """The coordinated elastic-restart protocol, from this process's
-    seat. Chief: shrink the survivor set by the lost peers (halting
-    below ``min_hosts``), pick the restore step (newest checkpoint on
-    disk — the same one every survivor's ``init_or_restore`` walk will
-    find), commit the decision. Non-chief: poll for it, fencing if
-    excluded. Both: adopt the new world and log ``elastic_restart``."""
-    if monitor.is_chief:
-        steps = ckpt_lib.all_checkpoint_steps(cfg.log_dir)
-        restore_step = max(steps) if steps else 0
-        decision = monitor.decide_restart(exc.process_ids, restore_step)
+    seat. A decision at a newer epoch that already includes us (we
+    observed it mid-step, or the chief committed while we were
+    unwinding) is adopted as-is — never race the chief's decision file
+    with one of our own. Otherwise — chief: shrink the survivor set by
+    the lost peers (halting below ``min_hosts``), pick the restore step
+    (newest checkpoint on disk — the same one every survivor's
+    ``init_or_restore`` walk will find), commit the decision.
+    Non-chief: poll for it, fencing if excluded. All seats: adopt the
+    new world and log the matching JSONL record."""
+    pending = monitor.coordinator.read()
+    if pending is not None and pending.epoch > monitor.epoch \
+            and monitor.process_id in pending.survivors:
+        decision = pending
+    elif monitor.is_chief:
+        decision = monitor.decide_restart(exc.process_ids,
+                                          _newest_restore_step(cfg))
     else:
         timeout = max(30.0, cfg.parallel.peer_dead_after_s * 6)
         decision = monitor.await_restart(timeout)
-    monitor.adopt(decision)
-    cfg.parallel.num_processes = decision.world_size
-    logger.log("elastic_restart", step=decision.restore_step,
-               restore_step=decision.restore_step,
-               world_size=decision.world_size, epoch=decision.epoch,
-               attempt=attempt, lost=list(exc.process_ids))
-    print(f"[supervisor] elastic restart epoch {decision.epoch}: "
-          f"lost {list(exc.process_ids)}, world size "
-          f"{decision.world_size}, restoring from step "
-          f"{decision.restore_step}")
-    return decision
+    return _adopt_decision(cfg, monitor, decision, logger, attempt,
+                           lost=exc.process_ids)
+
+
+def _coordinate_expand(cfg: TrainConfig, monitor, exc, logger,
+                       attempt: int):
+    """Chief half of the scale-UP protocol (only the chief raises
+    ``PeerRejoinError``): grow the world by the announced joiners,
+    restore from the newest checkpoint, commit, adopt."""
+    decision = monitor.decide_expand(exc.process_ids,
+                                     _newest_restore_step(cfg))
+    return _adopt_decision(cfg, monitor, decision, logger, attempt)
+
+
+def _request_rejoin(cfg: TrainConfig, monitor, logger, attempt: int):
+    """Returning-host half: announce with ``rejoin``-phase beats, wait
+    (bounded) for an expand decision that includes us, adopt it.
+    Returns the decision, or None when the rejoin was refused/timed out
+    — the caller fences cleanly, exactly as without
+    ``--elastic_expand``."""
+    monitor.request_rejoin()
+    logger.log("host_rejoin", step=monitor._step,
+               process_id=monitor.process_id, epoch=monitor.epoch)
+    print(f"[supervisor] process {monitor.process_id} announcing rejoin "
+          f"(epoch {monitor.epoch}); awaiting an expand decision")
+    timeout = max(60.0, cfg.parallel.peer_dead_after_s * 24)
+    try:
+        decision = monitor.await_inclusion(timeout)
+    except cluster_lib.PeerLostError as e:
+        print(f"[supervisor] rejoin not granted: {e}")
+        return None
+    return _adopt_decision(cfg, monitor, decision, logger, attempt)
 
 
 def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
@@ -138,9 +213,19 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
             except cluster_lib.EvictedError as e:
                 # The surviving world already restarted without this
                 # process (a stalled heartbeat looks dead from outside).
-                # Exit cleanly and saveless — rejoining would
-                # split-brain the run. The monitor logged `peer_lost`
-                # (reason "evicted") at detection.
+                # Without --elastic_expand: exit cleanly and saveless —
+                # rejoining would split-brain the run (the monitor
+                # logged `peer_lost` reason "evicted" at detection).
+                # WITH it, the fence is an invitation: announce rejoin
+                # and wait for the chief's expand decision; only a
+                # refused/timed-out rejoin still fences.
+                if monitor is not None and cfg.parallel.elastic_expand \
+                        and attempt < cfg.recovery_retries:
+                    attempt += 1
+                    decision = _request_rejoin(cfg, monitor, logger,
+                                               attempt)
+                    if decision is not None:
+                        continue
                 print(f"[supervisor] fenced: {e}")
                 return None
             except Exception as e:
@@ -151,10 +236,18 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                     # halt stays a halt; an exhausted skip budget
                     # already degraded to halt inside the loop.
                     raise
-                if fault == "peer_lost" and monitor is None:
+                if fault in ("peer_lost", "peer_rejoin") \
+                        and monitor is None:
                     raise
                 attempt += 1
-                if fault == "peer_lost":
+                if fault == "peer_rejoin":
+                    # Chief seat of the scale-UP: grow the world by the
+                    # announced joiners and re-enter restore at the
+                    # larger size.
+                    decision = _coordinate_expand(cfg, monitor, e,
+                                                  logger, attempt)
+                    restore_step = decision.restore_step
+                elif fault == "peer_lost":
                     # May re-raise PeerLostError (below min_hosts —
                     # unrecoverable) or fence this process (the
                     # decision excluded it while it was awaiting).
@@ -162,6 +255,13 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                         decision = _coordinate_restart(cfg, monitor, e,
                                                        logger, attempt)
                     except cluster_lib.EvictedError as ev:
+                        # Excluded while awaiting the decision: same
+                        # fence-or-rejoin choice as the in-loop fence.
+                        if cfg.parallel.elastic_expand:
+                            decision = _request_rejoin(cfg, monitor,
+                                                       logger, attempt)
+                            if decision is not None:
+                                continue
                         print(f"[supervisor] fenced: {ev}")
                         return None
                     restore_step = decision.restore_step

@@ -42,6 +42,13 @@ Cluster-resilience kinds (need a :class:`~parallel.cluster.ClusterMonitor`
   case. Peers see a fresh-but-behind straggler; this process's own
   watchdog eventually aborts it (``collective_timeout_s``), turning
   the silent hang into a classified host loss.
+- ``host_return`` — the deterministic stand-in for "a host came back
+  at step N": block the (surviving) process at the seam until a
+  returning host's ``rejoin``-phase beat appears in the store, so the
+  2→1→2 elastic scale-UP drill expands at a known step instead of
+  racing the returning process's startup. The expand itself then runs
+  through the real chief-side rejoin scan (``--elastic_expand``). A
+  drill where nobody ever returns fails loudly after a bounded wait.
 
 Every injection logs a ``fault`` JSONL record (``injected: true``) so
 recovery tooling can pair injections with the ``recovery`` records they
@@ -53,10 +60,18 @@ from __future__ import annotations
 import dataclasses
 import os
 import signal
+import time
 from typing import List, Optional
 
 FAULT_KINDS = ("nan", "ckpt_corrupt", "sigterm", "data_stall",
-               "heartbeat_stall", "host_lost", "collective_hang")
+               "heartbeat_stall", "host_lost", "collective_hang",
+               "host_return")
+
+#: Bounded wait for a ``host_return`` drill's returning host: long
+#: enough for a cold process start (imports + restore + compile), short
+#: enough that a drill where nobody returns fails the run, not the CI
+#: budget.
+HOST_RETURN_TIMEOUT_S = 300.0
 
 #: Exit code of a ``host_lost`` injection — an abrupt, cleanup-free
 #: death (distinct from the watchdog's own abort code so tests can tell
@@ -141,6 +156,12 @@ def corrupt_latest_checkpoint(log_dir: str) -> Optional[str]:
             os.path.join(path, n) for n in os.listdir(path)
             if os.path.isfile(os.path.join(path, n))
             and n != "MANIFEST.json")
+        # Prefer a DATA member over sidecar/index files (the sharded
+        # dir now carries per-shard .sha256 + files.json companions):
+        # truncating real payload exercises the integrity walk, not
+        # just the metadata parse.
+        data = [m for m in members if m.endswith(".msgpack")]
+        members = data or members
         if not members:  # nothing but the manifest — truncate that
             members = [os.path.join(path, "MANIFEST.json")]
         victim = members[0]
@@ -231,7 +252,36 @@ class FaultInjector:
                 # beating — exactly what a stuck XLA collective looks
                 # like. Only the watchdog's collective_timeout_s abort
                 # (os._exit) ends this loop.
-                import time
                 while True:
+                    time.sleep(0.05)
+            elif ev.kind == "host_return":
+                if cluster is None:
+                    raise InjectedFault(
+                        "host_return injection needs --cluster_dir "
+                        "(no beat store to watch for the rejoin)")
+                ev.fired = True
+                self._log(logger, step, ev.kind)
+                # Pin "the host returns here": hold this step until a
+                # rejoin announcement is visible, so the chief's rejoin
+                # scan fires at the very next seam — the 2→1→2 drill
+                # expands before it can checkpoint world-shrunk
+                # progress past the shared restore point. An expand the
+                # chief ALREADY granted (the returning host announced
+                # before this step) satisfies the drill too — the beat
+                # is consumed by the grant, so waiting for one would
+                # hang a run that already did the right thing.
+                deadline = time.time() + HOST_RETURN_TIMEOUT_S
+                while not cluster.rejoin_candidates():
+                    d = cluster.coordinator.read()
+                    if d is not None and \
+                            getattr(d, "kind", "shrink") == "expand":
+                        break
+                    if time.time() > deadline:
+                        raise InjectedFault(
+                            f"host_return@{ev.step}: no rejoin "
+                            f"announcement within "
+                            f"{HOST_RETURN_TIMEOUT_S:.0f}s — did the "
+                            f"returning host start with "
+                            f"--elastic_expand?")
                     time.sleep(0.05)
         return state

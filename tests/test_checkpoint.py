@@ -130,7 +130,7 @@ def test_sharded_member_corruption_falls_back(tmp_path):
     s1 = _state(seed=1)
     ckpt_lib.save_checkpoint(str(tmp_path), s1, step=1)
     p2 = ckpt_lib.save_checkpoint(str(tmp_path), _state(seed=2), step=2,
-                                  fmt="sharded")
+                                  fmt="sharded", shard_io_threads=1)
     shard = os.path.join(p2, "shard_0.msgpack")
     with open(shard, "r+b") as f:
         f.truncate(os.path.getsize(shard) // 2)
@@ -393,8 +393,13 @@ def test_sharded_roundtrip_fsdp(tmp_path, rng):
     state, _ = train(state, im, lb)
 
     path = ckpt_lib.save_checkpoint(str(tmp_path), state, step=1,
-                                fmt="sharded")
-    assert sorted(os.listdir(path)) == ["MANIFEST.json", "shard_0.msgpack"]
+                                fmt="sharded", shard_io_threads=1)
+    # threads=1 keeps the legacy single-data-file layout; every data
+    # file now carries a per-shard sha256 sidecar and the per-process
+    # file index the manifest's shard_files is gathered from.
+    assert sorted(os.listdir(path)) == [
+        "MANIFEST.json", "shard_0.files.json", "shard_0.msgpack",
+        "shard_0.msgpack.sha256"]
     assert ckpt_lib.latest_checkpoint(str(tmp_path)) == path
 
     fresh = step_lib.init_train_state(
@@ -530,7 +535,8 @@ def test_sharded_overlapping_entries_raise(tmp_path):
     from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
 
     state = _state()
-    ckpt_lib.save_checkpoint(str(tmp_path), state, step=1, fmt="sharded")
+    ckpt_lib.save_checkpoint(str(tmp_path), state, step=1, fmt="sharded",
+                             shard_io_threads=1)
     ckpt_dir = os.path.join(str(tmp_path), "ckpt_1.sharded")
     shard_file = os.path.join(ckpt_dir, "shard_0.msgpack")
     with open(shard_file, "rb") as f:
@@ -543,6 +549,10 @@ def test_sharded_overlapping_entries_raise(tmp_path):
     payload[path0] = entries + [entries[0]]
     with open(shard_file, "wb") as f:
         f.write(serialization.msgpack_serialize(payload))
+    # Drop the per-shard sidecar: hand-merged files come without one
+    # (legacy pass-through), and this test pins the coverage mask, not
+    # the integrity layer (tests/test_sharded_io.py pins that).
+    os.remove(shard_file + ".sha256")
     with pytest.raises(ValueError, match="overlap"):
         sharded_lib.restore_sharded(ckpt_dir, _state(seed=4))
 
@@ -553,7 +563,8 @@ def test_sharded_manifest_missing_listed_file_raises(tmp_path):
     from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
 
     state = _state()
-    ckpt_lib.save_checkpoint(str(tmp_path), state, step=2, fmt="sharded")
+    ckpt_lib.save_checkpoint(str(tmp_path), state, step=2, fmt="sharded",
+                             shard_io_threads=1)
     ckpt_dir = os.path.join(str(tmp_path), "ckpt_2.sharded")
     os.remove(os.path.join(ckpt_dir, "shard_0.msgpack"))
     with pytest.raises(ValueError, match="missing manifest-listed"):

@@ -1,0 +1,345 @@
+"""Elastic scale-UP (ISSUE 7): host rejoin + coordinated expand.
+
+Unit tests for the rejoin/expand protocol seams in parallel/cluster.py
+and the ISSUE-7 acceptance sim: a 2-process CPU lockstep run loses host
+1 (`host_lost@15`), shrinks to world size 1, the host RETURNS (the
+harness respawns it, the survivor's `host_return@18` injection pins the
+step), the chief records a monotone-epoch EXPAND decision, both
+processes re-enter restore at world size 2, and the final params are
+BIT-IDENTICAL to an uninterrupted 2-process run — with `host_rejoin` /
+`elastic_expand` events in schema-clean JSONL streams."""
+
+import json
+import os
+import time
+
+import pytest
+
+from dml_cnn_cifar10_tpu.parallel import cluster as cluster_lib
+from dml_cnn_cifar10_tpu.utils import faults as faults_lib
+
+from tests.test_cluster import (FakeLogger, _monitor, _read_result,
+                                _spawn, _ensure_data)
+
+
+# ---------------------------------------------------------------------------
+# protocol units
+# ---------------------------------------------------------------------------
+
+def test_rejoin_candidates_sees_fresh_rejoin_beats_only(tmp_path):
+    mon = _monitor(tmp_path, 0, n=1)
+    try:
+        outsider = cluster_lib.HeartbeatStore(str(tmp_path), 7)
+        outsider.publish(0, "train")          # wrong phase
+        assert mon.rejoin_candidates() == []
+        outsider.publish(0, "rejoin")         # fresh + rejoin
+        assert mon.rejoin_candidates() == [7]
+        # A survivor's beat never counts as a rejoin candidate.
+        member = cluster_lib.HeartbeatStore(str(tmp_path), 0)
+        member.publish(3, "rejoin")
+        assert mon.rejoin_candidates() == [7]
+    finally:
+        mon.close()
+
+
+def test_decide_expand_grows_world_with_monotone_epoch(tmp_path):
+    mon = _monitor(tmp_path, 0, n=2)
+    try:
+        shrink = mon.decide_restart([1], restore_step=10)
+        mon.adopt(shrink)
+        assert mon.world_size() == 1 and shrink.kind == "shrink"
+        d = mon.decide_expand([1], restore_step=10)
+        assert d.kind == "expand" and d.epoch == 2
+        assert d.survivors == [0, 1] and d.world_size == 2
+        mon.adopt(d)
+        assert mon.world_size() == 2 and mon.epoch == 2
+        # The decision file stays monotone across kinds.
+        with pytest.raises(ValueError, match="monotone"):
+            mon.coordinator.record(cluster_lib.RestartDecision(
+                epoch=2, world_size=2, restore_step=10, survivors=[0, 1]))
+    finally:
+        mon.close()
+
+
+def test_begin_step_raises_peer_rejoin_for_chief_with_expand_on(tmp_path):
+    log = FakeLogger()
+    mon = _monitor(tmp_path, 0, n=1, logger=log, elastic_expand=True)
+    try:
+        joiner = cluster_lib.HeartbeatStore(str(tmp_path), 1)
+        joiner.publish(10, "rejoin")
+        mon._last_rejoin_scan = 0.0
+        with pytest.raises(cluster_lib.PeerRejoinError) as ei:
+            mon.begin_step(11)
+        assert ei.value.process_ids == [1]
+        assert any(r["kind"] == "host_rejoin" and r["process_id"] == 1
+                   for r in log.records)
+    finally:
+        mon.close()
+
+
+def test_rejoin_scan_is_off_by_default(tmp_path):
+    """Without --elastic_expand the PR-4 contract holds: a rejoin
+    announcement is ignored and the world stays shrunk."""
+    mon = _monitor(tmp_path, 0, n=1)
+    try:
+        cluster_lib.HeartbeatStore(str(tmp_path), 1).publish(5, "rejoin")
+        mon._last_rejoin_scan = 0.0
+        mon.begin_step(6)  # no raise
+        mon.end_step(7)
+    finally:
+        mon.close()
+
+
+def test_request_rejoin_and_await_inclusion(tmp_path):
+    """Returning-host seat: adopt the excluding world as current truth,
+    announce with a rejoin-phase beat, then block until a NEWER epoch
+    includes us."""
+    mon = _monitor(tmp_path, 1, n=2)
+    try:
+        mon.coordinator.record(cluster_lib.RestartDecision(
+            epoch=1, world_size=1, restore_step=10, survivors=[0]))
+        mon.stall_heartbeats()
+        mon.request_rejoin()
+        assert mon.epoch == 1 and not mon._stalled
+        beat = mon.store.read(1)
+        assert beat.phase == "rejoin"
+        # Not yet included → bounded wait raises.
+        with pytest.raises(cluster_lib.PeerLostError, match="rejoin"):
+            mon.await_inclusion(timeout_s=0.2, poll_s=0.02)
+        # The chief's expand decision lets us in.
+        mon.coordinator.record(cluster_lib.RestartDecision(
+            epoch=2, world_size=2, restore_step=10, survivors=[0, 1],
+            kind="expand"))
+        d = mon.await_inclusion(timeout_s=1.0)
+        assert d.epoch == 2 and d.kind == "expand"
+        mon.adopt(d)
+        assert mon.world_size() == 2
+    finally:
+        mon.close()
+
+
+def test_stale_epoch_mid_step_exits_via_clean_peer_lost(tmp_path):
+    """ISSUE-7 satellite: a non-chief that observes a NEWER coordinator
+    epoch that still includes it must not race the decision file — it
+    exits through the peer_lost path (empty process_ids) after a
+    bounded re-read, and the supervisor adopts the pending decision."""
+    log = FakeLogger()
+    mon = _monitor(tmp_path, 1, n=2, logger=log)
+    try:
+        mon.coordinator.record(cluster_lib.RestartDecision(
+            epoch=1, world_size=2, restore_step=20, survivors=[0, 1],
+            kind="expand"))
+        with pytest.raises(cluster_lib.PeerLostError) as ei:
+            mon.check_evicted(25)
+        assert ei.value.process_ids == []
+        assert any(r["kind"] == "peer_lost"
+                   and r["reason"] == "stale_epoch" for r in log.records)
+        # The supervisor seat adopts the pending decision instead of
+        # deciding its own (no epoch race).
+        from dml_cnn_cifar10_tpu.config import TrainConfig
+        from dml_cnn_cifar10_tpu.train import supervisor as sup
+        cfg = TrainConfig()
+        cfg.parallel.num_processes = 2
+        d = sup._coordinate_restart(cfg, mon, ei.value, FakeLogger(), 1)
+        assert d.epoch == 1 and mon.epoch == 1
+        assert cfg.parallel.num_processes == 2
+    finally:
+        mon.close()
+
+
+def test_classify_and_fault_spec_cover_rejoin_kinds():
+    from dml_cnn_cifar10_tpu.train.supervisor import classify_failure
+    assert classify_failure(
+        cluster_lib.PeerRejoinError([2], "x")) == "peer_rejoin"
+    events = faults_lib.parse_fault_spec("host_lost@15,host_return@18")
+    assert [(e.kind, e.step) for e in events] == [("host_lost", 15),
+                                                 ("host_return", 18)]
+    inj = faults_lib.FaultInjector(
+        faults_lib.parse_fault_spec("host_return@0"))
+    with pytest.raises(faults_lib.InjectedFault, match="cluster_dir"):
+        inj.step_hook(0, None, "/tmp", cluster=None)
+
+
+def test_host_return_unblocks_on_rejoin_beat(tmp_path):
+    """The drill injection holds the step until a rejoin announcement
+    is visible, then returns (the chief's scan drives the expand)."""
+    mon = _monitor(tmp_path, 0, n=1)
+    try:
+        inj = faults_lib.FaultInjector(
+            faults_lib.parse_fault_spec("host_return@5"))
+        import threading
+        done = threading.Event()
+
+        def run():
+            inj.step_hook(5, "state", str(tmp_path), cluster=mon)
+            done.set()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(0.15)
+        assert not done.is_set()          # still holding the seam
+        cluster_lib.HeartbeatStore(str(tmp_path), 1).publish(0, "rejoin")
+        assert done.wait(5.0)
+        assert inj.pending() == []
+    finally:
+        mon.close()
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE-7 acceptance sim: 2 → 1 → 2, bit-identical to uninterrupted
+# ---------------------------------------------------------------------------
+
+WORKER = """
+import json, sys
+from dml_cnn_cifar10_tpu.utils.platform import force_cpu
+force_cpu()
+task, n, data_dir, log_dir, cluster_dir, fault_spec, total_steps = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6], int(sys.argv[7]))
+import hashlib
+import numpy as np
+import jax
+from dml_cnn_cifar10_tpu.config import TrainConfig, DataConfig
+from dml_cnn_cifar10_tpu.train.supervisor import fit_supervised
+
+cfg = TrainConfig(
+    batch_size=32, total_steps=total_steps, output_every=10,
+    eval_every=20, checkpoint_every=10, log_dir=log_dir,
+    metrics_jsonl=f"{log_dir}/metrics.jsonl",
+    data=DataConfig(dataset="synthetic", data_dir=data_dir,
+                    synthetic_train_records=256, synthetic_test_records=64,
+                    normalize="scale", use_native_loader=False),
+)
+cfg.model.logit_relu = False
+cfg.optim.learning_rate = 0.05
+cfg.keep_checkpoints = 20   # retention must not prune the restore point
+cfg.recovery_backoff_s = 0.05
+cfg.recovery_backoff_max_s = 0.2
+cfg.fault_spec = fault_spec or None
+cfg.parallel.process_id = task
+cfg.parallel.num_processes = n
+if cluster_dir:
+    cfg.parallel.cluster_dir = cluster_dir
+    cfg.parallel.cluster_lockstep = True
+    cfg.parallel.elastic_expand = True
+    cfg.parallel.heartbeat_interval_s = 0.1
+    cfg.parallel.straggler_after_s = 0.4
+    cfg.parallel.peer_dead_after_s = 2.5
+    cfg.parallel.collective_timeout_s = 300.0
+
+res = fit_supervised(cfg, task_index=task)
+if res is None:
+    print("RESULT " + json.dumps({"task": task, "fenced": True}))
+    sys.exit(0)
+h = hashlib.sha256()
+for leaf in jax.tree.leaves(jax.device_get(res.state.params)):
+    h.update(np.ascontiguousarray(leaf).tobytes())
+print("RESULT " + json.dumps({
+    "task": task, "fenced": False, "final_step": res.final_step,
+    "digest": h.hexdigest()}))
+"""
+
+
+def test_sim_2_1_2_expand_bit_identical_to_uninterrupted(tmp_path,
+                                                         data_cfg):
+    """host_lost@15 on task 1, host_return@18 on task 0: the survivor
+    shrinks to world 1 from ckpt_10, holds step 18 until the respawned
+    host announces rejoin, expands back to world 2 (epoch 2) restoring
+    ckpt_10, and BOTH processes finish step 40 with params
+    bit-identical to an uninterrupted 2-process reference run."""
+    data_dir = _ensure_data(tmp_path, data_cfg)
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+
+    # The uninterrupted 2-process lockstep reference (fresh dirs).
+    ref_cluster = str(tmp_path / "ref_cluster")
+    ref_logs = [str(tmp_path / f"ref_logs_{t}") for t in (0, 1)]
+    ref_procs = [_spawn(script, [t, 2, data_dir, ref_logs[t],
+                                 ref_cluster, "", 40], tmp_path)
+                 for t in (0, 1)]
+    ref_outs = [p.communicate(timeout=300)[0] for p in ref_procs]
+    for p, out in zip(ref_procs, ref_outs):
+        assert p.returncode == 0, f"reference run failed:\n{out}"
+    ref = [_read_result(o) for o in ref_outs]
+    assert all(r["final_step"] == 40 for r in ref)
+
+    # The elastic run: task 1 dies at 15; task 0 pins the return at 18.
+    cluster_dir = str(tmp_path / "cluster")
+    logs = [str(tmp_path / f"logs_{t}") for t in (0, 1)]
+    procs = [
+        _spawn(script, [0, 2, data_dir, logs[0], cluster_dir,
+                        "host_return@18", 40], tmp_path),
+        _spawn(script, [1, 2, data_dir, logs[1], cluster_dir,
+                        "host_lost@15", 40], tmp_path),
+    ]
+    rejoined = None
+    try:
+        # The scheduler seat: respawn task 1 once its first life exits
+        # with the abrupt-death code AND the survivor has committed the
+        # shrink decision — a host that returns before the world even
+        # noticed it was gone just keeps beating and nothing shrank
+        # (there is no death to recover from, and no drill).
+        assert procs[1].wait(timeout=300) == faults_lib.EXIT_HOST_LOST, \
+            procs[1].communicate()[0]
+        coord = cluster_lib.RestartCoordinator(cluster_dir)
+        deadline = time.time() + 240
+        while True:
+            d = coord.read()
+            if d is not None and d.epoch >= 1:
+                break
+            assert time.time() < deadline, "survivor never shrank"
+            assert procs[0].poll() is None, \
+                f"survivor died early:\n{procs[0].communicate()[0]}"
+            time.sleep(0.1)
+        rejoined = _spawn(script, [1, 2, data_dir, logs[1], cluster_dir,
+                                   "", 40], tmp_path)
+        outs = [procs[0].communicate(timeout=300)[0],
+                rejoined.communicate(timeout=300)[0]]
+    finally:
+        for p in procs + ([rejoined] if rejoined else []):
+            if p.poll() is None:
+                p.kill()
+    assert procs[0].returncode == 0, f"survivor failed:\n{outs[0]}"
+    assert rejoined.returncode == 0, f"rejoined host failed:\n{outs[1]}"
+
+    survivor = _read_result(outs[0])
+    joiner = _read_result(outs[1])
+    assert not survivor["fenced"] and not joiner["fenced"]
+    assert survivor["final_step"] == 40 and joiner["final_step"] == 40
+
+    # Bit-identical to the uninterrupted 2-process run, on BOTH seats.
+    assert survivor["digest"] == ref[0]["digest"]
+    assert joiner["digest"] == ref[1]["digest"]
+
+    # Stream contract: the survivor classified the loss, shrank, saw
+    # the rejoin, expanded; the joiner announced and adopted the
+    # expand. Both streams pass the schema lint.
+    from tools import check_jsonl_schema, telemetry_report
+    streams = []
+    for d in logs:
+        with open(os.path.join(d, "metrics.jsonl")) as f:
+            streams.append([json.loads(ln) for ln in f if ln.strip()])
+    for recs in streams:
+        assert check_jsonl_schema.check_lines(
+            json.dumps(r) for r in recs) == []
+    s_kinds = {r["kind"] for r in streams[0]}
+    assert {"peer_lost", "elastic_restart", "host_rejoin",
+            "elastic_expand"} <= s_kinds
+    shrink = [r for r in streams[0] if r["kind"] == "elastic_restart"]
+    assert shrink[0]["world_size"] == 1 and shrink[0]["epoch"] == 1
+    expand = [r for r in streams[0] if r["kind"] == "elastic_expand"]
+    assert expand[0]["world_size"] == 2 and expand[0]["epoch"] == 2
+    assert expand[0]["restore_step"] == 10
+    assert expand[0]["joined"] == [1]
+    j_kinds = {r["kind"] for r in streams[1]}
+    assert {"host_rejoin", "elastic_expand"} <= j_kinds
+    j_expand = [r for r in streams[1] if r["kind"] == "elastic_expand"]
+    assert j_expand[0]["world_size"] == 2
+    assert j_expand[0]["restore_step"] == 10
+
+    # The report CLI renders the full shrink→expand arc.
+    out = telemetry_report.summarize(os.path.join(logs[0],
+                                                  "metrics.jsonl"))
+    assert "elastic expand epoch 2" in out
+    assert "world-size timeline: 1[shrink@" in out
+    assert "2[expand@" in out

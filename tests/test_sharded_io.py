@@ -1,0 +1,261 @@
+"""Sharded fast-resume (ckpt/sharded.py): concurrent per-shard IO with
+per-shard sha256 integrity sidecars — ISSUE-7's checkpoint half.
+
+Pins: split-save/restore roundtrips bit-identical at any thread count
+(concurrent == serial), a corrupted shard or sidecar (flip/truncate ×
+shard/sidecar) triggers the newest→oldest fallback restore instead of a
+crash, new manifests always carry ``shard_files`` while legacy
+manifests restore via the loudly-flagged glob path, and the ``shard_io``
+JSONL telemetry is schema-clean and summarized by the report CLI."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dml_cnn_cifar10_tpu import ckpt as ckpt_lib
+from dml_cnn_cifar10_tpu.ckpt import sharded as sharded_lib
+from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig, OptimConfig
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.parallel import step as step_lib
+
+
+def _state(seed=0):
+    return step_lib.init_train_state(
+        jax.random.key(seed), get_model("cnn"), ModelConfig(), DataConfig(),
+        OptimConfig())
+
+
+class Events:
+    def __init__(self):
+        self.records = []
+
+    def __call__(self, kind, **fields):
+        self.records.append({"kind": kind, **fields})
+
+    def of(self, op):
+        return [r for r in self.records if r.get("op") == op]
+
+
+class FakeLogger:
+    """MetricsLogger-shaped sink for the checkpoint.py plumbing."""
+
+    def __init__(self):
+        self.records = []
+
+    def log(self, kind, **fields):
+        self.records.append({"kind": kind, **fields})
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(x)),
+                                      np.asarray(jax.device_get(y)))
+
+
+# ---------------------------------------------------------------------------
+# split save + concurrent restore: bit-identical at every thread count
+# ---------------------------------------------------------------------------
+
+def test_split_save_restores_bit_identical_and_emits_shard_io(tmp_path):
+    state = _state(seed=1)
+    ev = Events()
+    path = os.path.join(str(tmp_path), "ckpt_4.sharded")
+    sharded_lib.save_sharded(path, state, threads=4, on_event=ev)
+    # The payload split into multiple concurrently-written part files,
+    # each with its own sha256 sidecar, plus the per-process index.
+    names = sorted(n for n in os.listdir(path) if n.endswith(".msgpack"))
+    assert len(names) > 1
+    for n in names:
+        assert os.path.isfile(os.path.join(path, n + ".sha256"))
+    with open(os.path.join(path, "shard_0.files.json")) as f:
+        assert sorted(json.load(f)["files"]) == names
+    # Every data file produced a save-side shard_io record.
+    assert sorted(r["shard"] for r in ev.of("save")) == names
+    assert all(r["bytes"] > 0 and r["secs"] >= 0 for r in ev.of("save"))
+
+    # Concurrent restore == serial restore == the saved state.
+    serial = sharded_lib.restore_sharded(path, _state(seed=9), threads=1)
+    conc = sharded_lib.restore_sharded(path, _state(seed=9), threads=4,
+                                       on_event=ev)
+    _assert_trees_equal(state, serial)
+    _assert_trees_equal(serial, conc)
+    restores = ev.of("restore")
+    assert sorted(r["shard"] for r in restores) == names
+    assert all(r["verify"] is True for r in restores)
+
+
+def test_manifest_always_carries_shard_files(tmp_path):
+    """ISSUE-7 satellite: new saves must always commit the exact file
+    list — the glob fallback cannot tell stale shards of a crashed
+    same-process-count save from a valid set."""
+    for threads in (1, 4):
+        path = os.path.join(str(tmp_path), f"ckpt_{threads}.sharded")
+        sharded_lib.save_sharded(path, _state(), threads=threads)
+        with open(os.path.join(path, sharded_lib.MANIFEST)) as f:
+            meta = json.load(f)
+        assert meta["shard_files"], meta
+        for n in meta["shard_files"]:
+            assert os.path.isfile(os.path.join(path, n))
+
+
+def test_legacy_manifest_glob_fallback_warns_loudly(tmp_path, capsys):
+    """A manifest WITHOUT shard_files (pre-ISSUE-7 save) still
+    restores via the filename glob — with a stderr warning and a
+    `legacy_glob` shard_io event, because that path cannot rule out
+    stale shards from a crashed save at the SAME process count."""
+    state = _state(seed=3)
+    path = os.path.join(str(tmp_path), "ckpt_1.sharded")
+    sharded_lib.save_sharded(path, state, threads=1)
+    mpath = os.path.join(path, sharded_lib.MANIFEST)
+    with open(mpath) as f:
+        meta = json.load(f)
+    del meta["shard_files"]
+    with open(mpath, "w") as f:
+        json.dump(meta, f)
+    ev = Events()
+    restored = sharded_lib.restore_sharded(path, _state(seed=8),
+                                           on_event=ev)
+    _assert_trees_equal(state, restored)
+    assert ev.of("legacy_glob"), ev.records
+    assert "legacy manifest" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# per-shard integrity: flip/truncate × shard/sidecar → fallback, no crash
+# ---------------------------------------------------------------------------
+
+def _corrupt(victim: str, mode: str) -> None:
+    if mode == "flip":
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.seek(size // 2)
+            byte = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([byte[0] ^ 0xFF]))
+    else:  # truncate
+        with open(victim, "r+b") as f:
+            f.truncate(os.path.getsize(victim) // 2)
+
+
+@pytest.mark.parametrize("target", ["shard", "sidecar"])
+@pytest.mark.parametrize("mode", ["flip", "truncate"])
+def test_per_shard_corruption_falls_back_to_older(tmp_path, target, mode):
+    """Per-shard sha256 verification catches a damaged shard OR sidecar
+    even when the TOP-LEVEL sidecar is gone (a pre-integrity-era dir):
+    the classified ValueError sends restore_checkpoint's newest→oldest
+    walk back to the previous checkpoint instead of crashing."""
+    s1 = _state(seed=1)
+    ckpt_lib.save_checkpoint(str(tmp_path), s1, step=1, fmt="sharded")
+    s2 = _state(seed=2)
+    p2 = ckpt_lib.save_checkpoint(str(tmp_path), s2, step=2, fmt="sharded")
+    # Remove the whole-checkpoint sidecar so ONLY the per-shard layer
+    # stands between the corruption and the restore.
+    os.remove(ckpt_lib.checkpoint.checksum_path(p2))
+    shard = sorted(n for n in os.listdir(p2)
+                   if n.endswith(".msgpack"))[0]
+    victim = os.path.join(p2, shard)
+    if target == "sidecar":
+        victim += ".sha256"
+    _corrupt(victim, mode)
+    ev = FakeLogger()
+    restored = ckpt_lib.restore_checkpoint(str(tmp_path), _state(seed=9),
+                                           logger=ev)
+    _assert_trees_equal(s1, restored)
+    # The damaged shard surfaced as a failed per-shard verify (flip or
+    # truncate of the DATA file; a broken sidecar fails before any
+    # bytes are trusted) and the walk fell back.
+    if target == "shard":
+        fails = [r for r in ev.records if r["kind"] == "shard_io"
+                 and r.get("verify") is False]
+        assert fails and fails[0]["shard"] == shard
+
+
+def test_missing_per_shard_sidecar_is_back_compat(tmp_path):
+    """Pre-per-shard-integrity checkpoints (no .sha256 next to the
+    shard file) still restore; verify reports null, not failure."""
+    state = _state(seed=5)
+    path = os.path.join(str(tmp_path), "ckpt_1.sharded")
+    sharded_lib.save_sharded(path, state, threads=1)
+    os.remove(os.path.join(path, "shard_0.msgpack.sha256"))
+    ev = Events()
+    restored = sharded_lib.restore_sharded(path, _state(seed=7),
+                                           on_event=ev)
+    _assert_trees_equal(state, restored)
+    assert [r["verify"] for r in ev.of("restore")] == [None]
+
+
+# ---------------------------------------------------------------------------
+# manager + schema + report plumbing
+# ---------------------------------------------------------------------------
+
+def test_manager_threads_shard_io_events_to_logger(tmp_path):
+    log = FakeLogger()
+    mgr = ckpt_lib.CheckpointManager(str(tmp_path), every_steps=1,
+                                     fmt="sharded", logger=log,
+                                     shard_io_threads=4)
+    assert mgr.maybe_save(_state(), 1)
+    saves = [r for r in log.records if r["kind"] == "shard_io"
+             and r["op"] == "save"]
+    assert len(saves) > 1  # split parts, one record each
+
+
+def test_shard_io_stream_is_schema_clean_and_reported(tmp_path):
+    """End-to-end over the real JSONL writer: save + restore shard_io
+    rows pass the schema lint and telemetry_report prints the
+    resume-time breakdown."""
+    from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+    from tools import check_jsonl_schema, telemetry_report
+
+    jsonl = os.path.join(str(tmp_path), "m.jsonl")
+    log = MetricsLogger(jsonl)
+    state = _state(seed=2)
+    ckpt_lib.save_checkpoint(str(tmp_path), state, step=1, fmt="sharded",
+                             logger=log, shard_io_threads=4)
+    ckpt_lib.restore_checkpoint(str(tmp_path), _state(seed=6), logger=log)
+    log.close()
+    assert check_jsonl_schema.check_file(jsonl) == []
+    out = telemetry_report.summarize(jsonl)
+    assert "shard io:" in out
+    assert "save:" in out and "restore:" in out
+    assert "verify failure" in out
+
+
+def test_report_world_size_timeline_and_rejoins():
+    """The cluster-health section renders shrink AND expand decisions
+    as a world-size timeline plus rejoin announcements (fed synthetic
+    records — the sim tests produce the real stream)."""
+    import tempfile
+
+    from tools import check_jsonl_schema, telemetry_report
+
+    recs = [
+        {"kind": "heartbeat", "t": 0.1, "task": 0, "step": 1,
+         "process_id": 0, "phase": "train"},
+        {"kind": "peer_lost", "t": 1.0, "task": 0, "step": 15,
+         "process_id": 1, "reason": "stale_heartbeat"},
+        {"kind": "elastic_restart", "t": 1.1, "task": 0, "step": 15,
+         "restore_step": 10, "world_size": 1, "epoch": 1, "attempt": 1,
+         "lost": [1]},
+        {"kind": "host_rejoin", "t": 2.0, "task": 0, "step": 18,
+         "process_id": 1, "epoch": 1},
+        {"kind": "elastic_expand", "t": 2.1, "task": 0, "step": 19,
+         "restore_step": 10, "world_size": 2, "epoch": 2, "attempt": 2,
+         "joined": [1]},
+    ]
+    assert check_jsonl_schema.check_lines(
+        json.dumps(r) for r in recs) == []
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        path = f.name
+    try:
+        out = telemetry_report.summarize(path)
+    finally:
+        os.unlink(path)
+    assert "world-size timeline: 1[shrink@15] -> 2[expand@19]" in out
+    assert "host_rejoin: process 1 announced at step 18" in out
+    assert "elastic expand epoch 2" in out

@@ -63,6 +63,22 @@ KIND_KEYS = {
     "peer_lost": ("step", "process_id", "reason"),
     "elastic_restart": ("step", "restore_step", "world_size", "epoch",
                         "attempt"),
+    # Elastic scale-UP (--elastic_expand). `host_rejoin` is a rejoin
+    # announcement — logged by the returning host when it starts
+    # beating with phase "rejoin", and by the chief when its scan
+    # detects one; `elastic_expand` is the adopted coordinated-expand
+    # decision (grown world, restore step, epoch) — the scale-UP twin
+    # of `elastic_restart`.
+    "host_rejoin": ("step", "process_id", "epoch"),
+    "elastic_expand": ("step", "restore_step", "world_size", "epoch",
+                       "attempt"),
+    # Sharded-checkpoint fast-resume (ckpt/sharded.py). One record per
+    # shard file written (`op: save` — verify null, the digest is being
+    # created) or read (`op: restore` — verify true/false/null, null =
+    # pre-integrity shard without a sidecar); `op: legacy_glob` flags a
+    # manifest without `shard_files` restored via filename glob (bytes/
+    # secs/verify null).
+    "shard_io": ("op", "shard", "bytes", "secs", "verify"),
     # Compilation cache (compilecache/; docs/COMPILECACHE.md). One
     # record per compile-seam lookup: `key` is the program fingerprint
     # (null when no cache is configured but the seam still reports its

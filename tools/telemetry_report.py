@@ -280,13 +280,15 @@ def summarize(path: str) -> str:
                 f"    [{len(prune_errs)} checkpoint prune failure(s) — "
                 f"old checkpoints may be accumulating]")
     # Cluster health (parallel/cluster.py): beat cadence per process,
-    # straggler pressure, peer deaths, and elastic restarts — the
-    # stream-side answer to "did the cluster layer earn its keep".
+    # straggler pressure, peer deaths, elastic restarts AND expands —
+    # the stream-side answer to "did the cluster layer earn its keep".
     beats = [r for r in records if r.get("kind") == "heartbeat"]
     stragglers = [r for r in records if r.get("kind") == "straggler"]
     losses = [r for r in records if r.get("kind") == "peer_lost"]
     restarts = [r for r in records if r.get("kind") == "elastic_restart"]
-    if beats or stragglers or losses or restarts:
+    expands = [r for r in records if r.get("kind") == "elastic_expand"]
+    rejoins = [r for r in records if r.get("kind") == "host_rejoin"]
+    if beats or stragglers or losses or restarts or expands or rejoins:
         lines.append("  cluster health:")
         by_pid = {}
         for r in beats:
@@ -313,11 +315,54 @@ def summarize(path: str) -> str:
             lines.append(
                 f"    peer_lost: process {r.get('process_id')} at step "
                 f"{r.get('step')} ({r.get('reason')})")
+        for r in rejoins:
+            lines.append(
+                f"    host_rejoin: process {r.get('process_id')} "
+                f"announced at step {r.get('step')} "
+                f"(epoch {r.get('epoch')})")
         for r in restarts:
             lines.append(
                 f"    elastic restart epoch {r.get('epoch')}: world "
                 f"size {r.get('world_size')}, restored step "
                 f"{r.get('restore_step')}")
+        for r in expands:
+            lines.append(
+                f"    elastic expand epoch {r.get('epoch')}: world "
+                f"size {r.get('world_size')} "
+                f"(joined {r.get('joined')}), restored step "
+                f"{r.get('restore_step')}")
+        transitions = sorted(restarts + expands,
+                             key=lambda r: (r.get("epoch") or 0))
+        if transitions:
+            # The world-size timeline in one line: every adopted
+            # shrink/expand decision in epoch order.
+            arc = " -> ".join(
+                f"{r.get('world_size')}"
+                f"[{'expand' if r.get('kind') == 'elastic_expand' else 'shrink'}"
+                f"@{r.get('step')}]" for r in transitions)
+            lines.append(f"    world-size timeline: {arc}")
+    # Sharded fast-resume breakdown (ckpt/sharded.py `shard_io` rows):
+    # how many shard files moved, how many bytes, and the slowest shard
+    # — the wall-clock of a concurrent phase is its slowest member.
+    sios = [r for r in records if r.get("kind") == "shard_io"]
+    if sios:
+        lines.append("  shard io:")
+        for op in ("save", "restore"):
+            rows = [r for r in sios if r.get("op") == op]
+            if not rows:
+                continue
+            nbytes = sum(r.get("bytes") or 0 for r in rows)
+            secs = [r.get("secs") or 0.0 for r in rows]
+            fails = sum(1 for r in rows if r.get("verify") is False)
+            lines.append(
+                f"    {op}: {len(rows)} shard(s), {_fmt_bytes(nbytes)}, "
+                f"{sum(secs):.3f} s io (slowest {max(secs):.3f} s), "
+                f"{fails} verify failure(s)")
+        legacy = [r for r in sios if r.get("op") == "legacy_glob"]
+        for r in legacy:
+            lines.append(
+                f"    [legacy manifest without shard_files restored "
+                f"via glob: {r.get('shard')}]")
     hbm = _last(records, "hbm")
     if hbm:
         if hbm.get("available"):
