@@ -20,6 +20,10 @@ Round-5 (verdict #4/#5) methodology:
 - The headline config uses the DEVICE index stream
   (``data/device_stream.py``): the training dispatch uploads nothing at
   all. A host-index A/B row rides along.
+- Round 8: every row also records a per-dispatch step-time tail
+  (``step_ms_p50`` / ``step_ms_p99`` + the raw series) from a separate
+  drained sampling pass, so ``tools/bench_gate.py`` can flag tail
+  regressions the windowed mean hides.
 
 Baseline note: the reference publishes NO performance numbers
 (``README.md``, SURVEY §6 — ``BASELINE.json.published == {}``).
@@ -178,6 +182,21 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
         float(jax.device_get(metrics["loss"]))  # full drain
         dt = time.perf_counter() - t0
         rates.append(chunks * chunk_k * cfg.batch_size / dt / n_chips)
+
+    # Step-time tail (round 8): the windowed rates above report the
+    # MEAN; a periodic stall (GC, allocator, a slow collective) hides
+    # in it completely. A separate sampling pass times individual
+    # dispatches, each drained — per-dispatch drains serialize host and
+    # device, so these samples are NOT comparable to the throughput
+    # windows (each carries one drain round trip); they exist to rank
+    # p99 against p50, which tools/bench_gate.py gates on.
+    from dml_cnn_cifar10_tpu.utils.telemetry import percentile
+    tail_ms = []
+    for _ in range(min(chunks, 30)):
+        t0 = time.perf_counter()
+        state, metrics = chunk(state, *next(prefetch))
+        float(jax.device_get(metrics["loss"]))
+        tail_ms.append((time.perf_counter() - t0) / chunk_k * 1e3)
     # One extra (unused) batch before the pipeline closes: its avals let
     # the flops probe below look the TIMED chunk program up in the
     # compile cache without rebuilding shardings by hand.
@@ -191,6 +210,13 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
         "img_s_max": round(max(rates), 1),
         "spread_pct": round(100.0 * (max(rates) - min(rates)) / med, 2),
         "reps": reps,
+        # Per-step time distribution from the drained sampling pass
+        # (see above: includes a drain per dispatch — gate on the
+        # p99/p50 RATIO trajectory, not on these vs the mean rate).
+        "step_ms_p50": round(percentile(tail_ms, 50), 4),
+        "step_ms_p99": round(percentile(tail_ms, 99), 4),
+        "step_ms_samples": len(tail_ms),
+        "step_ms_series": [round(v, 4) for v in tail_ms],
     }
 
     # Per-step FLOPs. With the compile cache armed both figures come

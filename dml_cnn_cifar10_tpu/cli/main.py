@@ -469,6 +469,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write TensorBoard event files (chief only; the "
                         "reference's MTS wrote summaries to --log_dir)")
     p.add_argument("--profile_dir", type=str, default=None)
+    p.add_argument("--profile_at_steps", type=str, default=None,
+                   help="device-time attribution window 'N:K': capture "
+                        "a programmatic jax.profiler trace from global "
+                        "step N for K steps (closing at the next "
+                        "drained metrics boundary), parse it host-side, "
+                        "and emit per-op `devtime` JSONL records "
+                        "(top-k ops; compute/collective/infeed "
+                        "buckets). Writes under --profile_dir when "
+                        "set, else <log_dir>/devprof "
+                        "(docs/OBSERVABILITY.md)")
     p.add_argument("--seed", type=int, default=0)
     return p
 
@@ -502,6 +512,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         ckpt_format=args.ckpt_format,
         tensorboard_dir=args.tensorboard_dir,
         profile_dir=args.profile_dir,
+        profile_at_steps=args.profile_at_steps,
         seed=args.seed,
     )
     cfg.data.dataset = args.dataset

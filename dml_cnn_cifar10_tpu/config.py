@@ -586,6 +586,16 @@ class TrainConfig:
     tensorboard_dir: Optional[str] = None
     seed: int = 0
     profile_dir: Optional[str] = None     # jax.profiler trace output
+    # Device-time attribution window (utils/devprof.py): "N:K" captures
+    # a programmatic jax.profiler trace from global step N for K steps
+    # (stopping at the next DRAINED metrics boundary so the window
+    # closes on quiesced devices), parses it host-side, and emits
+    # per-op/per-lane `devtime` JSONL records (top-k ops, compute vs
+    # collective vs infeed buckets). Writes under --profile_dir when
+    # set, else <log_dir>/devprof. None = off. Unlike --profile_dir
+    # alone (whole-run capture, UI analysis), this is a bounded window
+    # with the analysis built in.
+    profile_at_steps: Optional[str] = None
 
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)

@@ -184,6 +184,10 @@ class _BeatPublisher(threading.Thread):
             extra={"replica_id": self.store.process_id,
                    "version": self.engine.version,
                    "queue_depth": self.batcher.queue_depth(),
+                   # Device-time attribution for the fleet: the router/
+                   # autoscaler (and trace_aggregate's request-flow
+                   # view) can tell a slow DEVICE from a deep queue.
+                   "device_ms": self.batcher.metrics.recent_device_ms(),
                    "port": self.port_ref.get("port")})
 
     def run(self) -> None:

@@ -476,8 +476,14 @@ class ClusterMonitor:
             now = time.time()
             if now - self._last_beat_log >= self.heartbeat_interval_s:
                 self._last_beat_log = now
+                # wallclock anchors cross-host clock alignment: each
+                # process's JSONL `t` is relative to ITS logger start,
+                # so tools/trace_aggregate.py recovers a per-stream
+                # unix offset from (wallclock - t) to merge streams
+                # onto one timeline.
                 self.log("heartbeat", step=step,
-                         process_id=self.process_id, phase=phase)
+                         process_id=self.process_id, phase=phase,
+                         wallclock=round(now, 3))
         self.check_evicted(step)
         self.watchdog.arm(step)
         self._raise_if_dead(step)

@@ -247,9 +247,14 @@ def _step_body(loss_fn, optim_cfg: OptimConfig,
     accum = max(1, optim_cfg.grad_accum)
 
     def grad_and_metrics(params, model_state, images, labels):
-        (loss, (logits, new_model_state, stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, model_state, images, labels)
-        acc = metrics_lib.batch_accuracy(logits, labels)
+        # named_scope prefixes the emitted ops so a --profile_at_steps
+        # device-time table (utils/devprof.py) can attribute fwd/bwd
+        # work vs the optimizer update by name; no numeric effect.
+        with jax.named_scope("fwd_bwd"):
+            (loss, (logits, new_model_state, stats)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, model_state, images, labels)
+            acc = metrics_lib.batch_accuracy(logits, labels)
         metrics = {"loss": loss, "accuracy": acc, **stats}
         return grads, metrics, new_model_state
 
@@ -299,8 +304,9 @@ def _step_body(loss_fn, optim_cfg: OptimConfig,
                 micro, (zeros, zeros_m, state.model_state), (ims, lbs))
             grads = jax.tree.map(lambda g: g / accum, gsum)
             metrics = jax.tree.map(lambda v: v / accum, msum)
-        new_params, new_opt = optim_lib.sgd_update(grads, state.opt,
-                                                   state.params, optim_cfg)
+        with jax.named_scope("optimizer"):
+            new_params, new_opt = optim_lib.sgd_update(
+                grads, state.opt, state.params, optim_cfg)
         if health_metrics:
             metrics.update(_health_stats(state.params, new_params, grads))
         if staleness >= 2:

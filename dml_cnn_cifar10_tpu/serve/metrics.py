@@ -87,6 +87,7 @@ class ServeMetrics:
         lat = latency_summary(w.latencies)
         qw50 = percentile(w.queue_waits, 50)
         dev50 = percentile(w.device_secs, 50)
+        dev99 = percentile(w.device_secs, 99)
         return {
             "requests": w.submitted,
             "completed": w.completed,
@@ -101,11 +102,24 @@ class ServeMetrics:
                 None if qw50 is None else round(qw50 * 1e3, 3),
             "device_p50_ms":
                 None if dev50 is None else round(dev50 * 1e3, 3),
+            "device_p99_ms":
+                None if dev99 is None else round(dev99 * 1e3, 3),
             "batches": w.batches,
             "batch_fill":
                 round(sum(w.fills) / len(w.fills), 4) if w.fills else None,
             "window_s": round(span, 3),
         }
+
+    def recent_device_ms(self) -> Optional[float]:
+        """Median per-batch DEVICE milliseconds over the recent batches
+        (current window, falling back to run lifetime) — the serving
+        analogue of the trainer's ``device_step_ms``, advertised in
+        fleet heartbeats so the router/autoscaler can tell a slow
+        device from a deep queue. ``None`` before the first batch."""
+        with self._lock:
+            vals = (self._win.device_secs or self._total.device_secs)[-64:]
+        p = percentile(vals, 50)
+        return None if p is None else round(p * 1e3, 3)
 
     def window(self, reset: bool = True) -> dict:
         """Stats since the last window reset (the periodic serve record)."""

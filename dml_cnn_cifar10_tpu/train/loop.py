@@ -36,6 +36,7 @@ from dml_cnn_cifar10_tpu.parallel import cluster as cluster_lib
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import multihost
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
+from dml_cnn_cifar10_tpu.utils import devprof as devprof_lib
 from dml_cnn_cifar10_tpu.utils import faults as faults_lib
 from dml_cnn_cifar10_tpu.utils import telemetry as telemetry_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
@@ -445,6 +446,14 @@ class Trainer:
         # to a shared no-op context manager.
         tracer = telemetry_lib.SpanTracer(enabled=cfg.telemetry)
         self._tracer = tracer  # exposed for tests/diagnostics
+        # Device-time attribution (utils/devprof.py): the always-on
+        # step-time estimator rides the existing fused boundary fetch
+        # (two clock reads, zero device traffic — the parity test pins
+        # it), and --profile_at_steps arms a bounded jax.profiler
+        # window whose trace is parsed host-side into `devtime` JSONL.
+        dev_est = devprof_lib.DeviceStepEstimator()
+        devwin = devprof_lib.ProfileWindow.from_config(cfg,
+                                                       logger=self.logger)
         # Online train-and-serve (--fleet_publish): every committed
         # checkpoint is published to the fleet's coordination dir so
         # live serve workers hot-swap to it between micro-batches. The
@@ -580,9 +589,14 @@ class Trainer:
         sync_stride = max(1, cfg.preempt_sync_every // k)
         n_dispatch = 0
         try:
-            with PreemptionGuard() as preempt, profile_trace(cfg.profile_dir):
+            # A step-gated capture window owns the profiler when armed;
+            # whole-run capture into --profile_dir remains the default.
+            with PreemptionGuard() as preempt, profile_trace(
+                    cfg.profile_dir if devwin is None else None):
                 while global_step < total_steps and not stop:
                     drained = False
+                    if devwin is not None:
+                        devwin.maybe_start(global_step)
                     if self.cluster is not None:
                         # Dispatch-seam liveness (parallel/cluster.py):
                         # publish a beat, check for eviction, arm the
@@ -649,6 +663,7 @@ class Trainer:
                         # the drain meter here so the FIRST boundary
                         # reports a real post-compile rate instead of 0.0.
                         meter.mark(global_step)
+                        dev_est.mark(global_step)
                         run_t0 = time.perf_counter()
                         import threading
 
@@ -760,10 +775,16 @@ class Trainer:
                                       jnp.float32) for mk in fused_keys]
                         # The fused fetch is a true drain: the host blocks
                         # on device compute, so the span is device-busy
-                        # time — traced, but counted as productive.
+                        # time — traced, but counted as productive. The
+                        # two clock reads around it feed the device
+                        # step-time estimator (no extra fetches).
+                        t_drain0 = time.perf_counter()
                         with tracer.span("boundary_drain"):
                             fused = jax.device_get(
                                 jnp.concatenate(parts))
+                        t_drain1 = time.perf_counter()
+                        device_step_ms, drain_wait_ms = dev_est.boundary(
+                            global_step, t_drain0, t_drain1)
                         rate = meter.rate(global_step)
                         drained = True
                         loss, acc = float(fused[0]), float(fused[1])
@@ -819,6 +840,8 @@ class Trainer:
                                         train_accuracy=acc,
                                         images_per_sec=rate,
                                         lr=_current_lr(cfg, global_step),
+                                        device_step_ms=device_step_ms,
+                                        drain_wait_ms=drain_wait_ms,
                                         **perf)
                         telemetry_lib.flush_boundary(tracer, self.logger,
                                                      global_step)
@@ -882,6 +905,12 @@ class Trainer:
                         # starts AFTER this iteration's eval/checkpoint
                         # work, so only training dispatches are timed.
                         meter.mark(global_step)
+                        dev_est.mark(global_step)
+                    if devwin is not None:
+                        # The capture stops only at a drained boundary
+                        # at/after its stop step — quiesced devices, no
+                        # truncated in-flight dispatches.
+                        devwin.maybe_stop(global_step, drained=drained)
 
                 # Final save covers both normal completion and preemption: the
                 # in-flight step finished, so the checkpoint loses zero work.
@@ -947,6 +976,12 @@ class Trainer:
             # matter.
             ckpt_mgr.close()
             prefetch.close()
+            # A capture window the run ended (or crashed) inside still
+            # stops, parses, and emits its devtime records — like the
+            # Chrome trace below, the runs that die mid-window are
+            # exactly the ones worth attributing.
+            if devwin is not None:
+                devwin.close(global_step)
             # A supervisor-owned monitor must keep its threads (and
             # epoch/world state) across fit attempts; only a monitor
             # this Trainer built for itself dies with the fit.

@@ -1,0 +1,307 @@
+"""Device-time performance attribution: programmatic profiler capture
+windows and a zero-fetch device step-time estimator.
+
+The PR-1 telemetry layer (``utils/telemetry.py``) times the HOST loop —
+it can say the run spent 95% of wall-clock "training" and still not know
+where the device spent that time (the headline bench sat at ~27% MFU for
+five rounds with nothing pointing at the other 73%). This module closes
+that gap from two directions, both honoring the loop's round-trip budget
+(zero extra device fetches — ``tests/test_telemetry.py`` pins it):
+
+- :class:`ProfileWindow` — ``--profile_at_steps N:K`` arms a
+  programmatic ``jax.profiler`` capture from global step N for K steps,
+  written under ``--profile_dir`` (default ``<log_dir>/devprof``). On
+  stop, the captured Chrome trace is parsed HOST-SIDE into a per-lane
+  device-time table — top-k ops and compute / collective / infeed
+  buckets — and emitted as ``devtime`` JSONL records that
+  ``tools/telemetry_report.py`` renders. No trace UI required to answer
+  "which op owns the step".
+- :class:`DeviceStepEstimator` — an always-on per-boundary estimate of
+  the device-side step time, measured as the block-until-ready delta at
+  the loop's EXISTING fused metrics fetch (the fetch drains everything
+  dispatched since the last boundary, so ``drain_end − window_start``
+  bounds the device's busy window; divided by the steps in the window
+  it is the per-step device time). ``train`` rows gain
+  ``device_step_ms`` + ``drain_wait_ms``: a ``drain_wait_ms`` near the
+  full window means the host idled on the device (device-bound — the
+  step itself must get faster); near zero means the device idled on the
+  host (host-bound — feed it better). Two ``perf_counter`` reads per
+  boundary, no device traffic.
+
+Bucket semantics (op names, lowercased): ``collective`` matches the
+cross-device primitives (all-reduce / all-gather / reduce-scatter /
+all-to-all / collective-permute / send / recv), ``infeed`` matches data
+movement (in/outfeed, copies, transfers), everything else is
+``compute``. On backends whose profiler emits no per-op device lanes
+(CPU: host-side runtime events only) the parser falls back to the host
+lanes so the record shape — and the tier-1 tests — stay identical; the
+table then attributes runtime phases rather than XLA ops.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import time
+from typing import List, Optional
+
+#: Device-time buckets, in report order.
+DEVTIME_BUCKETS = ("compute", "collective", "infeed")
+
+_COLLECTIVE_RE = re.compile(
+    r"all[-_]?reduce|all[-_]?gather|reduce[-_]?scatter|all[-_]?to[-_]?all"
+    r"|collective[-_]?permute|collective|ppermute|psum|\bsend\b|\brecv\b")
+_INFEED_RE = re.compile(
+    r"infeed|outfeed|\bcopy\b|copy[-_]?start|copy[-_]?done|transfer"
+    r"|memcpy|h2d|d2h|host[-_]?to[-_]?device|device[-_]?to[-_]?host")
+
+
+def classify_op(name: str) -> str:
+    """Bucket an op/event name: ``collective`` | ``infeed`` | ``compute``."""
+    low = name.lower()
+    if _COLLECTIVE_RE.search(low):
+        return "collective"
+    if _INFEED_RE.search(low):
+        return "infeed"
+    return "compute"
+
+
+def parse_profile_at_steps(spec: Optional[str]):
+    """``"N:K"`` → ``(start_step, n_steps)``; None/empty → None.
+
+    Validated loudly: a typo'd capture spec silently profiling nothing
+    would be the worst kind of observability bug.
+    """
+    if not spec:
+        return None
+    parts = spec.split(":")
+    try:
+        if len(parts) != 2:
+            raise ValueError
+        start, n = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"--profile_at_steps must be START:COUNT (e.g. 100:20), got "
+            f"{spec!r}")
+    if start < 0 or n < 1:
+        raise ValueError(
+            f"--profile_at_steps needs START >= 0 and COUNT >= 1, got "
+            f"{spec!r}")
+    return start, n
+
+
+def parse_trace_doc(doc: dict, top_k: int = 12) -> List[dict]:
+    """Chrome-trace dict → per-lane device-time records (no I/O).
+
+    Lane selection prefers the profiler's device lanes (process names
+    containing ``/device:``); absent those (CPU backend) it falls back
+    to host lanes, then to any lane with complete events. Durations are
+    summed per op name within a lane — nested host events double-count
+    their parents, which is why device lanes (flat per-op rows) are
+    preferred when present.
+    """
+    events = doc.get("traceEvents") or []
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e.get("pid")] = (e.get("args") or {}).get("name", "")
+    xs = [e for e in events
+          if e.get("ph") == "X" and e.get("dur") is not None]
+    if not xs:
+        return []
+    pids_with_x = {e.get("pid") for e in xs}
+    device_pids = {p for p in pids_with_x
+                   if "/device:" in (pid_names.get(p) or "")}
+    host_pids = {p for p in pids_with_x
+                 if "/host:" in (pid_names.get(p) or "")}
+    lanes = device_pids or host_pids or pids_with_x
+    out = []
+    for pid in sorted(lanes, key=lambda p: (str(pid_names.get(p, "")), p)):
+        evs = [e for e in xs if e.get("pid") == pid]
+        if not evs:
+            continue
+        by_op = {}
+        t_lo = min(e["ts"] for e in evs)
+        t_hi = max(e["ts"] + e["dur"] for e in evs)
+        for e in evs:
+            agg = by_op.setdefault(e.get("name") or "?", [0.0, 0])
+            agg[0] += e["dur"]          # microseconds
+            agg[1] += 1
+        buckets = dict.fromkeys(DEVTIME_BUCKETS, 0.0)
+        total_us = 0.0
+        for name, (dur_us, _calls) in by_op.items():
+            buckets[classify_op(name)] += dur_us
+            total_us += dur_us
+        top = sorted(by_op.items(), key=lambda kv: -kv[1][0])[:top_k]
+        out.append({
+            "device": pid_names.get(pid) or f"pid:{pid}",
+            "total_ms": round(total_us / 1e3, 3),
+            "compute_ms": round(buckets["compute"] / 1e3, 3),
+            "collective_ms": round(buckets["collective"] / 1e3, 3),
+            "infeed_ms": round(buckets["infeed"] / 1e3, 3),
+            "window_ms": round((t_hi - t_lo) / 1e3, 3),
+            "top_ops": [
+                {"name": name, "bucket": classify_op(name),
+                 "dur_ms": round(dur_us / 1e3, 3), "calls": calls,
+                 "frac": round(dur_us / total_us, 4) if total_us else 0.0}
+                for name, (dur_us, calls) in top],
+        })
+    return out
+
+
+def parse_profile_dir(profile_dir: str, top_k: int = 12) -> List[dict]:
+    """Parse the NEWEST capture session under a ``jax.profiler`` output
+    dir (``<dir>/plugins/profile/<timestamp>/*.trace.json[.gz]``) into
+    per-lane records; ``[]`` when nothing parseable is there."""
+    sessions = sorted(glob.glob(
+        os.path.join(profile_dir, "plugins", "profile", "*")))
+    if not sessions:
+        return []
+    lanes: List[dict] = []
+    paths = (glob.glob(os.path.join(sessions[-1], "*.trace.json.gz"))
+             + glob.glob(os.path.join(sessions[-1], "*.trace.json")))
+    for path in sorted(paths):
+        try:
+            if path.endswith(".gz"):
+                with gzip.open(path, "rt") as f:
+                    doc = json.load(f)
+            else:
+                with open(path) as f:
+                    doc = json.load(f)
+            lanes.extend(parse_trace_doc(doc, top_k=top_k))
+        except (OSError, ValueError):
+            continue
+    return lanes
+
+
+class ProfileWindow:
+    """Step-gated ``jax.profiler`` capture + host-side trace parsing.
+
+    The driver calls :meth:`maybe_start` at each dispatch seam (arms at
+    the first seam at/after ``start_step``) and :meth:`maybe_stop` at
+    each iteration end with the boundary's ``drained`` flag — the stop
+    waits for a DRAINED boundary at/after ``start+n_steps`` so the
+    captured window closes on quiesced devices instead of truncating
+    in-flight dispatches. :meth:`close` (the loop's ``finally``) stops a
+    window the run ended inside of. Fail-open throughout: a profiler or
+    parse error prints one warning and the training run continues.
+    """
+
+    def __init__(self, start_step: int, n_steps: int, out_dir: str,
+                 logger=None, top_k: int = 12):
+        self.start_step = start_step
+        self.n_steps = n_steps
+        self.out_dir = out_dir
+        self.logger = logger
+        self.top_k = top_k
+        self.state = "pending"            # pending -> active -> done
+        self._armed_at = start_step       # actual arm step once active
+
+    @classmethod
+    def from_config(cls, cfg, logger=None) -> Optional["ProfileWindow"]:
+        """Build the capture window the config asked for (None = flag
+        off). Composes with ``--profile_dir``: the window writes there
+        when set (so the host-loop Chrome trace, the XLA trace, and the
+        parsed ``devtime`` table all describe the same run), else under
+        ``<log_dir>/devprof``."""
+        spec = parse_profile_at_steps(
+            getattr(cfg, "profile_at_steps", None))
+        if spec is None:
+            return None
+        out_dir = cfg.profile_dir or os.path.join(cfg.log_dir, "devprof")
+        return cls(spec[0], spec[1], out_dir, logger=logger)
+
+    def maybe_start(self, step: int) -> None:
+        if self.state != "pending" or step < self.start_step:
+            return
+        self.state = "active"
+        self._armed_at = step
+        try:
+            import jax
+            jax.profiler.start_trace(self.out_dir)
+        except Exception as e:              # fail-open
+            print(f"[devprof] profiler start failed at step {step}: "
+                  f"{e!r}", file=sys.stderr)
+            self.state = "done"
+
+    def maybe_stop(self, step: int, drained: bool = True) -> None:
+        if self.state != "active" or not drained \
+                or step < self.start_step + self.n_steps:
+            return
+        self._finish(step)
+
+    def close(self, step: int) -> None:
+        """End-of-run stop for a window the run finished inside."""
+        if self.state == "active":
+            self._finish(step)
+
+    def _finish(self, step: int) -> None:
+        self.state = "done"
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:
+            print(f"[devprof] profiler stop failed at step {step}: {e!r}",
+                  file=sys.stderr)
+            return
+        try:
+            lanes = parse_profile_dir(self.out_dir, top_k=self.top_k)
+        except Exception as e:
+            print(f"[devprof] trace parse failed: {e!r}", file=sys.stderr)
+            return
+        if not lanes:
+            print(f"[devprof] no parseable trace under {self.out_dir}",
+                  file=sys.stderr)
+            return
+        for lane in lanes:
+            if self.logger is not None:
+                self.logger.log("devtime", step=step, **lane)
+            top = lane["top_ops"][0] if lane["top_ops"] else None
+            head = (f"; top op {top['name']} {top['dur_ms']:.1f} ms "
+                    f"({100 * top['frac']:.1f}%)") if top else ""
+            print(f"[devprof] {lane['device']}: {lane['total_ms']:.1f} ms "
+                  f"attributed over steps {self._armed_at}..{step} "
+                  f"(compute {lane['compute_ms']:.1f} / collective "
+                  f"{lane['collective_ms']:.1f} / infeed "
+                  f"{lane['infeed_ms']:.1f}){head}")
+
+
+class DeviceStepEstimator:
+    """Per-boundary device step-time estimate from the fused fetch.
+
+    Protocol mirrors ``DrainMeter`` (utils/profiling.py): ``mark(step)``
+    at the end of any iteration that drained (and once after the first
+    dispatch returns), then at a metrics boundary wrap the existing
+    fused ``device_get`` with two clock reads and call :meth:`boundary`.
+    The window ``[mark, drain_end]`` contains every training dispatch
+    since the mark plus the drain itself; the device executes that
+    window's steps back-to-back (modulo input starvation), so
+    ``(drain_end − mark) / steps`` estimates the per-step device time
+    and ``drain_end − drain_start`` is the host's blocked share (host
+    idle ⇔ device busy). An upper bound when the device starves — the
+    profiler window (:class:`ProfileWindow`) adjudicates that case.
+    """
+
+    __slots__ = ("_mark",)
+
+    def __init__(self):
+        self._mark = None
+
+    def mark(self, step: int, now: Optional[float] = None) -> None:
+        self._mark = (step, time.perf_counter() if now is None else now)
+
+    def boundary(self, step: int, drain_start: float, drain_end: float):
+        """→ ``(device_step_ms, drain_wait_ms)``; the first is ``None``
+        before any mark (schema keys stay present, null-valued)."""
+        drain_ms = round(max(drain_end - drain_start, 0.0) * 1e3, 3)
+        if self._mark is None:
+            return None, drain_ms
+        mark_step, mark_t = self._mark
+        steps = step - mark_step
+        if steps <= 0:
+            return None, drain_ms
+        return round((drain_end - mark_t) / steps * 1e3, 4), drain_ms

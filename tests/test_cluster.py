@@ -389,6 +389,32 @@ def _run_failure_scenario(tmp_path, data_cfg, fault_spec,
                                                   "metrics.jsonl"))
     assert "cluster health" in out and "elastic restart" in out
 
+    # Run-wide aggregation (ISSUE 8): both processes' streams merge
+    # onto one clock-aligned timeline whose per-host step counts match
+    # the individual streams EXACTLY, with the survivor's peer_lost /
+    # elastic_restart on the merged event list; and the merged Perfetto
+    # document builds.
+    from tools import trace_aggregate
+    streams = [os.path.join(d, "metrics.jsonl") for d in logs]
+    agg = trace_aggregate.aggregate(streams)
+    assert agg["aligned_hosts"] == 2       # heartbeat wallclock anchors
+    for host in agg["hosts"]:
+        direct = [r["step"]
+                  for r in trace_aggregate.load_stream(host["path"])
+                  if r["kind"] == "train"]
+        assert host["train_steps"] == direct
+        assert sorted(agg["timeline"][host["task"]]) == sorted(
+            {r["step"]
+             for r in trace_aggregate.load_stream(host["path"])
+             if isinstance(r.get("step"), int)})
+    ev_kinds = {e["kind"] for e in agg["events"]}
+    assert {"fault", "peer_lost", "elastic_restart"} <= ev_kinds
+    merged_path = os.path.join(str(tmp_path), "merged_trace.json")
+    assert trace_aggregate.main(streams + ["--out", merged_path]) == 0
+    with open(merged_path) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"]
+
     ref = _reference_digest(tmp_path, data_dir, logs[0], 10, script)
     return survivor, recs, ref
 

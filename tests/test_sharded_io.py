@@ -233,7 +233,7 @@ def test_report_world_size_timeline_and_rejoins():
 
     recs = [
         {"kind": "heartbeat", "t": 0.1, "task": 0, "step": 1,
-         "process_id": 0, "phase": "train"},
+         "process_id": 0, "phase": "train", "wallclock": 1000.1},
         {"kind": "peer_lost", "t": 1.0, "task": 0, "step": 15,
          "process_id": 1, "reason": "stale_heartbeat"},
         {"kind": "elastic_restart", "t": 1.1, "task": 0, "step": 15,

@@ -225,6 +225,15 @@ def test_telemetry_run_and_fetch_parity(data_cfg, tmp_path, monkeypatch):
                 "health_update_ratio"} <= set(r)
         assert np.isfinite(r["health_grad_norm"])
 
+    # The always-on device step-time estimator (utils/devprof.py)
+    # rides the same fused fetch: every train row carries the keys,
+    # real numbers once the first window completes — and it added zero
+    # fetches (the assertion above already proved it).
+    for r in by_kind["train"]:
+        assert {"device_step_ms", "drain_wait_ms"} <= set(r)
+    assert any(isinstance(r["device_step_ms"], (int, float)) and
+               r["device_step_ms"] > 0 for r in by_kind["train"])
+
     # Span phases cover the loop; depth-0 categories feed goodput.
     names = {r["name"] for r in by_kind["span"]}
     assert {"data_wait", "compile_first_dispatch", "dispatch",
@@ -245,11 +254,26 @@ def test_telemetry_run_and_fetch_parity(data_cfg, tmp_path, monkeypatch):
     # hbm records carry the full schema even on CPU.
     assert by_kind["hbm"][-1]["available"] in (True, False)
 
-    # Chrome trace-event file: valid JSON, Perfetto-loadable shape.
+    # Chrome trace-event file: valid JSON, Perfetto-loadable shape,
+    # and WELL-FORMED spans — complete events with non-negative
+    # durations that, within one lane (pid, tid=depth), are monotone
+    # and non-overlapping (the host loop's same-depth spans are
+    # sequential context managers; an overlap would mean the exporter
+    # scrambled ts/dur and Perfetto would render garbage).
     with open(trace_path) as f:
         doc = json.load(f)
-    assert doc["traceEvents"] and all(e["ph"] == "X"
-                                      for e in doc["traceEvents"])
+    events = doc["traceEvents"]
+    assert events and all(e["ph"] == "X" for e in events)
+    lanes = {}
+    for e in events:
+        assert e["dur"] >= 0 and e["ts"] >= 0
+        lanes.setdefault((e["pid"], e["tid"]), []).append(e)
+    for lane in lanes.values():
+        lane.sort(key=lambda e: e["ts"])
+        for a, b in zip(lane, lane[1:]):
+            # 0.2 us slack: ts/dur round to 0.1 us on export.
+            assert b["ts"] >= a["ts"] + a["dur"] - 0.2, \
+                (a, b, "same-depth spans must not overlap")
 
     # The stream passes the documented-schema lint (wired into tier 1).
     from tools import check_jsonl_schema
@@ -261,6 +285,37 @@ def test_telemetry_run_and_fetch_parity(data_cfg, tmp_path, monkeypatch):
     assert "goodput over" in out and "train" in out
     assert "grad norm" in out
     assert telemetry_report.main([cfg.metrics_jsonl]) == 0
+
+
+def test_schema_kinds_match_observability_doc():
+    """Doc-drift gate: every kind the lint knows appears in the
+    docs/OBSERVABILITY.md kinds table, and vice versa — the exact drift
+    KIND_KEYS' comment says the lint exists to catch, now enforced in
+    BOTH directions (--list-kinds is the machine-readable side)."""
+    import re
+
+    from tools import check_jsonl_schema as lint
+
+    doc_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")
+    with open(doc_path) as f:
+        doc = f.read()
+    # Table rows look like: | `kind` | `required keys` | emitted |
+    doc_kinds = set(re.findall(r"^\| `(\w+)` \|", doc, re.MULTILINE))
+    lint_kinds = set(lint.list_kinds())
+    assert lint_kinds - doc_kinds == set(), \
+        "kinds missing from the docs/OBSERVABILITY.md table"
+    assert doc_kinds - lint_kinds == set(), \
+        "documented kinds missing from tools/check_jsonl_schema.py"
+
+
+def test_list_kinds_cli(capsys):
+    from tools import check_jsonl_schema as lint
+
+    assert lint.main(["--list-kinds"]) == 0
+    out = capsys.readouterr().out.split()
+    assert out == sorted(lint.KIND_KEYS)
+    assert "devtime" in out and "train" in out
 
 
 def test_check_jsonl_schema_catches_violations(tmp_path):

@@ -28,7 +28,11 @@ BASE_KEYS = ("kind", "t", "task")
 # Required keys per record kind. Values may be null (the writer maps
 # NaN/Inf to null) but the KEY must be present.
 KIND_KEYS = {
-    "train": ("step", "loss", "train_accuracy", "images_per_sec", "lr"),
+    # `device_step_ms`/`drain_wait_ms` are the always-on device
+    # step-time estimate riding the fused boundary fetch
+    # (utils/devprof.py; null before the first complete window).
+    "train": ("step", "loss", "train_accuracy", "images_per_sec", "lr",
+              "device_step_ms", "drain_wait_ms"),
     "eval": ("step", "test_accuracy"),
     "span": ("step", "name", "start_s", "dur_s", "depth"),
     "goodput": ("step", "total_s", "train_frac", "compile_frac",
@@ -58,7 +62,7 @@ KIND_KEYS = {
     # eviction fence, or a non-chief preemption exit (`reason` says
     # which); `elastic_restart` is the adopted coordinated-restart
     # decision (shrunken world, restore step, epoch).
-    "heartbeat": ("step", "process_id", "phase"),
+    "heartbeat": ("step", "process_id", "phase", "wallclock"),
     "straggler": ("step", "process_id", "behind_steps", "beat_age_s"),
     "peer_lost": ("step", "process_id", "reason"),
     "elastic_restart": ("step", "restore_step", "world_size", "epoch",
@@ -89,6 +93,14 @@ KIND_KEYS = {
     # one of memory | executable | stablehlo | miss | corrupt | error |
     # uncached.
     "compile": ("key", "phase", "hit", "compile_s", "source"),
+    # Device-time attribution (utils/devprof.py; docs/OBSERVABILITY.md
+    # device-time section). One record per trace lane of a
+    # --profile_at_steps capture window: bucket totals in milliseconds
+    # (compute / collective / infeed), the lane's wall window, and the
+    # top-k op table as a nested list of
+    # {name, bucket, dur_ms, calls, frac}.
+    "devtime": ("step", "device", "total_ms", "compute_ms",
+                "collective_ms", "infeed_ms", "window_ms", "top_ops"),
     # Serving runtime (serve/metrics.py; docs/SERVING.md). Percentile
     # values are null until the window has completions.
     "serve": ("requests", "completed", "shed_queue", "shed_deadline",
@@ -162,11 +174,23 @@ def check_file(path: str) -> List[str]:
         return check_lines(f, source=path)
 
 
+def list_kinds() -> List[str]:
+    """Every kind the lint knows, sorted — the machine-readable side of
+    the drift contract with docs/OBSERVABILITY.md's kinds table
+    (``tests/test_telemetry.py`` asserts the two match both ways)."""
+    return sorted(KIND_KEYS)
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv == ["--list-kinds"]:
+        for kind in list_kinds():
+            print(kind)
+        return 0
     if not argv:
         print(__doc__.strip().splitlines()[0])
-        print("usage: check_jsonl_schema.py FILE.jsonl [...]")
+        print("usage: check_jsonl_schema.py [--list-kinds] "
+              "FILE.jsonl [...]")
         return 2
     failed = False
     for path in argv:

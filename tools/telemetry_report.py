@@ -13,9 +13,16 @@ Reads the stream written by ``--metrics_jsonl`` (schema:
   two relate),
 - training health (grad/param norm, update ratio) when the run compiled
   them in (``--health_metrics``),
+- device-time attribution: the per-boundary ``device_step_ms`` /
+  ``drain_wait_ms`` split (host-bound vs device-bound) from the train
+  rows, and the per-op ``devtime`` table a ``--profile_at_steps``
+  capture window emitted (utils/devprof.py),
 - HBM peak from the ``hbm`` snapshots.
 
 Usage: ``python tools/telemetry_report.py run.jsonl [more.jsonl ...]``
+``--format json`` emits the same summary as one machine-readable JSON
+document (``summarize_json``) for the perf gate / CI; the text renderer
+stays the default.
 """
 
 from __future__ import annotations
@@ -28,7 +35,8 @@ from typing import List, Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from dml_cnn_cifar10_tpu.utils.telemetry import GOODPUT_CATEGORIES  # noqa: E402
+from dml_cnn_cifar10_tpu.utils.telemetry import (GOODPUT_CATEGORIES,  # noqa: E402
+                                                 percentile)
 
 
 def load_records(path: str) -> List[dict]:
@@ -67,6 +75,48 @@ def _goodput_from_spans(records: List[dict]) -> Optional[dict]:
     for cat, v in secs.items():
         out[f"{cat}_frac"] = v / total
     out["train_frac"] = max(0.0, 1.0 - sum(secs.values()) / total)
+    return out
+
+
+def _device_split(trains: List[dict]) -> Optional[dict]:
+    """Boundary-estimator aggregate over the train rows: p50
+    ``device_step_ms`` / ``drain_wait_ms`` and the implied device-busy
+    fraction of the step window (device wall per step vs total wall per
+    step from ``images_per_sec``). None when no row carries the keys."""
+    dev = [r["device_step_ms"] for r in trains
+           if isinstance(r.get("device_step_ms"), (int, float))]
+    if not dev:
+        return None
+    drain = [r["drain_wait_ms"] for r in trains
+             if isinstance(r.get("drain_wait_ms"), (int, float))]
+    out = {
+        "boundaries": len(dev),
+        "device_step_ms_p50": round(percentile(dev, 50), 4),
+        "device_step_ms_p99": round(percentile(dev, 99), 4),
+        "drain_wait_ms_p50": round(percentile(drain, 50), 3)
+        if drain else None,
+        "device_busy_frac": None,
+    }
+    # Host-idle share of each boundary window: drain_wait is the time
+    # the host spent BLOCKED on the device at the fused fetch, and
+    # device_step_ms x (steps between consecutive train rows) is the
+    # window's wall (the estimator divides that wall by the same step
+    # count). A share near 1 means the host idles on the device
+    # (device-bound: the step itself must get faster); near 0 means the
+    # device idles on the host (host-bound: feed it better).
+    fracs = []
+    for prev, cur in zip(trains, trains[1:]):
+        d, w = cur.get("device_step_ms"), cur.get("drain_wait_ms")
+        if not (isinstance(d, (int, float))
+                and isinstance(w, (int, float))
+                and isinstance(cur.get("step"), int)
+                and isinstance(prev.get("step"), int)):
+            continue
+        steps = cur["step"] - prev["step"]
+        if steps > 0 and d > 0:
+            fracs.append(min(w / (d * steps), 1.0))
+    if fracs:
+        out["device_busy_frac"] = round(sum(fracs) / len(fracs), 4)
     return out
 
 
@@ -156,6 +206,42 @@ def summarize(path: str) -> str:
             lines.append(f"    {label:<13} {first.get(key)} -> "
                          f"{last.get(key)}")
         lines.append(f"    max grad norm {gmax}")
+    # Device-time split (utils/devprof.py): the always-on boundary
+    # estimator answers device-bound vs host-bound; the devtime table
+    # (a --profile_at_steps capture) answers WHICH ops own the device.
+    dev_split = _device_split(trains)
+    if dev_split:
+        lines.append(
+            f"  device step time (boundary estimator, "
+            f"{dev_split['boundaries']} boundaries):")
+        lines.append(
+            f"    device_step p50 {dev_split['device_step_ms_p50']} ms, "
+            f"drain-wait p50 {dev_split['drain_wait_ms_p50']} ms per "
+            f"boundary")
+        if dev_split.get("device_busy_frac") is not None:
+            lines.append(
+                f"    device-busy ~{100 * dev_split['device_busy_frac']:.0f} "
+                f"% of the step window "
+                f"({'device' if dev_split['device_busy_frac'] > 0.5 else 'host'}-bound)")
+    devtimes = [r for r in records if r.get("kind") == "devtime"]
+    if devtimes:
+        lines.append("  device-time attribution (--profile_at_steps):")
+        newest_step = max(r.get("step") or 0 for r in devtimes)
+        for r in devtimes:
+            if (r.get("step") or 0) != newest_step:
+                continue
+            lines.append(
+                f"    {r.get('device')}: {r.get('total_ms')} ms "
+                f"attributed (compute {r.get('compute_ms')} / "
+                f"collective {r.get('collective_ms')} / infeed "
+                f"{r.get('infeed_ms')}) over a {r.get('window_ms')} ms "
+                f"window")
+            for op in (r.get("top_ops") or [])[:5]:
+                lines.append(
+                    f"      {op.get('name', '?')[:44]:<44} "
+                    f"{op.get('dur_ms', 0):>9.2f} ms "
+                    f"{100 * (op.get('frac') or 0):5.1f}%  "
+                    f"[{op.get('bucket')}] x{op.get('calls')}")
     serve = _last(records, "serve_done")
     if serve is None:
         # A server that died before the final flush still has windows.
@@ -376,11 +462,125 @@ def summarize(path: str) -> str:
     return "\n".join(lines)
 
 
+def summarize_json(path: str) -> dict:
+    """Machine-readable summary of one stream — the ``--format json``
+    payload the perf gate / CI consumes. Same sections as the text
+    renderer (which stays the default), plainly keyed."""
+    records = load_records(path)
+    out: dict = {"path": path, "records": len(records)}
+    done = _last(records, "done")
+    trains = [r for r in records if r.get("kind") == "train"]
+    if done or trains:
+        out["steps"] = (done or trains[-1]).get("step")
+    if done:
+        out["images_per_sec"] = done.get("images_per_sec")
+    gp = _last(records, "goodput") or _goodput_from_spans(records)
+    if gp:
+        out["goodput"] = {k: v for k, v in gp.items()
+                          if k not in ("kind", "t", "task")}
+    compiles = [r for r in records if r.get("kind") == "compile"]
+    if compiles:
+        misses = [r for r in compiles if not r.get("hit")]
+        out["compile"] = {
+            "lookups": len(compiles),
+            "hits": len(compiles) - len(misses),
+            "misses": len(misses),
+            "total_s": round(sum(r.get("compile_s") or 0.0
+                                 for r in compiles), 3),
+            "miss_s": round(sum(r.get("compile_s") or 0.0
+                                for r in misses), 3),
+        }
+    health = [r for r in trains if "health_grad_norm" in r]
+    if health:
+        out["health"] = {
+            "first_grad_norm": health[0].get("health_grad_norm"),
+            "last_grad_norm": health[-1].get("health_grad_norm"),
+            "max_grad_norm": max((r.get("health_grad_norm") or 0.0)
+                                 for r in health),
+            "last_update_ratio": health[-1].get("health_update_ratio"),
+        }
+    dev_split = _device_split(trains)
+    if dev_split:
+        out["device_split"] = dev_split
+    devtimes = [r for r in records if r.get("kind") == "devtime"]
+    if devtimes:
+        out["devtime"] = [
+            {k: v for k, v in r.items() if k not in ("kind", "t", "task")}
+            for r in devtimes]
+    serve = _last(records, "serve_done") or _last(records, "serve")
+    if serve:
+        out["serve"] = {k: v for k, v in serve.items()
+                        if k not in ("kind", "t", "task")}
+    fleet_done = _last(records, "fleet_done") \
+        or _last(records, "fleet")
+    if fleet_done:
+        out["fleet"] = {k: v for k, v in fleet_done.items()
+                        if k not in ("kind", "t", "task")}
+        out["fleet"]["swaps"] = sum(1 for r in records
+                                    if r.get("kind") == "swap")
+        out["fleet"]["scales"] = sum(1 for r in records
+                                     if r.get("kind") == "scale")
+    faults = [r for r in records if r.get("kind") == "fault"]
+    recoveries = [r for r in records if r.get("kind") == "recovery"]
+    if faults or recoveries:
+        out["resilience"] = {
+            "faults": len(faults),
+            "injected": sum(1 for r in faults if r.get("injected")),
+            "recoveries": len(recoveries),
+            "ckpt_fallbacks": sum(1 for r in records
+                                  if r.get("kind") == "ckpt_fallback"),
+        }
+    beats = [r for r in records if r.get("kind") == "heartbeat"]
+    losses = [r for r in records if r.get("kind") == "peer_lost"]
+    transitions = [r for r in records
+                   if r.get("kind") in ("elastic_restart",
+                                        "elastic_expand")]
+    if beats or losses or transitions:
+        out["cluster"] = {
+            "heartbeats": len(beats),
+            "stragglers": sum(1 for r in records
+                              if r.get("kind") == "straggler"),
+            "peer_losses": [{"process_id": r.get("process_id"),
+                             "step": r.get("step"),
+                             "reason": r.get("reason")} for r in losses],
+            "world_size_timeline": [
+                {"kind": r["kind"], "epoch": r.get("epoch"),
+                 "world_size": r.get("world_size"),
+                 "step": r.get("step")}
+                for r in sorted(transitions,
+                                key=lambda r: (r.get("epoch") or 0))],
+        }
+    hbm = _last(records, "hbm")
+    if hbm and hbm.get("available"):
+        out["hbm"] = {k: hbm.get(k) for k in
+                      ("devices", "bytes_in_use", "peak_bytes",
+                       "bytes_limit")}
+    return out
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    fmt = "text"
+    if "--format" in argv:
+        i = argv.index("--format")
+        try:
+            fmt = argv[i + 1]
+        except IndexError:
+            fmt = ""
+        del argv[i:i + 2]
+        if fmt not in ("text", "json"):
+            print("usage: telemetry_report.py [--format text|json] "
+                  "run.jsonl [more.jsonl ...]")
+            return 2
     if not argv:
-        print("usage: telemetry_report.py run.jsonl [more.jsonl ...]")
+        print("usage: telemetry_report.py [--format text|json] "
+              "run.jsonl [more.jsonl ...]")
         return 2
+    if fmt == "json":
+        docs = [summarize_json(path) for path in argv]
+        print(json.dumps(docs[0] if len(docs) == 1
+                         else {"reports": docs}))
+        return 0
     for path in argv:
         print(summarize(path))
     return 0
