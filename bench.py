@@ -89,15 +89,65 @@ def _peak_tflops(device_kind: str):
     return None
 
 
+def _optimizer_ms_probe(chunk, prefetch, state, chunk_k: int,
+                        dispatches: int = 2):
+    """``(state, per_step_optimizer_ms | None)`` — a short
+    ``jax.profiler`` capture around ``dispatches`` extra chunk calls,
+    parsed host-side (utils/devprof.py) into the per-step device time
+    inside the step's ``named_scope("optimizer")``. The row then RECORDS
+    the weight-update tail the fused kernel / zero1 sharding attack,
+    instead of inferring it from throughput deltas. Fail-open: any
+    profiler/parse trouble returns None (the key stays in the row).
+    Skipped on the CPU backend entirely (None recorded): tracing a
+    bench-sized window there floods the export — the virtual-device
+    busy-wait case from PR 8, and measured minutes of stop_trace even
+    single-device at bench geometry — and CPU host lanes carry no
+    device op scopes to attribute anyway. BENCH_PROFILE_OPT=1 forces
+    the capture for debugging."""
+    import jax
+
+    if jax.default_backend() == "cpu" \
+            and os.environ.get("BENCH_PROFILE_OPT") != "1":
+        return state, None
+    import shutil
+    import tempfile
+
+    from dml_cnn_cifar10_tpu.utils import devprof
+
+    tmp = tempfile.mkdtemp(prefix="bench_opt_ms_")
+    try:
+        jax.profiler.start_trace(tmp)
+        try:
+            for _ in range(dispatches):
+                state, metrics = chunk(state, *next(prefetch))
+            float(jax.device_get(metrics["loss"]))
+        finally:
+            jax.profiler.stop_trace()
+        lanes = devprof.parse_profile_dir(tmp)
+        if not lanes:
+            return state, None
+        per_step = (sum(ln.get("optimizer_ms") or 0.0 for ln in lanes)
+                    / len(lanes) / (dispatches * chunk_k))
+        return state, round(per_step, 4)
+    except Exception:
+        return state, None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
-            dev_stream: bool = True, reps: int = 3) -> dict:
+            dev_stream: bool = True, reps: int = 3,
+            optimizer_sharding: str = "none") -> dict:
     """Steady-state throughput + MFU for one compute dtype —
     ``reps`` independently timed repetitions after one warmup.
 
     ``dev_stream`` (default ON — the headline config, round-4 verdict
     #5) generates the shuffled index stream on device
     (``data/device_stream.py``): the dispatch carries NO host data at
-    all. ``False`` ships host-generated index arrays (the A/B row)."""
+    all. ``False`` ships host-generated index arrays (the A/B row).
+    ``optimizer_sharding="zero1"`` runs the ZeRO-1 sharded weight
+    update (reduce-scatter / sharded update / all-gather over the data
+    mesh; docs/SHARDING.md) — the ``fp32_zero1`` row."""
     import jax
 
     from dml_cnn_cifar10_tpu.config import reference_config
@@ -121,6 +171,7 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
     # directly; the native loader's C++ shuffle pool would be dead weight.
     cfg.data.use_native_loader = False
     cfg.model.compute_dtype = compute_dtype
+    cfg.optim.optimizer_sharding = optimizer_sharding
     # Compile-cache every seam (trainer step fns, the chunk below, the
     # FLOPs probes): warm bench re-runs skip XLA entirely.
     cfg.compile_cache_dir = _bench_cache_dir() or None
@@ -197,6 +248,11 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
         state, metrics = chunk(state, *next(prefetch))
         float(jax.device_get(metrics["loss"]))
         tail_ms.append((time.perf_counter() - t0) / chunk_k * 1e3)
+    # Measured weight-update tail (docs/OBSERVABILITY.md): a short
+    # post-measurement capture attributes the per-step device time in
+    # the optimizer named_scope — None when the platform can't trace.
+    state, optimizer_ms = _optimizer_ms_probe(chunk, prefetch, state,
+                                              chunk_k)
     # One extra (unused) batch before the pipeline closes: its avals let
     # the flops probe below look the TIMED chunk program up in the
     # compile cache without rebuilding shardings by hand.
@@ -217,6 +273,10 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
         "step_ms_p99": round(percentile(tail_ms, 99), 4),
         "step_ms_samples": len(tail_ms),
         "step_ms_series": [round(v, 4) for v in tail_ms],
+        # Per-step device time in the optimizer named_scope (see the
+        # probe above); null when the platform can't capture a trace.
+        "optimizer_ms": optimizer_ms,
+        "optimizer_sharding": optimizer_sharding,
     }
 
     # Per-step FLOPs. With the compile cache armed both figures come
@@ -287,6 +347,13 @@ def main() -> None:
         # A/B: host-generated index upload (the pre-round-5 default) —
         # pins that the device stream costs nothing.
         "fp32_hostidx": measure("float32", chunk_k=100, dev_stream=False),
+        # ZeRO-1 sharded weight update (--optimizer_sharding zero1,
+        # docs/SHARDING.md) on the same mesh: reduce-scatter + sharded
+        # update + all-gather replacing the grad all-reduce. Joins the
+        # perf-regression gate (tools/bench_gate.py row tolerances) so
+        # the new path cannot regress silently.
+        "fp32_zero1": measure("float32", chunk_k=100,
+                              optimizer_sharding="zero1"),
     }
     # Headline = best PARITY config (K=100): the plateau row is reported
     # as data but may not claim the headline — it relaxes the

@@ -272,6 +272,41 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ZeRO/FSDP: shard params + optimizer moments over "
                         "the data axis (state memory 1/N; grads become "
                         "reduce-scatter)")
+    p.add_argument("--optimizer_sharding", type=str, default="none",
+                   choices=["none", "zero1"],
+                   help="cross-replica weight-update sharding "
+                        "(docs/SHARDING.md): zero1 allocates the "
+                        "optimizer moments sharded 1/N over the data "
+                        "axis from init on, reduce-scatters grads, "
+                        "updates each replica's shard, and all-gathers "
+                        "the new params for the next forward — same "
+                        "math as replicated (pinned <=1e-6), "
+                        "checkpoints interchange across modes. Needs "
+                        "the GSPMD step; excludes --fsdp and "
+                        "--async_staleness")
+    p.add_argument("--fused_optimizer", type="bool", default=True,
+                   help="fused single-pass SGD update (ops/optimizer.py: "
+                        "momentum + weight decay + LR in one pass over "
+                        "the param bytes; Pallas TPU kernel with an "
+                        "identical-math XLA fallback by platform). "
+                        "false restores the tree_map chain")
+    p.add_argument("--partition_rules", type=str, default=None,
+                   help="override the model's partition-rule table "
+                        "(parallel/shardings.py engine; grammar in "
+                        "docs/SHARDING.md): ordered ';'-separated "
+                        "'regex=spec' rules matched against /-joined "
+                        "param paths; spec is comma-separated per-dim "
+                        "axis names, right-aligned ('-' = unsharded "
+                        "dim, '^' prefix = left-aligned, empty = "
+                        "replicated)")
+    p.add_argument("--partition_rules_strict", type="bool", default=False,
+                   help="error at build time on any param leaf no "
+                        "partition rule matches (instead of silently "
+                        "replicating it)")
+    p.add_argument("--partition_report", type="bool", default=False,
+                   help="print the which-rule-matched-which-param "
+                        "report (path, shape, rule, spec) at Trainer "
+                        "build")
     p.add_argument("--compute_dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--optimizer", type=str, default="sgd",
@@ -615,6 +650,23 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     if args.fsdp and args.explicit_collectives:
         raise SystemExit("--fsdp needs the GSPMD (default) step, not "
                          "--explicit_collectives")
+    cfg.optim.optimizer_sharding = args.optimizer_sharding
+    cfg.optim.fused_optimizer = args.fused_optimizer
+    cfg.parallel.partition_rules = args.partition_rules
+    cfg.parallel.partition_rules_strict = args.partition_rules_strict
+    cfg.parallel.partition_report = args.partition_report
+    if args.optimizer_sharding == "zero1":
+        # Mirror the builder-level checks with CLI-shaped errors (the
+        # same trap the --fsdp guard above closes): a silently ignored
+        # sharding mode would mislabel every bench that rides it.
+        if args.fsdp:
+            raise SystemExit(
+                "--optimizer_sharding zero1 does not compose with "
+                "--fsdp (ZeRO-3 already shards the optimizer moments)")
+        if args.explicit_collectives:
+            raise SystemExit(
+                "--optimizer_sharding zero1 needs the GSPMD (default) "
+                "step, not --explicit_collectives")
     try:
         cfg.serve.buckets = tuple(
             int(b) for b in args.serve_buckets.split(",") if b.strip())

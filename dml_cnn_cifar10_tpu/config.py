@@ -261,6 +261,23 @@ class OptimConfig:
     # activation memory (no reference counterpart — the reference's batch
     # always fits; this is a scale capability).
     grad_accum: int = 1
+    # Cross-replica sharding of the WEIGHT UPDATE (arxiv 2004.13336;
+    # docs/SHARDING.md). "none" = fully replicated params + optimizer
+    # state (the historical layout). "zero1" = optimizer moments (+ EMA)
+    # allocated sharded 1/|data| from init on; the step reduce-scatters
+    # grads over ``data``, each replica updates its shard, and the new
+    # params all-gather for the next forward — same math as replicated
+    # to reduction-reorder tolerance (≤1e-6, pinned), checkpoints
+    # interchange across modes. Needs the GSPMD (default) step; does
+    # not compose with --fsdp (which already shards the update state)
+    # or --async_staleness.
+    optimizer_sharding: str = "none"     # none | zero1
+    # Fused single-pass SGD update (ops/optimizer.py): momentum + weight
+    # decay + LR applied in ONE pass over the param bytes — a Pallas TPU
+    # kernel with an identical-math XLA fallback selected by platform
+    # (bit-equal to the tree_map chain; PARITY.md). False restores the
+    # historical per-transform tree_map chain.
+    fused_optimizer: bool = True
 
 
 @dataclasses.dataclass
@@ -336,6 +353,20 @@ class ParallelConfig:
     # the PS already "sharded" state round-robin over PS tasks
     # (cifar10cnn.py:195-196); this is the SPMD-native form of that idea.
     fsdp: bool = False
+    # Partition-rule override (parallel/shardings.py engine;
+    # docs/SHARDING.md grammar): ordered ";"-separated "regex=spec"
+    # rules replacing the model's default table — specs are
+    # comma-separated per-dim axis names, right-aligned ("-"/"*"/empty =
+    # unsharded dim, "^" prefix = left-aligned, empty spec =
+    # replicated). None keeps the model's built-in rules.
+    partition_rules: Optional[str] = None
+    # Strict rule matching: a leaf no rule covers is a build-time error
+    # instead of silently replicating (applies to the override above
+    # AND the built-in tables, which all end in a catch-all).
+    partition_rules_strict: bool = False
+    # Print the which-rule-matched-which-param report (path, shape,
+    # matching rule, resulting spec) at Trainer build.
+    partition_report: bool = False
 
 
 @dataclasses.dataclass

@@ -1,13 +1,20 @@
-"""Tensor-parallel parameter sharding rules (the ``model`` mesh axis).
+"""Parameter/optimizer partition rules: an ordered regex → PartitionSpec
+engine over ``/``-joined pytree paths.
 
 The reference has no tensor parallelism (SURVEY §2.3 — async PS data
-parallelism is its only strategy), but this framework treats the ``model``
-axis as first-class: each model family declares how its parameter pytree is
-laid out over the mesh, and the jitted step (``parallel/step.py``) feeds
-those specs to ``jit in_shardings``/``out_shardings`` so GSPMD keeps the
-weights resident shard-wise and inserts the matching collectives
-(all-gather for column-parallel outputs consumed replicated, psum for
-row-parallel partial sums) on ICI.
+parallelism is its only strategy), but this framework treats the mesh
+layout as first-class: each model family declares how its parameter
+pytree is laid out as an ordered table of ``(regex, PartitionSpec)``
+rules (the ``match_partition_rules`` idiom), the engine matches each
+leaf's ``/``-joined path against the table first-match-wins, and the
+jitted step (``parallel/step.py``) feeds the resulting specs to ``jit
+in_shardings``/``out_shardings`` so GSPMD keeps the weights resident
+shard-wise and inserts the matching collectives (all-gather for
+column-parallel outputs consumed replicated, psum for row-parallel
+partial sums) on ICI. ``--partition_rules`` swaps the model's table for
+a user one (same grammar, :func:`parse_partition_rules`);
+:func:`explain_partition_rules` renders the which-rule-matched-which-
+param report, and strict mode errors on any leaf no rule covers.
 
 Layout follows the Megatron recipe, expressed as GSPMD annotations instead
 of hand-written collectives:
@@ -20,114 +27,261 @@ of hand-written collectives:
   GSPMD compiles the ``psum`` over ``model``. Bias replicated.
 
 ResNets stay replicated on ``model`` (conv-heavy, CIFAR-scale: dp is the
-right layout; rules return ``P()`` for every leaf). Anything not matched by
-a rule is replicated — correctness never depends on a rule firing, only
-layout efficiency does.
+right layout; the table is one catch-all ``P()`` rule). Anything not
+matched by a rule is replicated — correctness never depends on a rule
+firing, only layout efficiency does.
+
+Rule specs are RIGHT-aligned by default: a spec shorter than the leaf's
+rank pads leading ``None``s, so ``P("model")`` means "shard the trailing
+dim" for a 2-D kernel and for its stacked 3-D ``[depth, ...]`` twin
+alike. ``align="left"`` (the ``^`` prefix in the CLI grammar) anchors at
+the LEADING axis instead — the pipeline table uses it to shard stacked
+block leaves over ``pipe``.
 
 ViT attention note: the fused qkv projection is stored heads-major
 (``models/vit.py``), so column-sharding ``qkv`` shards *whole heads* when
 ``model`` divides ``vit_heads`` and the [B,S,H,hd] attention tensors
 propagate head-sharded through the kernel with zero resharding.
+
+Optimizer-state sharding (``--optimizer_sharding zero1``, arxiv
+2004.13336): :func:`state_pspecs` layers a ``data``-axis sharding over
+the per-param optimizer moments ONLY (params keep the model rule) — the
+weight-update tail of the step then runs 1/N per replica; see
+``docs/SHARDING.md``.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import dataclasses
+import re
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-Rule = Callable[[str, int], P]
+
+@dataclasses.dataclass(frozen=True)
+class PartitionRule:
+    """One ``(regex, spec)`` entry of an ordered rule table.
+
+    ``pattern`` is matched with ``re.search`` against the leaf's
+    ``/``-joined tree path; ``spec`` aligns to the leaf rank per
+    ``align`` (right: pad leading ``None`` — the trailing-dims
+    convention that covers stacked ``[depth, ...]`` leaves for free;
+    left: anchor at the leading axis, used by the pipeline table)."""
+
+    pattern: str
+    spec: P
+    align: str = "right"
+
+    def matches(self, path: str) -> bool:
+        return re.search(self.pattern, path) is not None
 
 
-def _col(ndim: int) -> P:
-    """Shard the trailing (output-feature) dim over ``model``."""
-    return P(*([None] * (ndim - 1) + ["model"]))
+Rules = Sequence[PartitionRule]
 
 
-def _row(ndim: int) -> P:
-    """Shard the second-to-last (input-feature) dim over ``model``."""
-    return P(*([None] * (ndim - 2) + ["model", None]))
+def _aligned_spec(rule: PartitionRule, path: str, ndim: int) -> P:
+    entries = tuple(rule.spec)
+    if len(entries) > ndim:
+        raise ValueError(
+            f"partition rule {rule.pattern!r} names {len(entries)} dims "
+            f"but leaf {path!r} has rank {ndim}")
+    if rule.align == "left" or not entries:
+        return rule.spec
+    return P(*([None] * (ndim - len(entries)) + list(entries)))
 
 
-def _replicated(path: str, ndim: int) -> P:
-    del path, ndim
-    return P()
+def match_partition_rules(rules: Rules, tree: Any,
+                          strict: bool = False) -> Any:
+    """Pytree of ``PartitionSpec`` for ``tree`` (arrays or
+    ShapeDtypeStructs) from an ordered rule table, first match wins.
 
+    Scalars never partition (rank-0 leaves return ``P()`` without
+    consuming a rule — the standard ``match_partition_rules``
+    convention). An unmatched leaf replicates, unless ``strict`` — then
+    every unmatched path is collected and raised at once, so a user
+    table with a typo'd regex fails loudly instead of silently
+    replicating half the model."""
+    unmatched: List[str] = []
 
-def _cnn_rule(path: str, ndim: int) -> P:
-    # full1 2304→384 column-parallel, full2 384→192 row-parallel
-    # (the wide FC pair of the reference model, cifar10cnn.py:130-139);
-    # convs and the 192→10 head are small — replicated.
-    if path.endswith(("full1/kernel", "full1/bias")):
-        return _col(ndim)
-    if path.endswith("full2/kernel"):
-        return _row(ndim)
-    return P()
-
-
-def _vit_rule(path: str, ndim: int) -> P:
-    # Stacked block leaves carry a leading [depth] axis; _col/_row index
-    # from the trailing dims so the same rule covers stacked and unstacked.
-    if path.endswith(("qkv/kernel", "qkv/bias", "mlp1/kernel", "mlp1/bias")):
-        return _col(ndim)
-    if path.endswith(("proj/kernel", "mlp2/kernel")):
-        return _row(ndim)
-    return P()
-
-
-def _expert(ndim: int, offset: int) -> P:
-    """Shard the expert dim (``offset`` positions from the trailing end:
-    w [.., E, D, H] → 3, b [.., E, H] → 2) over ``model``."""
-    spec = [None] * ndim
-    spec[ndim - offset] = "model"
-    return P(*spec)
-
-
-def _vit_moe_rule(path: str, ndim: int) -> P:
-    # Expert parallelism: expert-major MoE weights shard their E dim over
-    # ``model`` (ops/moe.py); the router gate stays replicated. Attention
-    # follows the dense ViT rules.
-    if path.endswith(("moe/w1", "moe/w2")):
-        return _expert(ndim, 3)
-    if path.endswith(("moe/b1", "moe/b2")):
-        return _expert(ndim, 2)
-    if "moe/gate" in path:
+    def spec_for(kp, leaf):
+        path = _path_str(kp)
+        if leaf.ndim == 0:
+            return P()
+        for rule in rules:
+            if rule.matches(path):
+                return _aligned_spec(rule, path, leaf.ndim)
+        unmatched.append(path)
         return P()
-    return _vit_rule(path, ndim)
+
+    specs = jax.tree_util.tree_map_with_path(spec_for, tree)
+    if strict and unmatched:
+        raise ValueError(
+            f"strict partition matching: no rule matched "
+            f"{len(unmatched)} leaf path(s): {unmatched}")
+    return specs
 
 
-def _vit_pipe_rule(path: str, ndim: int) -> P:
-    # Pipelined stack: each stage owns depth/P contiguous layers — the
-    # stacked [depth, ...] leaves shard their LEADING axis over ``pipe``.
-    # Tensor-parallel specs are dropped (shard_map stages would need
-    # hand-written collectives; parallel/pipeline.py docstring).
-    if path.startswith("blocks/"):
-        return P("pipe")
-    return P()
+def explain_partition_rules(rules: Rules, tree: Any) -> List[dict]:
+    """The which-rule-matched-which-param report, as data: one row per
+    leaf with ``path``, ``shape``, the matching ``rule`` pattern (or
+    ``<scalar>`` / ``<unmatched>``), and the resulting ``spec``."""
+    rows = []
 
+    def note(kp, leaf):
+        path = _path_str(kp)
+        if leaf.ndim == 0:
+            rows.append({"path": path, "shape": tuple(leaf.shape),
+                         "rule": "<scalar>", "spec": P()})
+            return P()
+        for rule in rules:
+            if rule.matches(path):
+                spec = _aligned_spec(rule, path, leaf.ndim)
+                rows.append({"path": path, "shape": tuple(leaf.shape),
+                             "rule": rule.pattern, "spec": spec})
+                return spec
+        rows.append({"path": path, "shape": tuple(leaf.shape),
+                     "rule": "<unmatched>", "spec": P()})
+        return P()
+
+    jax.tree_util.tree_map_with_path(note, tree)
+    return rows
+
+
+def format_partition_report(rows: List[dict]) -> str:
+    """Render :func:`explain_partition_rules` rows as a printable
+    table (the ``--partition_report`` output)."""
+    if not rows:
+        return "(no leaves)"
+    wp = max(len(r["path"]) for r in rows)
+    wr = max(len(r["rule"]) for r in rows)
+    lines = [f"{'param':<{wp}}  {'shape':<18} {'rule':<{wr}}  spec"]
+    for r in rows:
+        lines.append(f"{r['path']:<{wp}}  "
+                     f"{str(r['shape']):<18} {r['rule']:<{wr}}  "
+                     f"{r['spec']}")
+    return "\n".join(lines)
+
+
+def parse_partition_rules(text: Optional[str]) -> Optional[Tuple[
+        PartitionRule, ...]]:
+    """``--partition_rules`` grammar → rule table (None passes through).
+
+    Rules are ``;``-separated ``regex=spec`` pairs, ordered. A spec is
+    comma-separated per-dim axis entries, right-aligned to each matched
+    leaf: an axis name (``model``, ``data``, ...), ``-``/``*``/empty
+    for an unsharded dim, or ``a+b`` for a multi-axis dim. An empty
+    spec or the word ``replicated`` is ``P()``; a ``^`` prefix
+    left-aligns the spec (leading-axis anchor, e.g. pipeline stages).
+
+    Example: ``"full1/(kernel|bias)$=model; full2/kernel$=model,-; .*="``
+    reproduces the CNN table.
+    """
+    if not text:
+        return None
+    rules = []
+    for i, chunk in enumerate(t for t in text.split(";") if t.strip()):
+        pattern, sep, spec_text = chunk.partition("=")
+        if not sep or not pattern.strip():
+            raise ValueError(
+                f"--partition_rules entry {i} ({chunk.strip()!r}) must "
+                f"be 'regex=spec' (spec may be empty for replicated)")
+        pattern = pattern.strip()
+        spec_text = spec_text.strip()
+        align = "right"
+        if spec_text.startswith("^"):
+            align = "left"
+            spec_text = spec_text[1:].strip()
+        if not spec_text or spec_text == "replicated":
+            spec = P()
+        else:
+            entries = []
+            for ent in spec_text.split(","):
+                ent = ent.strip()
+                if ent in ("", "-", "*"):
+                    entries.append(None)
+                elif "+" in ent:
+                    entries.append(tuple(a.strip()
+                                         for a in ent.split("+")))
+                else:
+                    entries.append(ent)
+            spec = P(*entries)
+        try:
+            re.compile(pattern)
+        except re.error as e:
+            raise ValueError(
+                f"--partition_rules entry {i}: bad regex "
+                f"{pattern!r}: {e}")
+        rules.append(PartitionRule(pattern, spec, align=align))
+    return tuple(rules)
+
+
+# ---------------------------------------------------------------------------
+# Per-model default tables. First match wins; every table ends in a
+# catch-all so the defaults never trip strict mode.
+# ---------------------------------------------------------------------------
+
+#: full1 2304→384 column-parallel, full2 384→192 row-parallel (the wide
+#: FC pair of the reference model, cifar10cnn.py:130-139); convs and the
+#: 192→10 head are small — replicated.
+CNN_RULES = (
+    PartitionRule(r"full1/(kernel|bias)$", P("model")),
+    PartitionRule(r"full2/kernel$", P("model", None)),
+    PartitionRule(r".*", P()),
+)
+
+#: Megatron pairing: qkv/mlp1 column-parallel (bias rides along),
+#: proj/mlp2 row-parallel (bias replicated). Right alignment covers the
+#: stacked [depth, ...] block leaves with the same two rules.
+VIT_RULES = (
+    PartitionRule(r"(qkv|mlp1)/(kernel|bias)$", P("model")),
+    PartitionRule(r"(proj|mlp2)/kernel$", P("model", None)),
+    PartitionRule(r".*", P()),
+)
+
+#: Expert parallelism: expert-major MoE weights shard their E dim
+#: (w [.., E, D, H], b [.., E, H]) over ``model`` (ops/moe.py); the
+#: router gate stays replicated; attention follows the dense ViT rules.
+VIT_MOE_RULES = (
+    PartitionRule(r"moe/(w1|w2)$", P("model", None, None)),
+    PartitionRule(r"moe/(b1|b2)$", P("model", None)),
+    PartitionRule(r"moe/gate", P()),
+) + VIT_RULES
+
+#: Pipelined stack: each stage owns depth/P contiguous layers — the
+#: stacked [depth, ...] leaves shard their LEADING axis over ``pipe``
+#: (left-aligned). Tensor-parallel specs are dropped (shard_map stages
+#: would need hand-written collectives; parallel/pipeline.py docstring).
+VIT_PIPE_RULES = (
+    PartitionRule(r"^blocks/", P("pipe"), align="left"),
+    PartitionRule(r".*", P()),
+)
+
+REPLICATED_RULES = (PartitionRule(r".*", P()),)
 
 _RULES = {
-    "cnn": _cnn_rule,
-    "resnet18": _replicated,
-    "resnet50": _replicated,
-    "vit_tiny": _vit_rule,
-    "vit_moe": _vit_moe_rule,
+    "cnn": CNN_RULES,
+    "resnet18": REPLICATED_RULES,
+    "resnet50": REPLICATED_RULES,
+    "vit_tiny": VIT_RULES,
+    "vit_moe": VIT_MOE_RULES,
 }
 
 _PIPE_RULES = {
-    "vit_tiny": _vit_pipe_rule,
+    "vit_tiny": VIT_PIPE_RULES,
 }
 
 
-def rule_for(model_name: str, pipe: bool = False) -> Rule:
+def rule_for(model_name: str, pipe: bool = False) -> Rules:
+    """The model's default rule table (pipeline table when ``pipe``)."""
     if pipe:
         if model_name not in _PIPE_RULES:
             raise ValueError(
                 f"pipeline parallelism is not supported for {model_name!r} "
                 f"(supported: {sorted(_PIPE_RULES)})")
         return _PIPE_RULES[model_name]
-    return _RULES.get(model_name, _replicated)
+    return _RULES.get(model_name, REPLICATED_RULES)
 
 
 def _add_fsdp(spec: P, shape, data_size: int) -> P:
@@ -167,27 +321,47 @@ def _path_str(key_path) -> str:
 
 
 def param_pspecs(model_name: str, params: Any, pipe: bool = False,
-                 fsdp_data: int = 0) -> Any:
+                 fsdp_data: int = 0, rules: Optional[Rules] = None,
+                 strict: bool = False) -> Any:
     """Pytree of ``PartitionSpec`` matching ``params`` (arrays or
     ShapeDtypeStructs). ``fsdp_data > 1`` layers the ZeRO/FSDP ``data``-axis
-    sharding on top of the model's tensor/pipeline rule."""
-    rule = rule_for(model_name, pipe=pipe)
+    sharding on top of the rule table; ``rules`` (a ``--partition_rules``
+    table) overrides the model's default one; ``strict`` errors on
+    unmatched leaves instead of replicating them."""
+    table = rules if rules is not None else rule_for(model_name, pipe=pipe)
+    specs = match_partition_rules(table, params, strict=strict)
+    if not fsdp_data:
+        return specs
+    return jax.tree.map(
+        lambda spec, leaf: _add_fsdp(spec, leaf.shape, fsdp_data),
+        specs, params, is_leaf=lambda x: isinstance(x, P))
 
-    def spec_for(kp, leaf):
-        spec = rule(_path_str(kp), leaf.ndim)
-        return _add_fsdp(spec, leaf.shape, fsdp_data)
 
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+#: Optimizer-state entries that mirror the param tree leaf-for-leaf and
+#: therefore take the per-param partition specs (everything else in
+#: ``opt`` — scalar step, adafactor's factored stats, BN EMA — stays
+#: replicated). ZERO1_KEYS is the subset ``--optimizer_sharding zero1``
+#: additionally shards over ``data``: the per-param moments plus the
+#: eval-time EMA (state memory, not forward-pass weights); the
+#: async-staleness ring serves the FORWARD pass and must stay whole.
+PARAM_SHAPED_OPT_KEYS = ("momentum", "mu", "nu", "ema", "stale")
+ZERO1_KEYS = ("momentum", "mu", "nu", "ema")
 
 
 def state_pspecs(model_name: str, state: Any, pipe: bool = False,
-                 fsdp_data: int = 0) -> Any:
+                 fsdp_data: int = 0, zero1_data: int = 0,
+                 rules: Optional[Rules] = None,
+                 strict: bool = False) -> Any:
     """Specs for a full ``TrainState``: params by model rule, per-param
     optimizer moments (SGD momentum, AdamW mu/nu) mirror the params (same
     tree paths), scalar step + BN state replicated. With ``fsdp_data > 1``
     params AND moments are sharded over ``data`` (ZeRO-3: the dominant
     state memory scales 1/|data|; BN state stays replicated — it is
-    pmean'd cross-replica, not per-shard)."""
+    pmean'd cross-replica, not per-shard). With ``zero1_data > 1`` ONLY
+    the optimizer moments (+ EMA) shard over ``data`` (ZeRO-1, arxiv
+    2004.13336): params stay in their model layout for the forward, each
+    replica owns 1/N of the update state, and the step's reduce-scatter /
+    sharded-update / all-gather schedule follows from these specs alone."""
     # "stale" (the async-staleness ring) carries a leading [S] axis; the
     # rules index from the trailing dims, so the same per-param specs
     # apply — the extra leading dim just stays unsharded.
@@ -196,32 +370,44 @@ def state_pspecs(model_name: str, state: Any, pipe: bool = False,
     # them buys no meaningful memory and their reduced ranks don't fit
     # the per-param trailing-dim rules), and "v" holds full accumulators
     # only for 1-D leaves (biases/BN — already tiny).
-    opt = {k: (param_pspecs(model_name, v, pipe=pipe, fsdp_data=fsdp_data)
-               if k in ("momentum", "mu", "nu", "ema", "stale")
-               else jax.tree.map(lambda _: P(), v))
-           for k, v in state.opt.items()}
+    def opt_specs(k, v):
+        if k not in PARAM_SHAPED_OPT_KEYS:
+            return jax.tree.map(lambda _: P(), v)
+        data = max(fsdp_data, zero1_data if k in ZERO1_KEYS else 0)
+        return param_pspecs(model_name, v, pipe=pipe, fsdp_data=data,
+                            rules=rules, strict=strict)
+
+    opt = {k: opt_specs(k, v) for k, v in state.opt.items()}
     return type(state)(
         params=param_pspecs(model_name, state.params, pipe=pipe,
-                            fsdp_data=fsdp_data),
+                            fsdp_data=fsdp_data, rules=rules,
+                            strict=strict),
         opt=opt,
         model_state=jax.tree.map(lambda _: P(), state.model_state),
     )
 
 
 def state_shardings(mesh: Mesh, model_name: str, state: Any,
-                    fsdp: bool = False) -> Any:
+                    fsdp: bool = False, zero1: bool = False,
+                    rules: Optional[Rules] = None,
+                    strict: bool = False) -> Any:
     """``state_pspecs`` bound to a mesh → pytree of ``NamedSharding``.
 
     A mesh with a nontrivial ``pipe`` axis selects the pipeline layout
     (stage-sharded layer stacks) instead of the tensor-parallel one.
     ``fsdp=True`` additionally shards params + optimizer moments over the
     ``data`` axis (ZeRO-3); GSPMD compiles the all-gather before compute
-    and the reduce-scatter of gradients in place of the plain all-reduce."""
+    and the reduce-scatter of gradients in place of the plain all-reduce.
+    ``zero1=True`` shards ONLY the optimizer moments (+ EMA) over
+    ``data`` — the ZeRO-1 layout ``--optimizer_sharding`` builds on."""
     pipe = mesh.shape.get("pipe", 1) > 1
     fsdp_data = mesh.shape["data"] if fsdp else 0
+    zero1_data = mesh.shape["data"] if zero1 else 0
     return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
                         state_pspecs(model_name, state, pipe=pipe,
-                                     fsdp_data=fsdp_data),
+                                     fsdp_data=fsdp_data,
+                                     zero1_data=zero1_data,
+                                     rules=rules, strict=strict),
                         is_leaf=lambda x: isinstance(x, P))
 
 

@@ -13,8 +13,22 @@ step. Two modes:
   ``lax.psum``/``lax.pmean`` — the hand-written SPMD form, used by tests to
   pin down the semantics and as the template for custom-collective work.
 
-Both modes are bit-comparable (tests assert it) and both donate the input
-state so parameter memory is updated in place in HBM.
+Three weight-update paths exist, with PINNED (tested) equivalence
+tolerances — see PARITY.md "Update-path equivalence":
+
+- replicated (the default) vs ``explicit_collectives``: bit-identical
+  (``test_step.py`` asserts exact equality — same reduction schedule).
+- ``--optimizer_sharding zero1`` (reduce-scatter / sharded update /
+  all-gather) vs replicated: final params within 1e-6 absolute
+  (``test_zero1.py`` — the reduce-scatter may reorder the gradient sum).
+- the fused single-pass optimizer (``ops/optimizer.py``) vs the
+  ``tree_map`` chain: the XLA form is bit-identical (same f32
+  elementwise expression); the Pallas kernel is within a few f32 ULPs
+  of it (≤ 5e-7 absolute — FMA contraction differences; both pinned in
+  ``test_zero1.py``).
+
+Every mode donates the input state so parameter memory is updated in
+place in HBM.
 """
 
 from __future__ import annotations
@@ -114,19 +128,28 @@ def train_state_shardings(
     data_cfg: DataConfig,
     optim_cfg: OptimConfig,
     fsdp: bool = False,
+    zero1: bool = False,
+    rules=None,
+    strict: bool = False,
 ) -> TrainState:
     """The ``TrainState`` sharding tree (tensor-parallel rules applied) for
     a model config, computed shape-only via ``eval_shape``. Compute it ONCE
     and hand the same tree to ``make_train_step`` / ``make_eval_step`` /
     ``restore_checkpoint`` — it is the single currency for state layout.
     ``fsdp=True`` adds the ZeRO-3 ``data``-axis sharding of params +
-    moments (:func:`~..parallel.shardings.state_shardings`)."""
+    moments; ``zero1=True`` shards ONLY the optimizer moments (+ EMA)
+    over ``data`` (``--optimizer_sharding zero1`` — the state is
+    ALLOCATED sharded from init on, which is the HBM win). ``rules`` is
+    an optional ``--partition_rules`` table overriding the model's
+    default (:mod:`~dml_cnn_cifar10_tpu.parallel.shardings`); ``strict``
+    errors on leaves no rule matches."""
     abstract = jax.eval_shape(
         lambda k: init_train_state(k, model_def, model_cfg, data_cfg,
                                    optim_cfg),
         jax.random.key(0))
     return shardings_lib.state_shardings(mesh, model_cfg.name, abstract,
-                                         fsdp=fsdp)
+                                         fsdp=fsdp, zero1=zero1,
+                                         rules=rules, strict=strict)
 
 
 def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,
@@ -177,7 +200,7 @@ def _forward_loss(model_def: ModelDef, model_cfg: ModelConfig,
 
 
 def _fsdp_gather_wrap(loss_fn, mesh: Optional[Mesh], model_cfg: ModelConfig,
-                      state_sharding: Optional[TrainState]):
+                      state_sharding: Optional[TrainState], rules=None):
     """ZeRO-3's gather-before-compute, stated explicitly.
 
     When the parameter STORAGE layout shards over ``data`` (FSDP), leaving
@@ -199,13 +222,56 @@ def _fsdp_gather_wrap(loss_fn, mesh: Optional[Mesh], model_cfg: ModelConfig,
     pipe = mesh.shape.get("pipe", 1) > 1
 
     def gathered(params, model_state, images, labels):
-        specs = shardings_lib.param_pspecs(model_cfg.name, params, pipe=pipe)
+        specs = shardings_lib.param_pspecs(model_cfg.name, params,
+                                           pipe=pipe, rules=rules)
         shs = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                            is_leaf=lambda x: isinstance(x, P))
         params = lax.with_sharding_constraint(params, shs)
         return loss_fn(params, model_state, images, labels)
 
     return gathered
+
+
+def _zero1_update(mesh: Mesh, model_cfg: ModelConfig,
+                  optim_cfg: OptimConfig, rules=None):
+    """The ZeRO-1 weight-update schedule (arxiv 2004.13336), stated as
+    sharding constraints: ``(grads, opt, params) -> (new_params,
+    new_opt)``.
+
+    Gradients are constrained to the ``data``-sharded layout of the
+    optimizer moments, which — composed with the batch-sharded loss's
+    gradient psum — XLA's all-reduce reassociation compiles to a
+    REDUCE-SCATTER over ``data``; the optimizer update then runs on 1/N
+    of the param bytes per replica (the moments live sharded, so the
+    elementwise update partitions to match), and constraining the new
+    params back to their base (tensor-parallel-only) layout compiles to
+    the ALL-GATHER that rebuilds the full weights for the next forward.
+    Same math as the replicated update to reduction-reorder tolerance
+    (pinned ≤ 1e-6 by ``test_zero1.py``; PARITY.md)."""
+    ndata = mesh.shape["data"]
+    pipe = mesh.shape.get("pipe", 1) > 1
+
+    def named(specs):
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def update(grads, opt, params):
+        shard_sh = named(shardings_lib.param_pspecs(
+            model_cfg.name, params, pipe=pipe, fsdp_data=ndata,
+            rules=rules))
+        base_sh = named(shardings_lib.param_pspecs(
+            model_cfg.name, params, pipe=pipe, rules=rules))
+        grads = lax.with_sharding_constraint(grads, shard_sh)
+        # pallas_ok=False: the update operands are data-sharded here —
+        # the XLA expression is what GSPMD partitions into the 1/N
+        # per-replica update (ops/optimizer.py module docstring).
+        new_params, new_opt = optim_lib.sgd_update(grads, opt, params,
+                                                   optim_cfg,
+                                                   pallas_ok=False)
+        new_params = lax.with_sharding_constraint(new_params, base_sh)
+        return new_params, new_opt
+
+    return update
 
 
 def _global_norm(tree) -> jax.Array:
@@ -234,7 +300,8 @@ def _health_stats(params, new_params, grads) -> dict:
 
 
 def _step_body(loss_fn, optim_cfg: OptimConfig,
-               health_metrics: bool = False):
+               health_metrics: bool = False, update_fn=None,
+               pallas_ok=None):
     """``(state, images, labels) -> (new_state, metrics)`` — the shared
     grad/update/metrics math of ``make_train_step`` and
     ``make_train_chunk`` (one source of truth for both).
@@ -243,8 +310,18 @@ def _step_body(loss_fn, optim_cfg: OptimConfig,
     averaging grads/metrics, then applies ONE optimizer update — the same
     math as the full batch (equal-sized microbatches ⇒ mean of means) in
     1/accum of the activation memory.
+
+    ``update_fn(grads, opt, params) -> (new_params, new_opt)`` overrides
+    the plain ``optim_lib.sgd_update`` apply — the ZeRO-1 schedule
+    (:func:`_zero1_update`) rides this seam; the default is the
+    replicated update. ``pallas_ok=False`` vetoes the fused optimizer's
+    Pallas lowering (see :func:`_pallas_veto`).
     """
     accum = max(1, optim_cfg.grad_accum)
+    if update_fn is None:
+        def update_fn(grads, opt, params):
+            return optim_lib.sgd_update(grads, opt, params, optim_cfg,
+                                        pallas_ok=pallas_ok)
 
     def grad_and_metrics(params, model_state, images, labels):
         # named_scope prefixes the emitted ops so a --profile_at_steps
@@ -305,8 +382,7 @@ def _step_body(loss_fn, optim_cfg: OptimConfig,
             grads = jax.tree.map(lambda g: g / accum, gsum)
             metrics = jax.tree.map(lambda v: v / accum, msum)
         with jax.named_scope("optimizer"):
-            new_params, new_opt = optim_lib.sgd_update(
-                grads, state.opt, state.params, optim_cfg)
+            new_params, new_opt = update_fn(grads, state.opt, state.params)
         if health_metrics:
             metrics.update(_health_stats(state.params, new_params, grads))
         if staleness >= 2:
@@ -326,6 +402,53 @@ def _step_body(loss_fn, optim_cfg: OptimConfig,
     return step
 
 
+def _check_optimizer_sharding(optim_cfg: OptimConfig,
+                              explicit_collectives: bool = False) -> None:
+    """Reject invalid ``--optimizer_sharding`` combinations at build
+    time (every step builder calls this)."""
+    mode = getattr(optim_cfg, "optimizer_sharding", "none")
+    if mode not in ("none", "zero1"):
+        raise ValueError(
+            f"optimizer_sharding={mode!r} must be one of none | zero1")
+    if mode == "zero1":
+        if explicit_collectives:
+            raise ValueError(
+                "optimizer_sharding=zero1 needs the GSPMD (default) "
+                "step: the explicit_collectives shard_map path applies "
+                "the update replicated per device")
+        if optim_cfg.async_staleness >= 2:
+            raise ValueError(
+                "optimizer_sharding=zero1 does not compose with "
+                "async_staleness: the snapshot ring serves the forward "
+                "pass and must stay whole, but zero1 shards the update "
+                "state it is refreshed from")
+
+
+def _maybe_zero1(mesh: Optional[Mesh], model_cfg: ModelConfig,
+                 optim_cfg: OptimConfig, rules=None):
+    """The ZeRO-1 update override when configured and meaningful
+    (a mesh exists), else None (plain replicated update)."""
+    if mesh is None or \
+            getattr(optim_cfg, "optimizer_sharding", "none") != "zero1":
+        return None
+    return _zero1_update(mesh, model_cfg, optim_cfg, rules=rules)
+
+
+def _pallas_veto(state_sharding: Optional[TrainState]):
+    """``pallas_ok`` for the fused optimizer: ``False`` when the update
+    operands are GSPMD-sharded (tp/fsdp/pipe/seq param layout) — a
+    ``pallas_call`` is an opaque custom call the partitioner cannot
+    split, so a sharded update must stay on the (identical-math,
+    partitionable) XLA expression. ``None`` (platform default) when
+    params are replicated."""
+    if state_sharding is None:
+        return None
+    if any(shardings_lib.specs_name_axis(state_sharding.params, ax)
+           for ax in ("model", "pipe", "seq", "data")):
+        return False
+    return None
+
+
 def make_train_step(
     model_def: ModelDef,
     model_cfg: ModelConfig,
@@ -335,6 +458,7 @@ def make_train_step(
     state_sharding: Optional[TrainState] = None,
     health_metrics: bool = False,
     compile_cache=None,
+    rules=None,
 ) -> Callable[[TrainState, jax.Array, jax.Array],
               Tuple[TrainState, dict]]:
     """Build the jitted train step:
@@ -344,7 +468,10 @@ def make_train_step(
     partitioned per the model's tensor-parallel rules
     (:mod:`~dml_cnn_cifar10_tpu.parallel.shardings`); ``None`` means
     replicated state — identical layout when the ``model`` axis is 1.
+    ``rules`` is the optional ``--partition_rules`` table (must match
+    the one ``state_sharding`` was built with).
     """
+    _check_optimizer_sharding(optim_cfg, explicit_collectives)
 
     if explicit_collectives and mesh is not None:
         if (mesh.shape["model"] * mesh.shape["seq"]
@@ -377,8 +504,11 @@ def make_train_step(
     loss_fn = _fsdp_gather_wrap(
         _forward_loss(model_def, model_cfg, mesh=mesh,
                       label_smoothing=optim_cfg.label_smoothing),
-        mesh, model_cfg, state_sharding)
-    step = _step_body(loss_fn, optim_cfg, health_metrics=health_metrics)
+        mesh, model_cfg, state_sharding, rules=rules)
+    step = _step_body(loss_fn, optim_cfg, health_metrics=health_metrics,
+                      update_fn=_maybe_zero1(mesh, model_cfg, optim_cfg,
+                                             rules),
+                      pallas_ok=_pallas_veto(state_sharding))
 
     def _cached(jitted):
         return _cc_wrap(jitted, compile_cache, "train_step",
@@ -406,7 +536,8 @@ def make_train_step(
 
 def _chunk_body(loss_fn, optim_cfg: OptimConfig,
                 data_cfg: Optional[DataConfig],
-                health_metrics: bool = False):
+                health_metrics: bool = False, update_fn=None,
+                pallas_ok=None):
     """``(state, images [K,B,...], labels [K,B]) -> (state, last-step
     metrics)`` — the shared scan-over-K-steps math of ``make_train_chunk``
     and ``make_train_chunk_resident`` (one source of truth).
@@ -419,7 +550,8 @@ def _chunk_body(loss_fn, optim_cfg: OptimConfig,
     per (seed, step).
     """
     one_step = _step_body(loss_fn, optim_cfg,
-                          health_metrics=health_metrics)
+                          health_metrics=health_metrics,
+                          update_fn=update_fn, pallas_ok=pallas_ok)
     if data_cfg is not None:
         from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
 
@@ -476,6 +608,7 @@ def make_train_chunk(
     data_cfg: Optional[DataConfig] = None,
     health_metrics: bool = False,
     compile_cache=None,
+    rules=None,
 ) -> Callable[[TrainState, jax.Array, jax.Array],
               Tuple[TrainState, dict]]:
     """K training steps per dispatch: ``(state, images [K,B,...], labels
@@ -492,12 +625,15 @@ def make_train_chunk(
     (:func:`~dml_cnn_cifar10_tpu.ops.preprocess.device_preprocess`) — the
     host only shuffles bytes, H2D moves uint8.
     """
+    _check_optimizer_sharding(optim_cfg)
     chunk = _chunk_body(
         _fsdp_gather_wrap(
             _forward_loss(model_def, model_cfg, mesh=mesh,
                           label_smoothing=optim_cfg.label_smoothing),
-            mesh, model_cfg, state_sharding),
-        optim_cfg, data_cfg, health_metrics=health_metrics)
+            mesh, model_cfg, state_sharding, rules=rules),
+        optim_cfg, data_cfg, health_metrics=health_metrics,
+        update_fn=_maybe_zero1(mesh, model_cfg, optim_cfg, rules),
+        pallas_ok=_pallas_veto(state_sharding))
 
     def _cached(jitted):
         return _cc_wrap(jitted, compile_cache, "train_chunk",
@@ -532,6 +668,7 @@ def make_train_chunk_resident(
     index_stream: Optional[Tuple[int, int, int]] = None,
     health_metrics: bool = False,
     compile_cache=None,
+    rules=None,
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, dict]]:
     """Chunked training against an HBM-resident dataset:
     ``(state, idx [K, B] int32) -> (new_state, metrics of the LAST step)``.
@@ -565,17 +702,21 @@ def make_train_chunk_resident(
         raise ValueError(
             "make_train_chunk_resident requires data_cfg (the gathered "
             "dataset rows are raw uint8 and must be decoded on device)")
+    _check_optimizer_sharding(optim_cfg)
     loss = _fsdp_gather_wrap(
         _forward_loss(model_def, model_cfg, mesh=mesh,
                       label_smoothing=optim_cfg.label_smoothing),
-        mesh, model_cfg, state_sharding)
+        mesh, model_cfg, state_sharding, rules=rules)
 
     spatial = mesh_lib.spatial_enabled(model_def, mesh)
     repl = mesh_lib.replicated(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
 
     body = _chunk_body(loss, optim_cfg, data_cfg,
-                       health_metrics=health_metrics)
+                       health_metrics=health_metrics,
+                       update_fn=_maybe_zero1(mesh, model_cfg, optim_cfg,
+                                              rules),
+                       pallas_ok=_pallas_veto(state_sharding))
     gathered_sh = mesh_lib.batch_sharding(mesh, 5, leading_dims=1,
                                           spatial=spatial)
 

@@ -35,6 +35,7 @@ from dml_cnn_cifar10_tpu.models.registry import get_model
 from dml_cnn_cifar10_tpu.parallel import cluster as cluster_lib
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import multihost
+from dml_cnn_cifar10_tpu.parallel import shardings as shardings_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
 from dml_cnn_cifar10_tpu.utils import devprof as devprof_lib
 from dml_cnn_cifar10_tpu.utils import faults as faults_lib
@@ -99,16 +100,44 @@ class Trainer:
                 "fsdp needs the GSPMD (default) step: the "
                 "explicit_collectives shard_map path expects replicated "
                 "state")
+        zero1 = cfg.optim.optimizer_sharding == "zero1"
+        if zero1 and cfg.parallel.fsdp:
+            raise ValueError(
+                "optimizer_sharding=zero1 does not compose with --fsdp: "
+                "ZeRO-3 already shards the optimizer moments (and the "
+                "params) over the data axis")
+        # Partition-rule override (--partition_rules): parsed once, used
+        # by every sharding-tree/step build below so the layouts agree.
+        self.partition_rules = shardings_lib.parse_partition_rules(
+            cfg.parallel.partition_rules)
         self.state_sharding = None if cfg.parallel.explicit_collectives \
             else step_lib.train_state_shardings(
                 self.mesh, self.model_def, cfg.model, cfg.data, cfg.optim,
-                fsdp=cfg.parallel.fsdp)
+                fsdp=cfg.parallel.fsdp, zero1=zero1,
+                rules=self.partition_rules,
+                strict=cfg.parallel.partition_rules_strict)
+        if cfg.parallel.partition_report and jax.process_index() == 0:
+            # The which-rule-matched-which-param report, over the same
+            # abstract params the sharding tree was computed from.
+            abstract = jax.eval_shape(
+                lambda k: step_lib.init_train_state(
+                    k, self.model_def, cfg.model, cfg.data, cfg.optim),
+                jax.random.key(0))
+            table = self.partition_rules if self.partition_rules \
+                is not None else shardings_lib.rule_for(
+                    cfg.model.name,
+                    pipe=self.mesh.shape.get("pipe", 1) > 1)
+            print("[shardings] partition report (params):")
+            print(shardings_lib.format_partition_report(
+                shardings_lib.explain_partition_rules(table,
+                                                      abstract.params)))
         self.train_step = step_lib.make_train_step(
             self.model_def, cfg.model, cfg.optim, self.mesh,
             explicit_collectives=cfg.parallel.explicit_collectives,
             state_sharding=self.state_sharding,
             health_metrics=cfg.health_metrics,
-            compile_cache=self.compile_cache)
+            compile_cache=self.compile_cache,
+            rules=self.partition_rules)
         self.steps_per_dispatch = max(1, cfg.steps_per_dispatch)
         if self.steps_per_dispatch > 1:
             k = self.steps_per_dispatch
@@ -128,7 +157,8 @@ class Trainer:
                 self.model_def, cfg.model, cfg.optim, self.mesh,
                 state_sharding=self.state_sharding, data_cfg=cfg.data,
                 health_metrics=cfg.health_metrics,
-                compile_cache=self.compile_cache)
+                compile_cache=self.compile_cache,
+                rules=self.partition_rules)
         self.eval_step = step_lib.make_eval_step(
             self.model_def, cfg.model, self.mesh,
             state_sharding=self.state_sharding,
@@ -364,7 +394,8 @@ class Trainer:
                 index_stream=((cfg.data.seed, cfg.batch_size, k)
                               if dev_stream else None),
                 health_metrics=cfg.health_metrics,
-                compile_cache=self.compile_cache)
+                compile_cache=self.compile_cache,
+                rules=self.partition_rules)
             idx_sh = mesh_lib.batch_sharding(self.mesh, 2, leading_dims=1)
             # Eval also goes resident: boundary train-accuracy is index-fed
             # from the in-HBM train split, test eval is one dispatch over
@@ -836,12 +867,21 @@ class Trainer:
                             # trust accordingly).
                             perf["flops_stack"] = flops_cell.pop("stack")
                         self.logger.train_print(global_step, i + k - 1, acc)
+                        # optimizer_ms: per-step device time inside the
+                        # step's named_scope("optimizer"), measured by
+                        # the last --profile_at_steps window (null until
+                        # one completes) — the kernel/sharding win is
+                        # attributed, not inferred.
                         self.logger.log("train", step=global_step, loss=loss,
                                         train_accuracy=acc,
                                         images_per_sec=rate,
                                         lr=_current_lr(cfg, global_step),
                                         device_step_ms=device_step_ms,
                                         drain_wait_ms=drain_wait_ms,
+                                        optimizer_ms=(
+                                            devwin.optimizer_step_ms
+                                            if devwin is not None
+                                            else None),
                                         **perf)
                         telemetry_lib.flush_boundary(tracer, self.logger,
                                                      global_step)

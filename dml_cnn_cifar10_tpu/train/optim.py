@@ -11,11 +11,20 @@ Implemented as a minimal functional optimizer (init/update pytrees) with
 optional momentum / weight decay / grad clipping for the config-ladder
 models. It is deliberately optax-shaped; ``as_optax()`` exposes the same
 thing as a ``GradientTransformation`` for users who want to compose.
+
+The plain-SGD apply runs fused by default (``ops/optimizer.py``:
+momentum + weight decay + LR in ONE pass over the param bytes — a
+Pallas TPU kernel with an identical-math XLA fallback by platform;
+``--fused_optimizer false`` restores the tree_map chain). Under
+``--optimizer_sharding zero1`` the caller (``parallel/step.py``)
+wraps this update in the reduce-scatter/all-gather schedule; the
+moments it reads are then ``data``-sharded and the same elementwise
+math partitions 1/N per replica (docs/SHARDING.md).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -154,7 +163,8 @@ def _clipped(grads: Any, cfg: OptimConfig) -> Any:
 
 
 def sgd_update(
-    grads: Any, state: OptState, params: Any, cfg: OptimConfig
+    grads: Any, state: OptState, params: Any, cfg: OptimConfig,
+    pallas_ok: Optional[bool] = None
 ) -> Tuple[Any, OptState]:
     """One optimizer step; returns (new_params, new_state).
 
@@ -163,8 +173,15 @@ def sgd_update(
     decay into the gradient (classic L2); AdamW decays decoupled, applied
     directly to the weights (Loshchilov & Hutter). ``cfg.ema_decay`` also
     tracks an eval-time parameter EMA across every family.
+
+    ``pallas_ok=False`` vetoes the fused path's Pallas lowering (same
+    math via the XLA expression): the step builders pass it when the
+    update's operands are GSPMD-sharded (tp/fsdp/pipe state) — an
+    opaque ``pallas_call`` there would force the partitioner to
+    materialize full replicas. ``None`` resolves by platform.
     """
-    new_params, new_state = _base_update(grads, state, params, cfg)
+    new_params, new_state = _base_update(grads, state, params, cfg,
+                                         pallas_ok=pallas_ok)
     if cfg.ema_decay:
         d = ema_decay_at(cfg, new_state["step"])
         new_state["ema"] = jax.tree.map(
@@ -174,7 +191,8 @@ def sgd_update(
 
 
 def _base_update(
-    grads: Any, state: OptState, params: Any, cfg: OptimConfig
+    grads: Any, state: OptState, params: Any, cfg: OptimConfig,
+    pallas_ok: Optional[bool] = None
 ) -> Tuple[Any, OptState]:
     step = state["step"]
     lr = learning_rate(cfg, step)
@@ -283,10 +301,27 @@ def _base_update(
                                   params, mom)
         return new_params, {"step": step + 1, "momentum": mom}
 
+    new_state: OptState = {"step": step + 1}
+    if getattr(cfg, "fused_optimizer", True):
+        # Fused single-pass update (ops/optimizer.py): decay + momentum
+        # + apply in ONE pass over the param bytes — a Pallas TPU kernel,
+        # or the identical (bit-equal, PARITY.md) f32 expression as one
+        # fused XLA loop on other platforms / under GSPMD-sharded
+        # (zero1) layouts. --fused_optimizer false keeps the historical
+        # tree_map chain below.
+        from dml_cnn_cifar10_tpu.ops import optimizer as fused_lib
+
+        new_params, mom = fused_lib.fused_sgd_update(
+            params, grads, state.get("momentum") if cfg.momentum else None,
+            lr, cfg.momentum, cfg.weight_decay,
+            optimizer_sharding=getattr(cfg, "optimizer_sharding", "none"),
+            use_pallas=False if pallas_ok is False else None)
+        if mom is not None:
+            new_state["momentum"] = mom
+        return new_params, new_state
     if cfg.weight_decay:
         grads = jax.tree.map(lambda g, p: g + cfg.weight_decay * p,
                              grads, params)
-    new_state: OptState = {"step": step + 1}
     if cfg.momentum:
         mom = jax.tree.map(lambda m, g: cfg.momentum * m + g,
                            state["momentum"], grads)

@@ -52,6 +52,14 @@ from typing import List, Optional
 #: Device-time buckets, in report order.
 DEVTIME_BUCKETS = ("compute", "collective", "infeed")
 
+#: named_scope phases attributed as their own (overlapping) totals, in
+#: addition to the exclusive buckets above: the train step wraps its
+#: grad and update phases in jax.named_scope("fwd_bwd"/"optimizer")
+#: (parallel/step.py), and the scope name survives into the emitted op
+#: names / metadata — so `optimizer_ms` is MEASURED attribution of the
+#: weight-update tail (the ZeRO-1 / fused-kernel target), not inference.
+SCOPE_RE = re.compile(r"optimizer")
+
 _COLLECTIVE_RE = re.compile(
     r"all[-_]?reduce|all[-_]?gather|reduce[-_]?scatter|all[-_]?to[-_]?all"
     r"|collective[-_]?permute|collective|ppermute|psum|\bsend\b|\brecv\b")
@@ -125,12 +133,23 @@ def parse_trace_doc(doc: dict, top_k: int = 12) -> List[dict]:
         if not evs:
             continue
         by_op = {}
+        optimizer_us = 0.0
         t_lo = min(e["ts"] for e in evs)
         t_hi = max(e["ts"] + e["dur"] for e in evs)
         for e in evs:
             agg = by_op.setdefault(e.get("name") or "?", [0.0, 0])
             agg[0] += e["dur"]          # microseconds
             agg[1] += 1
+            # Scope attribution: the named_scope prefix may live in the
+            # event name OR in the profiler's metadata args (long_name /
+            # tf_op carry the full HLO op_name on XLA device lanes).
+            args = e.get("args") or {}
+            text = " ".join((e.get("name") or "",
+                             str(args.get("name", "")),
+                             str(args.get("long_name", "")),
+                             str(args.get("tf_op", "")))).lower()
+            if SCOPE_RE.search(text):
+                optimizer_us += e["dur"]
         buckets = dict.fromkeys(DEVTIME_BUCKETS, 0.0)
         total_us = 0.0
         for name, (dur_us, _calls) in by_op.items():
@@ -143,6 +162,10 @@ def parse_trace_doc(doc: dict, top_k: int = 12) -> List[dict]:
             "compute_ms": round(buckets["compute"] / 1e3, 3),
             "collective_ms": round(buckets["collective"] / 1e3, 3),
             "infeed_ms": round(buckets["infeed"] / 1e3, 3),
+            # OVERLAPPING scope total (a subset of the buckets above,
+            # not a fourth one): device time inside the step's
+            # jax.named_scope("optimizer") — the weight-update tail.
+            "optimizer_ms": round(optimizer_us / 1e3, 3),
             "window_ms": round((t_hi - t_lo) / 1e3, 3),
             "top_ops": [
                 {"name": name, "bucket": classify_op(name),
@@ -200,6 +223,11 @@ class ProfileWindow:
         self.top_k = top_k
         self.state = "pending"            # pending -> active -> done
         self._armed_at = start_step       # actual arm step once active
+        # Per-step optimizer device time from the parsed window (mean
+        # over lanes of optimizer_ms / steps-in-window); None until a
+        # window completes. Train rows after the window carry it as
+        # `optimizer_ms` — measured attribution of the update tail.
+        self.optimizer_step_ms: Optional[float] = None
 
     @classmethod
     def from_config(cls, cfg, logger=None) -> Optional["ProfileWindow"]:
@@ -257,6 +285,10 @@ class ProfileWindow:
             print(f"[devprof] no parseable trace under {self.out_dir}",
                   file=sys.stderr)
             return
+        steps = max(1, step - self._armed_at)
+        self.optimizer_step_ms = round(
+            sum(ln.get("optimizer_ms") or 0.0 for ln in lanes)
+            / len(lanes) / steps, 4)
         for lane in lanes:
             if self.logger is not None:
                 self.logger.log("devtime", step=step, **lane)

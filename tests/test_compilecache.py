@@ -107,6 +107,50 @@ def test_train_step_key_determinism_across_builders(data_cfg, tmp_path):
     assert k3 != k1
 
 
+def test_optimizer_sharding_and_partition_rules_change_key(data_cfg,
+                                                           tmp_path):
+    """--optimizer_sharding and --partition_rules alter the lowered
+    StableHLO (sharding constraints / in-sharding annotations), so they
+    MUST re-key the compile cache — a stale hit here silently serves an
+    executable with the wrong update schedule or state layout."""
+    from dml_cnn_cifar10_tpu.config import (ModelConfig, OptimConfig,
+                                            ParallelConfig)
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.parallel import shardings
+    from dml_cnn_cifar10_tpu.parallel import step as step_lib
+    from dml_cnn_cifar10_tpu.parallel.mesh import build_mesh, shard_batch
+
+    mesh = build_mesh(ParallelConfig())
+    md = get_model("cnn")
+    mc = ModelConfig(logit_relu=False)
+    cache = CompileCache(str(tmp_path))
+    rng = np.random.default_rng(0)
+    batch = shard_batch(mesh, rng.random((32, 24, 24, 3), np.float32),
+                        rng.integers(0, 10, (32,)).astype(np.int32))
+
+    def key_for(oc, rules=None, zero1=False):
+        sh = step_lib.train_state_shardings(mesh, md, mc, data_cfg, oc,
+                                            zero1=zero1, rules=rules)
+        fn = step_lib.make_train_step(md, mc, oc, mesh,
+                                      state_sharding=sh, rules=rules,
+                                      compile_cache=cache)
+        state = step_lib.init_train_state(
+            jax.random.key(0), md, mc, data_cfg, oc, mesh,
+            state_sharding=sh)
+        fn(state, *batch)
+        return fn.last_event["key"]
+
+    base = key_for(OptimConfig(momentum=0.9))
+    zero1 = key_for(OptimConfig(momentum=0.9,
+                                optimizer_sharding="zero1"), zero1=True)
+    rules = shardings.parse_partition_rules(
+        "full1/kernel$=data,-; .*=")     # storage layout change
+    ruled = key_for(OptimConfig(momentum=0.9), rules=rules)
+    assert base is not None
+    assert zero1 != base
+    assert ruled != base and ruled != zero1
+
+
 # ---------------------------------------------------------------------------
 # hit/miss mechanics + entry layout
 # ---------------------------------------------------------------------------

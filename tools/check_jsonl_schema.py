@@ -31,8 +31,11 @@ KIND_KEYS = {
     # `device_step_ms`/`drain_wait_ms` are the always-on device
     # step-time estimate riding the fused boundary fetch
     # (utils/devprof.py; null before the first complete window).
+    # `optimizer_ms` is the per-step device time inside the step's
+    # jax.named_scope("optimizer"), from the last --profile_at_steps
+    # capture window (null until one completes).
     "train": ("step", "loss", "train_accuracy", "images_per_sec", "lr",
-              "device_step_ms", "drain_wait_ms"),
+              "device_step_ms", "drain_wait_ms", "optimizer_ms"),
     "eval": ("step", "test_accuracy"),
     "span": ("step", "name", "start_s", "dur_s", "depth"),
     "goodput": ("step", "total_s", "train_frac", "compile_frac",
@@ -96,11 +99,13 @@ KIND_KEYS = {
     # Device-time attribution (utils/devprof.py; docs/OBSERVABILITY.md
     # device-time section). One record per trace lane of a
     # --profile_at_steps capture window: bucket totals in milliseconds
-    # (compute / collective / infeed), the lane's wall window, and the
-    # top-k op table as a nested list of
+    # (compute / collective / infeed), the overlapping named-scope
+    # total `optimizer_ms` (the weight-update tail), the lane's wall
+    # window, and the top-k op table as a nested list of
     # {name, bucket, dur_ms, calls, frac}.
     "devtime": ("step", "device", "total_ms", "compute_ms",
-                "collective_ms", "infeed_ms", "window_ms", "top_ops"),
+                "collective_ms", "infeed_ms", "optimizer_ms",
+                "window_ms", "top_ops"),
     # Serving runtime (serve/metrics.py; docs/SERVING.md). Percentile
     # values are null until the window has completions.
     "serve": ("requests", "completed", "shed_queue", "shed_deadline",
