@@ -390,6 +390,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shared recovery budget: max on_nonfinite=skip "
                         "events per run AND max supervisor restarts; "
                         "exhausted degrades to halt")
+    p.add_argument("--retry_budget_window", type=int, default=0,
+                   help="progress-based retry-budget reset: when > 0, "
+                        "the supervisor's attempt counter resets after "
+                        "the newest checkpoint advances this many "
+                        "steps past the last retry — long runs "
+                        "absorbing well-spaced faults keep recovering "
+                        "while a fault burst still degrades to halt. "
+                        "0 = lifetime budget (historical behavior)")
     p.add_argument("--recovery_backoff_s", type=float, default=0.5,
                    help="supervisor restart backoff base (doubles per "
                         "attempt, capped at 30s)")
@@ -400,13 +408,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "at the same LR diverges again)")
     p.add_argument("--fault_spec", type=str, default=None,
                    help="deterministic fault injection for recovery "
-                        "drills: comma-separated kind@step with kinds "
-                        "nan, ckpt_corrupt, sigterm, data_stall — plus "
-                        "the cluster kinds heartbeat_stall, host_lost, "
-                        "collective_hang, host_return (need "
-                        "--cluster_dir) — each fires once at the first "
-                        "dispatch at/after its global step "
-                        "(utils/faults.py)")
+                        "drills: comma-separated kind@trigger with "
+                        "kinds nan, ckpt_corrupt, sigterm, data_stall "
+                        "— plus the cluster kinds heartbeat_stall, "
+                        "host_lost, collective_hang, host_return, "
+                        "decision_corrupt (need --cluster_dir). A "
+                        "trigger is a global step (fires once at the "
+                        "first dispatch at/after it; several faults "
+                        "may share a step) or a recovery phase "
+                        "restore|adopt|decide that fires inside the "
+                        "supervisor's recovery paths (utils/faults.py; "
+                        "tools/chaos.py fuzzes these)")
     p.add_argument("--cluster_dir", type=str, default=None,
                    help="shared directory arming the cluster-resilience "
                         "layer (parallel/cluster.py): per-process "
@@ -539,6 +551,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         on_nonfinite=args.on_nonfinite,
         supervise=args.supervise,
         recovery_retries=args.recovery_retries,
+        retry_budget_window=args.retry_budget_window,
         recovery_backoff_s=args.recovery_backoff_s,
         rollback_lr_scale=args.rollback_lr_scale,
         fault_spec=args.fault_spec,

@@ -565,6 +565,14 @@ class TrainConfig:
     # Supervisor restart backoff: base * 2^(attempt-1), capped.
     recovery_backoff_s: float = 0.5
     recovery_backoff_max_s: float = 30.0
+    # Progress-based retry-budget reset: when > 0 and the newest
+    # checkpoint has advanced by at least this many steps since the
+    # budget was last charged, the supervisor's attempt counter resets
+    # to 0 before the next failure is judged — long runs absorbing many
+    # WELL-SPACED faults keep recovering, while a fault burst still
+    # exhausts the budget and degrades to halt. 0 (default) keeps the
+    # historical lifetime budget.
+    retry_budget_window: int = 0
     # LR multiplier applied at each supervisor rollback of a non-finite
     # failure (1.0 = keep the configured LR). A deterministically
     # diverging run needs the step size reduced, not just replayed.

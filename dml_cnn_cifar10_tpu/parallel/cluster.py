@@ -51,8 +51,10 @@ job is only to observe and abort.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -202,17 +204,63 @@ class RestartCoordinator:
     The decision file is the cluster's only piece of mutable shared
     truth, so it follows the checkpoint rules: written to a tmp name,
     committed by atomic rename, monotone ``epoch`` so a stale decision
-    can never be mistaken for a new one."""
+    can never be mistaken for a new one — and, like a checkpoint, it
+    carries a sha256 integrity sidecar (``restart_decision.json.sha256``)
+    committed AFTER the payload. A decision every survivor is about to
+    rebuild its world around must not be trusted on a successful JSON
+    parse alone: bit rot / a half-synced shared filesystem can serve a
+    decodable-but-wrong payload. :meth:`read` therefore returns **None
+    with a classified ``decision_corrupt`` telemetry record** on an
+    undecodable or sidecar-mismatched file, instead of either crashing
+    unclassified or silently adopting garbage; the poll loops that call
+    it self-heal on the next read. A payload without any sidecar is a
+    pre-hardening (or mid-commit) decision file and still decodes."""
 
-    def __init__(self, cluster_dir: str):
+    def __init__(self, cluster_dir: str, log_fn=None):
         self.path = os.path.join(cluster_dir, "restart_decision.json")
+        self.sidecar_path = self.path + ".sha256"
         os.makedirs(cluster_dir, exist_ok=True)
+        # Telemetry sink for corrupt-decision reads; the owning
+        # ClusterMonitor wires its (locked) log method in. Rate-limited
+        # per payload digest — await_decision polls at 20 Hz and one
+        # corrupt file must not flood the stream.
+        self._log = log_fn
+        self._last_bad_digest: Optional[str] = None
+
+    def _note_corrupt(self, digest: str, error: str) -> None:
+        if digest == self._last_bad_digest:
+            return
+        self._last_bad_digest = digest
+        print(f"[cluster] corrupt restart decision {self.path}: "
+              f"{error}; reading as absent", file=sys.stderr)
+        if self._log is not None:
+            self._log("decision_corrupt", path=self.path, error=error)
 
     def read(self) -> Optional[RestartDecision]:
         try:
-            with open(self.path) as f:
-                return RestartDecision(**json.load(f))
-        except (OSError, ValueError, TypeError):
+            with open(self.path, "rb") as f:
+                payload = f.read()
+        except OSError:
+            return None
+        digest = hashlib.sha256(payload).hexdigest()
+        want = None
+        try:
+            with open(self.sidecar_path) as f:
+                want = json.load(f)["digest"]
+        except OSError:
+            want = None  # no sidecar: legacy / mid-commit — decode only
+        except (ValueError, TypeError, KeyError) as e:
+            self._note_corrupt(digest, f"undecodable sidecar: {e}")
+            return None
+        if want is not None and want != digest:
+            self._note_corrupt(
+                digest, f"sidecar digest mismatch (have {digest[:12]}…, "
+                        f"sidecar says {str(want)[:12]}…)")
+            return None
+        try:
+            return RestartDecision(**json.loads(payload))
+        except (ValueError, TypeError) as e:
+            self._note_corrupt(digest, f"undecodable decision: {e}")
             return None
 
     def record(self, decision: RestartDecision) -> RestartDecision:
@@ -221,10 +269,22 @@ class RestartCoordinator:
             raise ValueError(
                 f"restart epoch must be monotone: have {prior.epoch}, "
                 f"recording {decision.epoch}")
+        payload = json.dumps(dataclasses.asdict(decision)).encode()
+        # Commit order is payload → sidecar (each via atomic rename):
+        # a reader between the two renames sees new payload + stale
+        # sidecar, reads it as corrupt-absent, and self-heals on the
+        # next poll — strictly better than a window where a mismatched
+        # pair could be half-trusted.
         tmp = self.path + f".tmp{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(dataclasses.asdict(decision), f)
+        with open(tmp, "wb") as f:
+            f.write(payload)
         os.replace(tmp, self.path)
+        sidecar = {"algo": "sha256",
+                   "digest": hashlib.sha256(payload).hexdigest()}
+        tmp = self.sidecar_path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(sidecar, f)
+        os.replace(tmp, self.sidecar_path)
         return decision
 
     def await_decision(self, min_epoch: int, timeout_s: float,
@@ -387,7 +447,8 @@ class ClusterMonitor:
         self._last_beat_log = 0.0
         self._last_rejoin_scan = 0.0
         self.store = HeartbeatStore(cluster_dir, process_id)
-        self.coordinator = RestartCoordinator(cluster_dir)
+        self.coordinator = RestartCoordinator(cluster_dir,
+                                              log_fn=self.log)
         self.watchdog = CollectiveWatchdog(
             self.store, self, straggler_after_s, peer_dead_after_s,
             collective_timeout_s, abort_fn=abort_fn)

@@ -206,6 +206,18 @@ class Trainer:
             self.logger.log("ckpt_fallback", step=step, path=path,
                             error=str(reason))
 
+        if self.faults is not None:
+            # Recovery-phase injection seam (utils/faults.py): a
+            # `kind@restore` fault strikes here, right before the
+            # restore walk reads anything — e.g. ckpt_corrupt@restore
+            # corrupts the newest checkpoint at the exact moment a
+            # recovery tries to restore it. Gated inside the injector
+            # to RECOVERY restores (the supervisor arms it); a fresh
+            # run's initial restore never fires.
+            self.faults.phase_hook("restore", self.cfg.log_dir,
+                                   logger=self.logger,
+                                   cluster=self.cluster)
+
         return ckpt_lib.restore_checkpoint(
             self.cfg.log_dir, state, sharding=sharding,
             on_fallback=note_fallback,

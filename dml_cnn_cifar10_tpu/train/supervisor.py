@@ -51,6 +51,17 @@ epoch at the next seam check and adopt it. Everything is testable on
 CPU in tier-1 via ``--fault_spec`` (utils/faults.py, including
 ``host_return@N``) and the lockstep simulation harness
 (``tests/test_cluster.py``, ``tests/test_elastic_expand.py``).
+
+Chaos hardening (ISSUE 10): the supervisor owns the recovery-phase
+fault seams (``@decide`` after a chief commits a decision, ``@adopt``
+after any seat adopts one, ``@restore`` armed for the next attempt's
+checkpoint restore) so ``tools/chaos.py`` can strike *inside* a
+recovery; a non-chief whose ``await_restart`` times out presumes the
+chief died mid-decision and takes the decision pen itself when it is
+the next live seat (re-deciding at a higher epoch); and
+``--retry_budget_window`` resets the attempt budget after sustained
+checkpoint progress, so long runs absorbing well-spaced faults never
+degrade to halt.
 """
 
 from __future__ import annotations
@@ -92,8 +103,13 @@ def classify_failure(exc: BaseException) -> Optional[str]:
         return "data"
     if isinstance(exc, FloatingPointError):
         return "nonfinite"
-    if isinstance(exc, ValueError) and "restore" in str(exc) \
-            and "checkpoint" in str(exc):
+    if isinstance(exc, ValueError) and "checkpoint" in str(exc) \
+            and ("restore" in str(exc) or "restorable" in str(exc)):
+        # Includes the all-candidates-failed-integrity walk ("no
+        # restorable checkpoint ..."): retrying cannot resurrect a
+        # fully corrupt archive, but classifying it buys bounded,
+        # logged retries that degrade to a loud halt instead of an
+        # unclassified crash (a chaos-campaign finding).
         return "ckpt_restore"
     return None
 
@@ -103,13 +119,24 @@ def _newest_restore_step(cfg: TrainConfig) -> int:
     return max(steps) if steps else 0
 
 
+def _fire_phase(injector, phase: str, cfg: TrainConfig, logger,
+                monitor) -> None:
+    """Fire phase-qualified fault injections (``kind@decide`` /
+    ``kind@adopt``) at their supervisor seam — the hooks that let the
+    chaos campaign strike *inside* a recovery."""
+    if injector is not None:
+        injector.phase_hook(phase, cfg.log_dir, logger=logger,
+                            cluster=monitor)
+
+
 def _adopt_decision(cfg: TrainConfig, monitor, decision, logger,
-                    attempt: int, lost=()):
+                    attempt: int, lost=(), injector=None):
     """Enter the decided world from any seat: adopt, resize the config,
     and log ``elastic_restart`` (shrink) or ``elastic_expand`` (grow)
     keyed on the decision's kind."""
     prev = set(monitor.live_set())
     monitor.adopt(decision)
+    _fire_phase(injector, "adopt", cfg, logger, monitor)
     cfg.parallel.num_processes = decision.world_size
     expand = getattr(decision, "kind", "shrink") == "expand"
     fields = dict(step=decision.restore_step,
@@ -131,7 +158,7 @@ def _adopt_decision(cfg: TrainConfig, monitor, decision, logger,
 
 
 def _coordinate_restart(cfg: TrainConfig, monitor, exc, logger,
-                        attempt: int):
+                        attempt: int, injector=None):
     """The coordinated elastic-restart protocol, from this process's
     seat. A decision at a newer epoch that already includes us (we
     observed it mid-step, or the chief committed while we were
@@ -140,33 +167,70 @@ def _coordinate_restart(cfg: TrainConfig, monitor, exc, logger,
     the lost peers (halting below ``min_hosts``), pick the restore step
     (newest checkpoint on disk — the same one every survivor's
     ``init_or_restore`` walk will find), commit the decision.
-    Non-chief: poll for it, fencing if excluded. All seats: adopt the
-    new world and log the matching JSONL record."""
+    Non-chief: poll for it, fencing if excluded — and when the poll
+    times out (the chief died between classifying and committing), the
+    decision pen falls to the next live seat: the presumed-dead chief
+    joins the lost set, and if that makes THIS process the lowest live
+    survivor it re-decides at a higher epoch instead of dying on the
+    timeout. All seats: adopt the new world and log the matching JSONL
+    record."""
+    lost = list(exc.process_ids)
     pending = monitor.coordinator.read()
     if pending is not None and pending.epoch > monitor.epoch \
             and monitor.process_id in pending.survivors:
         decision = pending
     elif monitor.is_chief:
-        decision = monitor.decide_restart(exc.process_ids,
+        decision = monitor.decide_restart(lost,
                                           _newest_restore_step(cfg))
+        _fire_phase(injector, "decide", cfg, logger, monitor)
     else:
         timeout = max(30.0, cfg.parallel.peer_dead_after_s * 6)
-        decision = monitor.await_restart(timeout)
+        try:
+            decision = monitor.await_restart(timeout)
+        except cluster_lib.PeerLostError:
+            # Coordinator loss mid-decision: the chief classified the
+            # failure but died before (or while) committing. Mark it
+            # dead and let chiefship fall to the lowest live survivor
+            # — if that is us, re-decide at a higher epoch; otherwise
+            # re-raise so the failure stays deterministic (the new
+            # chief's decision reaches us through the next attempt's
+            # seam check).
+            live = [p for p in monitor.live_set()
+                    if p not in monitor.watchdog.dead_peers
+                    and p not in lost]
+            dead_chief = min(live) if live else None
+            if dead_chief is None or dead_chief == monitor.process_id:
+                raise
+            monitor.watchdog.dead_peers.add(dead_chief)
+            lost = sorted(set(lost) | {dead_chief})
+            monitor.log("peer_lost", step=monitor._step,
+                        process_id=dead_chief,
+                        reason="coordinator_lost")
+            print(f"[supervisor] chief {dead_chief} never committed a "
+                  f"restart decision; presuming it lost")
+            if not monitor.is_chief:
+                raise
+            decision = monitor.decide_restart(lost,
+                                              _newest_restore_step(cfg))
+            _fire_phase(injector, "decide", cfg, logger, monitor)
     return _adopt_decision(cfg, monitor, decision, logger, attempt,
-                           lost=exc.process_ids)
+                           lost=lost, injector=injector)
 
 
 def _coordinate_expand(cfg: TrainConfig, monitor, exc, logger,
-                       attempt: int):
+                       attempt: int, injector=None):
     """Chief half of the scale-UP protocol (only the chief raises
     ``PeerRejoinError``): grow the world by the announced joiners,
     restore from the newest checkpoint, commit, adopt."""
     decision = monitor.decide_expand(exc.process_ids,
                                      _newest_restore_step(cfg))
-    return _adopt_decision(cfg, monitor, decision, logger, attempt)
+    _fire_phase(injector, "decide", cfg, logger, monitor)
+    return _adopt_decision(cfg, monitor, decision, logger, attempt,
+                           injector=injector)
 
 
-def _request_rejoin(cfg: TrainConfig, monitor, logger, attempt: int):
+def _request_rejoin(cfg: TrainConfig, monitor, logger, attempt: int,
+                    injector=None):
     """Returning-host half: announce with ``rejoin``-phase beats, wait
     (bounded) for an expand decision that includes us, adopt it.
     Returns the decision, or None when the rejoin was refused/timed out
@@ -183,7 +247,8 @@ def _request_rejoin(cfg: TrainConfig, monitor, logger, attempt: int):
     except cluster_lib.PeerLostError as e:
         print(f"[supervisor] rejoin not granted: {e}")
         return None
-    return _adopt_decision(cfg, monitor, decision, logger, attempt)
+    return _adopt_decision(cfg, monitor, decision, logger, attempt,
+                           injector=injector)
 
 
 def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
@@ -204,6 +269,12 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
     monitor = cluster_lib.ClusterMonitor.from_config(cfg.parallel,
                                                      logger=logger)
     attempt = 0
+    # Progress-based retry-budget reset (--retry_budget_window): the
+    # newest checkpoint step at the time the budget was last charged.
+    # A long run absorbing many well-spaced faults must not degrade to
+    # halt just because its LIFETIME fault count crossed a budget sized
+    # for fault bursts.
+    budget_anchor = 0
     try:
         while True:
             trainer = Trainer(cfg, task_index=task_index,
@@ -222,15 +293,18 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                 if monitor is not None and cfg.parallel.elastic_expand \
                         and attempt < cfg.recovery_retries:
                     attempt += 1
+                    if injector is not None:
+                        injector.recovering = True
                     decision = _request_rejoin(cfg, monitor, logger,
-                                               attempt)
+                                               attempt,
+                                               injector=injector)
                     if decision is not None:
                         continue
                 print(f"[supervisor] fenced: {e}")
                 return None
             except Exception as e:
                 fault = classify_failure(e)
-                if fault is None or attempt >= cfg.recovery_retries:
+                if fault is None:
                     raise
                 if fault == "nonfinite" and cfg.on_nonfinite != "rollback":
                     # halt stays a halt; an exhausted skip budget
@@ -239,13 +313,39 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                 if fault in ("peer_lost", "peer_rejoin") \
                         and monitor is None:
                     raise
+                # Progress-based budget reset: enough sustained
+                # progress (checkpoint steps) since the last charge
+                # refills the whole budget — spaced faults on a long
+                # run stay recoverable; a fault BURST still exhausts
+                # the budget and degrades to halt as before. Off by
+                # default (window 0 = the historical lifetime budget).
+                progress = _newest_restore_step(cfg)
+                if cfg.retry_budget_window > 0 and attempt > 0 \
+                        and progress - budget_anchor \
+                        >= cfg.retry_budget_window:
+                    logger.log("recovery", step=progress, fault=fault,
+                               action="budget_reset", attempt=attempt)
+                    print(f"[supervisor] {progress - budget_anchor} "
+                          f"steps of progress since the last retry "
+                          f"(>= retry_budget_window="
+                          f"{cfg.retry_budget_window}): retry budget "
+                          f"reset")
+                    attempt = 0
+                if attempt >= cfg.recovery_retries:
+                    raise
                 attempt += 1
+                budget_anchor = progress
+                if injector is not None:
+                    # Arm the recovery-phase injections (@restore fires
+                    # at the next attempt's checkpoint-restore seam).
+                    injector.recovering = True
                 if fault == "peer_rejoin":
                     # Chief seat of the scale-UP: grow the world by the
                     # announced joiners and re-enter restore at the
                     # larger size.
                     decision = _coordinate_expand(cfg, monitor, e,
-                                                  logger, attempt)
+                                                  logger, attempt,
+                                                  injector=injector)
                     restore_step = decision.restore_step
                 elif fault == "peer_lost":
                     # May re-raise PeerLostError (below min_hosts —
@@ -253,13 +353,15 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                     # decision excluded it while it was awaiting).
                     try:
                         decision = _coordinate_restart(cfg, monitor, e,
-                                                       logger, attempt)
+                                                       logger, attempt,
+                                                       injector=injector)
                     except cluster_lib.EvictedError as ev:
                         # Excluded while awaiting the decision: same
                         # fence-or-rejoin choice as the in-loop fence.
                         if cfg.parallel.elastic_expand:
                             decision = _request_rejoin(cfg, monitor,
-                                                       logger, attempt)
+                                                       logger, attempt,
+                                                       injector=injector)
                             if decision is not None:
                                 continue
                         print(f"[supervisor] fenced: {ev}")

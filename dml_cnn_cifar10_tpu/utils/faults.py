@@ -50,6 +50,38 @@ Cluster-resilience kinds (need a :class:`~parallel.cluster.ClusterMonitor`
   through the real chief-side rejoin scan (``--elastic_expand``). A
   drill where nobody ever returns fails loudly after a bounded wait.
 
+Phase-qualified triggers (``kind@phase``) fire inside the RECOVERY
+paths instead of at a training step — exactly the seams a fault that
+strikes *during* recovery hits:
+
+- ``@restore`` — at the checkpoint-restore seam of a recovery attempt
+  (``Trainer.init_or_restore``; the run-start restore of a fresh,
+  unfailed run does not count). ``ckpt_corrupt@restore`` corrupts the
+  newest checkpoint at the exact moment the restore walk starts.
+- ``@decide`` — on the chief, immediately AFTER it commits a restart/
+  expand decision and before it restores. ``host_lost@decide`` is the
+  chief-killed-mid-decision drill: survivors must finish recovery via
+  the next chief re-deciding at a higher epoch.
+- ``@adopt`` — on any seat, immediately after it adopts a coordinated
+  restart decision (before re-entering restore).
+
+Phase triggers need the run supervisor (``--supervise``) — the seams
+live in ``train/supervisor.py``. A schedule can also name several
+faults at one trigger (``nan@15,ckpt_corrupt@15``): compound faults
+fire in spec order at the same seam.
+
+- ``decision_corrupt`` — corrupt the cluster's restart-decision file
+  (``restart_decision.json``): overwrite it with a decodable but bogus
+  decision and a MISMATCHED integrity sidecar — what bit rot or a
+  half-synced shared filesystem serves to survivors polling for the
+  chief's verdict. The hardened ``RestartCoordinator.read`` must
+  classify it (``decision_corrupt`` telemetry, read as absent), never
+  adopt it. Needs a :class:`ClusterMonitor`.
+
+:class:`FaultSchedule` is the seeded sampler over this vocabulary the
+chaos campaign driver (``tools/chaos.py``) uses: the same seed always
+yields the same compound-fault schedule.
+
 Every injection logs a ``fault`` JSONL record (``injected: true``) so
 recovery tooling can pair injections with the ``recovery`` records they
 provoke (``docs/RESILIENCE.md``).
@@ -59,13 +91,26 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import random
 import signal
 import time
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 FAULT_KINDS = ("nan", "ckpt_corrupt", "sigterm", "data_stall",
                "heartbeat_stall", "host_lost", "collective_hang",
-               "host_return")
+               "host_return", "decision_corrupt")
+
+#: Recovery-path seams a fault may be phase-qualified to
+#: (``kind@phase``). The seams are supervisor-owned: ``restore`` fires
+#: at the next recovery attempt's checkpoint restore, ``decide`` on the
+#: chief right after it commits a coordinated-restart decision,
+#: ``adopt`` right after any seat adopts one.
+FAULT_PHASES = ("restore", "adopt", "decide")
+
+#: Kinds that make sense at a phase seam (no train state to poison
+#: there, and a blocking kind would deadlock the recovery itself).
+PHASE_FAULT_KINDS = ("ckpt_corrupt", "sigterm", "data_stall",
+                     "host_lost", "heartbeat_stall", "decision_corrupt")
 
 #: Bounded wait for a ``host_return`` drill's returning host: long
 #: enough for a cold process start (imports + restore + compile), short
@@ -90,41 +135,72 @@ class DataStallError(InjectedFault):
 @dataclasses.dataclass
 class FaultEvent:
     kind: str
-    step: int
+    step: Optional[int] = None
     fired: bool = False
+    phase: Optional[str] = None
+
+    @property
+    def trigger(self) -> str:
+        """The ``@``-suffix this event was parsed from."""
+        return self.phase if self.phase is not None else str(self.step)
 
 
 def parse_fault_spec(spec: str) -> List[FaultEvent]:
-    """``"kind@step,kind@step,..."`` → ordered fault events.
+    """``"kind@trigger,kind@trigger,..."`` → ordered fault events.
 
-    Steps are global training steps; duplicate kinds are allowed (e.g.
-    ``nan@100,nan@200`` re-poisons after a recovery). Unknown kinds and
-    malformed entries fail loudly at parse time — a typo'd fault plan
-    that silently injects nothing would void the test it was written
-    for.
+    A trigger is a global training step (``nan@120``) or a recovery
+    phase from :data:`FAULT_PHASES` (``ckpt_corrupt@restore``).
+    Duplicate kinds are allowed (e.g. ``nan@100,nan@200`` re-poisons
+    after a recovery), and several faults may share one trigger — a
+    compound fault firing in spec order at the same seam. Unknown
+    kinds, malformed entries, and phase triggers on kinds outside
+    :data:`PHASE_FAULT_KINDS` fail loudly at parse time — a typo'd
+    fault plan that silently injects nothing would void the test it was
+    written for.
     """
     events = []
     for entry in spec.split(","):
         entry = entry.strip()
         if not entry:
             continue
-        kind, sep, step_s = entry.partition("@")
+        kind, sep, trigger = entry.partition("@")
         kind = kind.strip()
         if not sep or kind not in FAULT_KINDS:
             raise ValueError(
-                f"bad fault spec entry {entry!r}: want kind@step with "
-                f"kind in {FAULT_KINDS}")
+                f"bad fault spec entry {entry!r}: want kind@trigger "
+                f"with kind in {FAULT_KINDS}")
+        trigger = trigger.strip()
+        if trigger in FAULT_PHASES:
+            if kind not in PHASE_FAULT_KINDS:
+                raise ValueError(
+                    f"bad fault spec entry {entry!r}: kind {kind!r} "
+                    f"cannot be phase-qualified (allowed: "
+                    f"{PHASE_FAULT_KINDS})")
+            events.append(FaultEvent(kind, phase=trigger))
+            continue
         try:
-            step = int(step_s)
+            step = int(trigger)
         except ValueError:
             raise ValueError(
-                f"bad fault spec entry {entry!r}: step {step_s!r} is "
-                f"not an integer") from None
+                f"bad fault spec entry {entry!r}: trigger {trigger!r} "
+                f"is neither an integer step nor a phase in "
+                f"{FAULT_PHASES}") from None
         if step < 0:
             raise ValueError(f"bad fault spec entry {entry!r}: "
                              f"negative step")
         events.append(FaultEvent(kind, step))
-    return sorted(events, key=lambda e: (e.step, e.kind))
+    # Step events in step order first; phase events after them in a
+    # stable (phase, kind) order — they have no step to slot into.
+    return sorted(events, key=lambda e: (
+        e.step is None, e.step if e.step is not None else 0,
+        e.phase or "", e.kind))
+
+
+def format_fault_spec(events: Sequence[FaultEvent]) -> str:
+    """The ``--fault_spec`` string for ``events`` — the inverse of
+    :func:`parse_fault_spec` (chaos shrinking emits reproducers with
+    it)."""
+    return ",".join(f"{e.kind}@{e.trigger}" for e in events)
 
 
 def poison_state(state):
@@ -171,6 +247,119 @@ def corrupt_latest_checkpoint(log_dir: str) -> Optional[str]:
     return path
 
 
+def corrupt_decision_file(cluster) -> str:
+    """Corrupt the cluster's restart-decision file the *nasty* way: a
+    decodable but bogus decision (absurd epoch, empty survivor set —
+    adopting it would fence every live host) paired with a MISMATCHED
+    integrity sidecar. A plain truncation would be caught by the JSON
+    parse alone; this shape is only caught by the sidecar check, which
+    is exactly the hardening the chaos campaign exists to regress-test
+    (a reverted check adopts the bogus decision and the run visibly
+    breaks)."""
+    import json
+
+    coord = cluster.coordinator
+    bogus = {"epoch": cluster.epoch + 997, "world_size": 1,
+             "restore_step": 0, "survivors": [], "kind": "shrink"}
+    with open(coord.path, "w") as f:
+        json.dump(bogus, f)
+    with open(coord.sidecar_path, "w") as f:
+        json.dump({"algo": "sha256", "digest": "0" * 64}, f)
+    return coord.path
+
+
+#: Default seeded-sampler vocabulary: every (kind, trigger) the chaos
+#: campaign may draw for a SUPERVISED single-process run — each entry
+#: is recoverable to run completion (sigterm/host_lost on the sole
+#: process end the run early by design, so they are cluster-scenario
+#: backbone faults, not sampled ones).
+CHAOS_VOCABULARY = (
+    "nan@step", "ckpt_corrupt@step", "data_stall@step",
+    "decision_corrupt@step", "ckpt_corrupt@restore",
+    "data_stall@restore", "decision_corrupt@restore",
+)
+
+#: Extra vocabulary for the 2-process cluster scenario's SURVIVOR seat
+#: (the dead peer carries the backbone ``host_lost``): recovery-phase
+#: compound faults on the seat that must keep the run alive.
+CHAOS_CLUSTER_VOCABULARY = CHAOS_VOCABULARY + (
+    "decision_corrupt@decide", "heartbeat_stall@adopt",
+)
+
+#: Vocabulary for the 2→1→2 elastic-expand scenario's surviving chief.
+#: Two families are deliberately absent: ``heartbeat_stall@adopt`` (a
+#: chief going dark right before re-admitting a joiner starts an
+#: evict/rejoin ping-pong with unbounded wall-clock — a liveness
+#: property the deadline invariant would punish, not a recovery
+#: property this scenario fuzzes), and ``decision_corrupt`` (the
+#: drill's harness-respawned seat learns of its eviction FROM the
+#: decision file; corrupting it leaves that seat beating in ``train``
+#: phase forever and the ``host_return`` hold times out by
+#: construction — decision-file fuzzing is the train/cluster
+#: scenarios' job).
+CHAOS_EXPAND_VOCABULARY = (
+    "nan@step", "ckpt_corrupt@step", "data_stall@step",
+    "ckpt_corrupt@restore", "data_stall@restore",
+)
+
+
+@dataclasses.dataclass
+class FaultSchedule:
+    """A seeded, reproducible compound-fault schedule.
+
+    ``generate(seed, budget)`` draws ``budget`` faults from a
+    vocabulary of ``kind@step`` / ``kind@phase`` templates with a
+    :class:`random.Random` seeded stream — same seed, same schedule,
+    forever. The chaos campaign (``tools/chaos.py``) runs many of these
+    through the CPU sims and shrinks failing ones to minimal
+    reproducers.
+    """
+
+    seed: int
+    events: List[FaultEvent]
+
+    @property
+    def spec(self) -> str:
+        return format_fault_spec(self.events)
+
+    @classmethod
+    def generate(cls, seed: int, budget: int,
+                 vocabulary: Sequence[str] = CHAOS_VOCABULARY,
+                 min_step: int = 1, max_step: int = 35,
+                 ckpt_every: int = 10) -> "FaultSchedule":
+        """Sample ``budget`` faults. Step templates get a uniform step
+        in ``[min_step, max_step]`` (several faults may land on one
+        step — compound faults are the point); phase templates are
+        deduplicated (a phase event is one-shot, a duplicate could
+        never fire). ``ckpt_corrupt`` steps are drawn only after the
+        SECOND checkpoint can exist (``2 * ckpt_every + 1``): corrupting
+        the run's only checkpoint right before a recovery needs it is
+        unrecoverable by construction — the sampler fuzzes the recovery
+        state space, and "your sole backup rotted" has no recovery to
+        fuzz (the classified halt covers it)."""
+        if budget < 1:
+            raise ValueError(f"budget must be >= 1, got {budget}")
+        rng = random.Random(seed)
+        events: List[FaultEvent] = []
+        seen_phase = set()
+        for _ in range(budget):
+            template = rng.choice(list(vocabulary))
+            kind, _, trigger = template.partition("@")
+            if trigger == "step":
+                lo = max(min_step, 2 * ckpt_every + 1) \
+                    if kind == "ckpt_corrupt" else min_step
+                events.append(
+                    FaultEvent(kind, rng.randint(lo, max(lo, max_step))))
+            else:
+                if (kind, trigger) in seen_phase:
+                    continue
+                seen_phase.add((kind, trigger))
+                events.append(FaultEvent(kind, phase=trigger))
+        # Round-trip through the parser: validates every sampled entry
+        # and applies the canonical ordering.
+        return cls(seed, parse_fault_spec(format_fault_spec(events)))
+
+
 class FaultInjector:
     """One-shot, step-keyed fault firing at the training loop's host
     seam (``Trainer.fit`` calls :meth:`step_hook` once per dispatch).
@@ -179,6 +368,13 @@ class FaultInjector:
 
     def __init__(self, events: List[FaultEvent]):
         self.events = events
+        # Set by the supervisor once a recoverable failure is being
+        # handled: phase-qualified ``@restore`` events only fire at
+        # RECOVERY restores, not the run-start restore of a fresh run.
+        self.recovering = False
+        # Last step seen by step_hook — phase events fire outside the
+        # step loop and borrow it for their telemetry.
+        self._last_step = 0
 
     @classmethod
     def from_spec(cls, spec: Optional[str]) -> Optional["FaultInjector"]:
@@ -204,8 +400,9 @@ class FaultInjector:
         Trainer threads through (``cluster``) and fail loudly without
         one — a cluster drill that silently no-ops would void its
         test."""
+        self._last_step = step
         for ev in self.events:
-            if ev.fired or step < ev.step:
+            if ev.phase is not None or ev.fired or step < ev.step:
                 continue
             if ev.kind == "nan":
                 ev.fired = True
@@ -254,6 +451,14 @@ class FaultInjector:
                 # (os._exit) ends this loop.
                 while True:
                     time.sleep(0.05)
+            elif ev.kind == "decision_corrupt":
+                if cluster is None:
+                    raise InjectedFault(
+                        "decision_corrupt injection needs --cluster_dir "
+                        "(no restart-decision file to corrupt)")
+                ev.fired = True
+                path = corrupt_decision_file(cluster)
+                self._log(logger, step, ev.kind, path=path)
             elif ev.kind == "host_return":
                 if cluster is None:
                     raise InjectedFault(
@@ -285,3 +490,71 @@ class FaultInjector:
                             f"--elastic_expand?")
                     time.sleep(0.05)
         return state
+
+    def phase_hook(self, phase: str, log_dir: str, logger=None,
+                   cluster=None) -> None:
+        """Fire every unfired event qualified to ``phase`` — the
+        recovery-path twin of :meth:`step_hook`, called by the
+        supervisor at the ``decide``/``adopt`` seams and by
+        ``Trainer.init_or_restore`` at the ``restore`` seam. ``restore``
+        events are additionally gated on :attr:`recovering` (every fit
+        attempt restores; only recovery restores count as the seam).
+        The fault record borrows the last step the step hook saw and
+        carries the phase so injections stay pairable with the recovery
+        they strike."""
+        if phase not in FAULT_PHASES:
+            raise ValueError(f"unknown fault phase {phase!r} "
+                             f"(want one of {FAULT_PHASES})")
+        if phase == "restore" and not self.recovering:
+            return
+        step = self._last_step
+        for ev in self.events:
+            if ev.fired or ev.phase != phase:
+                continue
+            if ev.kind == "ckpt_corrupt":
+                # The recovery-phase drill exercises the FALLBACK walk:
+                # it fires only when an older candidate exists to fall
+                # back to. Corrupting the sole copy makes the run
+                # unrecoverable by construction — that is a halt test
+                # (covered by the classified all-candidates-failed
+                # error), not a recovery drill; stay pending instead.
+                from dml_cnn_cifar10_tpu.ckpt import (
+                    checkpoint as ckpt_lib)
+                if len(ckpt_lib.all_checkpoint_steps(log_dir)) < 2:
+                    continue
+                path = corrupt_latest_checkpoint(log_dir)
+                if path is None:
+                    continue
+                ev.fired = True
+                self._log(logger, step, ev.kind, phase=phase, path=path)
+            elif ev.kind == "sigterm":
+                ev.fired = True
+                self._log(logger, step, ev.kind, phase=phase)
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif ev.kind == "data_stall":
+                ev.fired = True
+                self._log(logger, step, ev.kind, phase=phase)
+                raise DataStallError(
+                    f"injected data stall at recovery phase {phase!r}")
+            elif ev.kind == "host_lost":
+                ev.fired = True
+                self._log(logger, step, ev.kind, phase=phase)
+                if logger is not None and hasattr(logger, "flush"):
+                    logger.flush()
+                os._exit(EXIT_HOST_LOST)
+            elif ev.kind == "heartbeat_stall":
+                if cluster is None:
+                    raise InjectedFault(
+                        f"heartbeat_stall@{phase} injection needs "
+                        f"--cluster_dir (no ClusterMonitor to stall)")
+                ev.fired = True
+                self._log(logger, step, ev.kind, phase=phase)
+                cluster.stall_heartbeats()
+            elif ev.kind == "decision_corrupt":
+                if cluster is None:
+                    raise InjectedFault(
+                        f"decision_corrupt@{phase} injection needs "
+                        f"--cluster_dir (no decision file to corrupt)")
+                ev.fired = True
+                path = corrupt_decision_file(cluster)
+                self._log(logger, step, ev.kind, phase=phase, path=path)

@@ -1,0 +1,440 @@
+"""Chaos campaign engine (ISSUE 10): phase-qualified fault triggers,
+the seeded compound-fault sampler, decision-file integrity sidecars,
+the progress-based retry-budget reset, and the campaign driver
+(tools/chaos.py) — including the tier-1 acceptance sims: a fixed-seed
+smoke campaign where every schedule's recovery converges bit-identical
+to the fault-free reference, a planted regression (decision-sidecar
+revert) that the campaign must catch and shrink to its
+``decision_corrupt`` core, phase triggers firing exactly once at their
+recovery seams in a 2-process sim, and a chief killed between its
+``decide_restart`` commit and its own restore with the survivor
+completing recovery via next-chief re-decision."""
+
+import json
+import os
+
+import pytest
+
+from dml_cnn_cifar10_tpu.parallel import cluster as cluster_lib
+from dml_cnn_cifar10_tpu.utils import faults as faults_lib
+
+from tests.test_cluster import FakeLogger, _monitor
+
+from tools import chaos as chaos_lib
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# fault-spec grammar: phase triggers + compound same-step faults
+# ---------------------------------------------------------------------------
+
+def test_phase_qualified_spec_parses_and_round_trips():
+    events = faults_lib.parse_fault_spec(
+        "decision_corrupt@decide,ckpt_corrupt@restore,nan@15,"
+        "ckpt_corrupt@15")
+    # Step events first in (step, kind) order, then phase events in a
+    # stable (phase, kind) order.
+    assert [(e.kind, e.trigger) for e in events] == [
+        ("ckpt_corrupt", "15"), ("nan", "15"),
+        ("decision_corrupt", "decide"), ("ckpt_corrupt", "restore")]
+    assert faults_lib.format_fault_spec(events) == \
+        "ckpt_corrupt@15,nan@15,decision_corrupt@decide," \
+        "ckpt_corrupt@restore"
+    # Kinds that need a training step cannot be phase-qualified.
+    for bad in ("nan@restore", "collective_hang@adopt",
+                "host_return@decide", "nan@bogusphase"):
+        with pytest.raises(ValueError):
+            faults_lib.parse_fault_spec(bad)
+
+
+def test_compound_faults_fire_at_one_step():
+    """Several faults naming one step fire together at that seam, in
+    spec order, each exactly once."""
+    log = FakeLogger()
+    inj = faults_lib.FaultInjector.from_spec(
+        "ckpt_corrupt@10,data_stall@10")
+    with pytest.raises(faults_lib.DataStallError):
+        # ckpt_corrupt has nothing to corrupt (stays pending);
+        # data_stall raises after marking itself fired.
+        inj.step_hook(10, None, log_dir="/nonexistent", logger=log)
+    assert [e.kind for e in inj.pending()] == ["ckpt_corrupt"]
+    assert [r["fault"] for r in log.records] == ["data_stall"]
+
+
+# ---------------------------------------------------------------------------
+# FaultSchedule: the seeded sampler
+# ---------------------------------------------------------------------------
+
+def test_fault_schedule_generate_is_seeded_and_bounded():
+    a = faults_lib.FaultSchedule.generate(42, 4)
+    b = faults_lib.FaultSchedule.generate(42, 4)
+    assert a.spec == b.spec                  # same seed, same schedule
+    assert 1 <= len(a.events) <= 4
+    vocab_kinds = {t.partition("@")[0]
+                   for t in faults_lib.CHAOS_VOCABULARY}
+    for ev in a.events:
+        assert ev.kind in vocab_kinds
+        if ev.step is not None:
+            assert 1 <= ev.step <= 35
+    # Different seeds explore different schedules (across a small pool
+    # at least one must differ — the sampler is not constant).
+    specs = {faults_lib.FaultSchedule.generate(s, 4).spec
+             for s in range(8)}
+    assert len(specs) > 1
+    with pytest.raises(ValueError):
+        faults_lib.FaultSchedule.generate(0, 0)
+
+
+# ---------------------------------------------------------------------------
+# decision-file integrity sidecar (parallel/cluster.py)
+# ---------------------------------------------------------------------------
+
+def test_decision_record_commits_payload_then_sidecar(tmp_path):
+    logged = []
+    c = cluster_lib.RestartCoordinator(
+        str(tmp_path), log_fn=lambda k, **f: logged.append((k, f)))
+    d = c.record(cluster_lib.RestartDecision(
+        epoch=1, world_size=1, restore_step=10, survivors=[0]))
+    assert os.path.exists(c.path) and os.path.exists(c.sidecar_path)
+    assert c.read() == d
+    assert logged == []
+    # Monotone epoch still enforced through the verified read.
+    with pytest.raises(ValueError, match="monotone"):
+        c.record(cluster_lib.RestartDecision(
+            epoch=1, world_size=1, restore_step=10, survivors=[0]))
+
+
+def test_decision_read_classifies_corruption_instead_of_raising(
+        tmp_path):
+    logged = []
+    c = cluster_lib.RestartCoordinator(
+        str(tmp_path), log_fn=lambda k, **f: logged.append((k, f)))
+    c.record(cluster_lib.RestartDecision(
+        epoch=1, world_size=1, restore_step=10, survivors=[0]))
+    # Tampered payload, stale sidecar: None + one decision_corrupt
+    # record — NOT an unclassified JSON error, NOT a trusted decode.
+    with open(c.path, "a") as f:
+        f.write("garbage")
+    assert c.read() is None
+    assert len(logged) == 1 and logged[0][0] == "decision_corrupt"
+    assert "mismatch" in logged[0][1]["error"]
+    # Rate-limited per payload digest: re-polling the same corpse adds
+    # no records (await_decision polls at 20 Hz).
+    assert c.read() is None
+    assert len(logged) == 1
+    # An undecodable payload (valid sidecar removed) classifies too.
+    os.remove(c.sidecar_path)
+    with open(c.path, "w") as f:
+        f.write("{not json")
+    assert c.read() is None
+    assert logged[-1][0] == "decision_corrupt"
+    assert "undecodable" in logged[-1][1]["error"]
+
+
+def test_decision_read_accepts_legacy_sidecarless_file(tmp_path):
+    """A pre-hardening decision file (payload, no sidecar) must still
+    decode — mid-upgrade clusters cannot deadlock on their own history."""
+    c = cluster_lib.RestartCoordinator(str(tmp_path))
+    with open(c.path, "w") as f:
+        json.dump({"epoch": 3, "world_size": 2, "restore_step": 20,
+                   "survivors": [0, 1]}, f)
+    d = c.read()
+    assert d is not None and d.epoch == 3 and d.kind == "shrink"
+
+
+def test_decision_corrupt_fault_is_ignored_by_hardened_monitor(
+        tmp_path):
+    """The injected corruption (bogus decision + mismatched sidecar)
+    must be read as absent by the seam check — training continues; the
+    only trace is the classified telemetry."""
+    log = FakeLogger()
+    mon = _monitor(tmp_path, 0, n=1, logger=log)
+    try:
+        inj = faults_lib.FaultInjector.from_spec("decision_corrupt@5")
+        inj.step_hook(5, None, log_dir=str(tmp_path), logger=log,
+                      cluster=mon)
+        assert inj.pending() == []
+        mon.check_evicted(6)                 # no raise, no adoption
+        assert mon.epoch == 0
+        kinds = log.kinds()
+        assert "fault" in kinds and "decision_corrupt" in kinds
+        # Without a monitor the drill fails loudly, like the other
+        # cluster kinds.
+        with pytest.raises(faults_lib.InjectedFault, match="cluster"):
+            faults_lib.FaultInjector.from_spec(
+                "decision_corrupt@1").step_hook(2, None, "/tmp")
+    finally:
+        mon.close()
+
+
+# ---------------------------------------------------------------------------
+# phase-hook mechanics (units; the sims below cover the seams in vivo)
+# ---------------------------------------------------------------------------
+
+def test_phase_hook_restore_is_gated_on_recovery(tmp_path):
+    log = FakeLogger()
+    inj = faults_lib.FaultInjector.from_spec("data_stall@restore")
+    # A fresh run's initial restore is NOT the seam.
+    inj.phase_hook("restore", str(tmp_path), logger=log)
+    assert len(inj.pending()) == 1 and log.records == []
+    # The supervisor arms recovery; now the seam fires (once).
+    inj.recovering = True
+    inj._last_step = 30
+    with pytest.raises(faults_lib.DataStallError):
+        inj.phase_hook("restore", str(tmp_path), logger=log)
+    assert inj.pending() == []
+    assert log.records[0]["fault"] == "data_stall"
+    assert log.records[0]["phase"] == "restore"
+    assert log.records[0]["step"] == 30
+    inj.phase_hook("restore", str(tmp_path), logger=log)  # one-shot
+    assert len(log.records) == 1
+    with pytest.raises(ValueError, match="phase"):
+        inj.phase_hook("bogus", str(tmp_path))
+
+
+def test_phase_hook_decide_and_adopt_fire_without_recovery_gate(
+        tmp_path):
+    """decide/adopt seams only exist inside recovery, so they fire
+    as soon as reached — no arming needed."""
+    log = FakeLogger()
+    mon = _monitor(tmp_path, 0, n=1, logger=log)
+    try:
+        inj = faults_lib.FaultInjector.from_spec(
+            "decision_corrupt@decide,heartbeat_stall@adopt")
+        inj.phase_hook("decide", str(tmp_path), logger=log, cluster=mon)
+        inj.phase_hook("adopt", str(tmp_path), logger=log, cluster=mon)
+        assert inj.pending() == []
+        fired = [(r["fault"], r["phase"]) for r in log.records
+                 if r["kind"] == "fault"]
+        assert fired == [("decision_corrupt", "decide"),
+                         ("heartbeat_stall", "adopt")]
+        assert mon._stalled
+    finally:
+        mon.close()
+
+
+# ---------------------------------------------------------------------------
+# supervisor retry budget: progress-based reset (--retry_budget_window)
+# ---------------------------------------------------------------------------
+
+def test_retry_budget_exhaustion_then_reset(data_cfg, tmp_path):
+    """Two well-spaced stalls against a budget of ONE: the lifetime
+    budget (window off) exhausts and re-raises; with
+    --retry_budget_window the checkpoint progress between them refills
+    the budget and the run completes."""
+    from dml_cnn_cifar10_tpu.train.supervisor import fit_supervised
+    from tests.conftest import tiny_train_cfg
+
+    def cfg_for(subdir, window):
+        cfg = tiny_train_cfg(data_cfg, str(tmp_path / subdir),
+                             total_steps=40)
+        cfg.checkpoint_every = 10
+        cfg.recovery_retries = 1
+        cfg.retry_budget_window = window
+        cfg.recovery_backoff_s = 0.01
+        cfg.fault_spec = "data_stall@5,data_stall@25"
+        cfg.metrics_jsonl = os.path.join(str(tmp_path), subdir + ".jsonl")
+        return cfg
+
+    with pytest.raises(faults_lib.DataStallError):
+        fit_supervised(cfg_for("exhaust", window=0))
+
+    result = fit_supervised(cfg_for("reset", window=10))
+    assert result.final_step == 40
+    recs = _read_jsonl(os.path.join(str(tmp_path), "reset.jsonl"))
+    resets = [r for r in recs if r["kind"] == "recovery"
+              and r["action"] == "budget_reset"]
+    assert len(resets) == 1
+    restarts = [r for r in recs if r["kind"] == "recovery"
+                and r["action"] == "restart"]
+    assert len(restarts) == 2            # both stalls recovered
+
+
+# ---------------------------------------------------------------------------
+# the campaign driver: shared harness + tier-1 acceptance smokes
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def chaos_workdir(tmp_path_factory):
+    return tmp_path_factory.mktemp("chaos")
+
+
+@pytest.fixture(scope="module")
+def chaos_refs(chaos_workdir):
+    """Per-scenario fault-free reference digests, computed once for the
+    whole module (the sampler/worker/dataset are deterministic, so
+    every harness below can share them)."""
+    harness = chaos_lib.ChaosHarness(str(chaos_workdir / "refs"))
+    return {"train": harness.reference_digest("train"),
+            "cluster": harness.reference_digest("cluster")}
+
+
+def test_chaos_smoke_campaign_fixed_seeds(chaos_workdir, chaos_refs):
+    """ISSUE-10 tier-1 wiring: a fixed-seed ≥5-schedule campaign over
+    the supervised-train sim passes every invariant — bit-identical
+    finals, schema-clean streams, fault/recovery pairing, deadlines —
+    and its own chaos/chaos_done stream lints + reports."""
+    jsonl = str(chaos_workdir / "campaign.jsonl")
+    summary = chaos_lib.run_campaign(
+        seeds=range(5), scenario="train",
+        workdir=str(chaos_workdir / "smoke"),
+        metrics_jsonl=jsonl, refs=chaos_refs)
+    assert summary["schedules"] == 5
+    assert summary["failed"] == 0, summary
+    # Across the fixed seeds the sampler exercised a compound mix, not
+    # one lucky kind.
+    assert len(summary["faults_by_kind"]) >= 2
+    assert sum(summary["faults_by_kind"].values()) >= 5
+    from tools import check_jsonl_schema, telemetry_report
+    assert check_jsonl_schema.check_file(jsonl) == []
+    out = telemetry_report.summarize(jsonl)
+    assert "chaos campaign" in out and "5 passed" in out
+
+
+def test_chaos_catches_planted_decision_sidecar_revert(chaos_workdir,
+                                                       chaos_refs):
+    """Regression drill (ISSUE-10 acceptance): revert the
+    RestartCoordinator sidecar check inside the workers and the
+    campaign must FAIL the schedule — the bogus corrupted decision gets
+    adopted and fences the run — and shrink it to a ≤2-fault reproducer
+    centred on decision_corrupt."""
+    summary = chaos_lib.run_campaign(
+        seeds=[0], scenario="train",
+        workdir=str(chaos_workdir / "planted"),
+        plant="no_decision_sidecar",
+        explicit_spec="data_stall@12,decision_corrupt@18",
+        refs=chaos_refs)
+    assert summary["failed"] == 1
+    rec = summary["results"][0]
+    assert not rec["ok"] and rec["invariant"].startswith("completed")
+    repro = faults_lib.parse_fault_spec(rec["reproducer"])
+    assert len(repro) <= 2
+    assert any(e.kind == "decision_corrupt" for e in repro)
+    # The SAME schedule passes with the hardening in place: the plant,
+    # not the schedule, is what failed.
+    clean = chaos_lib.run_campaign(
+        seeds=[0], scenario="train",
+        workdir=str(chaos_workdir / "unplanted"),
+        explicit_spec="data_stall@12,decision_corrupt@18",
+        refs=chaos_refs)
+    assert clean["failed"] == 0
+
+
+def test_phase_triggers_fire_once_each_in_cluster_sim(chaos_workdir,
+                                                      chaos_refs):
+    """ISSUE-10 satellite: @restore / @adopt / @decide each fire
+    exactly once at their seam in a 2-process sim (host_lost backbone
+    on the peer; the survivor carries the recovery-phase compound) and
+    the recovery still converges bit-identical to the fault-free
+    reference."""
+    harness = chaos_lib.ChaosHarness(
+        str(chaos_workdir / "phases"), refs=chaos_refs)
+    events = faults_lib.parse_fault_spec(
+        "ckpt_corrupt@restore,heartbeat_stall@adopt,"
+        "decision_corrupt@decide")
+    # Backbone death at 25: the survivor holds ckpt_10 AND ckpt_20 when
+    # recovery starts, so the @restore corruption has a fallback
+    # candidate to exercise (the phase drill stays pending without one).
+    r = harness.run_schedule(events, "cluster", tag="phases",
+                             backbone="host_lost@25")
+    assert r.ok, r.invariant
+    assert r.injected == {"ckpt_corrupt": 1, "heartbeat_stall": 1,
+                          "decision_corrupt": 1, "host_lost": 1}
+    stream = _read_jsonl(os.path.join(
+        harness.workdir, "run_001_phases", "logs_0", "metrics.jsonl"))
+    phased = [r for r in stream if r["kind"] == "fault"
+              and r.get("phase")]
+    assert sorted((r["fault"], r["phase"]) for r in phased) == [
+        ("ckpt_corrupt", "restore"), ("decision_corrupt", "decide"),
+        ("heartbeat_stall", "adopt")]
+    # The @restore corruption forced the restore walk to fall back.
+    assert any(r["kind"] == "ckpt_fallback" for r in stream)
+
+
+def test_chief_killed_between_decide_and_restore(chaos_workdir,
+                                                 chaos_refs):
+    """ISSUE-10 acceptance: the chief commits a shrink decision and is
+    killed before its own restore (`host_lost@decide`). The surviving
+    non-chief adopts the orphaned decision, finds the chief's corpse at
+    its next seam, inherits chiefship, re-decides at a HIGHER epoch,
+    and completes — final params bit-identical to the fault-free
+    reference."""
+    harness = chaos_lib.ChaosHarness(
+        str(chaos_workdir / "chiefloss"), refs=chaos_refs)
+    run_dir = str(chaos_workdir / "chiefloss" / "sim")
+    cluster = os.path.join(run_dir, "cluster")
+    logs = [os.path.join(run_dir, f"logs_{t}") for t in (0, 1)]
+    for d in logs:
+        os.makedirs(d)
+    # Three seats, two processes: seat 2 never starts (a host that
+    # failed to even boot — as dead as one that stopped), which is what
+    # forces the step-0 shrink decision both live seats agree on.
+    procs = [
+        harness._spawn([0, 3, harness.data_dir, logs[0], cluster,
+                        "host_lost@decide", 40], planted=False),
+        harness._spawn([1, 3, harness.data_dir, logs[1], cluster,
+                        "", 40], planted=False),
+    ]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    # The chief died abruptly at the decide seam...
+    assert procs[0].returncode == faults_lib.EXIT_HOST_LOST, outs[0]
+    # ...and the survivor completed anyway.
+    assert procs[1].returncode == 0, outs[1]
+    res = harness._read_result(outs[1])
+    assert not res["fenced"] and res["final_step"] == 40
+    assert res["digest"] == chaos_refs["train"]
+
+    chief = _read_jsonl(os.path.join(logs[0], "metrics.jsonl"))
+    died = [r for r in chief if r["kind"] == "fault"
+            and r["fault"] == "host_lost"]
+    assert died and died[0]["phase"] == "decide"
+
+    surv = _read_jsonl(os.path.join(logs[1], "metrics.jsonl"))
+    adopted = [r for r in surv if r["kind"] == "elastic_restart"]
+    # Epoch 1: the dead chief's orphaned decision (world 2, seats 0+1).
+    # Epoch 2: the survivor's own re-decision as the new chief
+    # (world 1) — strictly higher epoch, monotone file.
+    assert [(r["epoch"], r["world_size"]) for r in adopted] == [
+        (1, 2), (2, 1)]
+    lost = {(r["process_id"], r["reason"]) for r in surv
+            if r["kind"] == "peer_lost"}
+    # The killed chief is always classified by its stale heartbeats.
+    # (The never-booted seat 2 may instead surface as the adopted
+    # orphan decision, depending on which the survivor sees first.)
+    assert (0, "stale_heartbeat") in lost
+    from tools import check_jsonl_schema
+    for recs in (chief, surv):
+        assert check_jsonl_schema.check_lines(
+            json.dumps(r) for r in recs) == []
+    # The final decision on disk is the survivor's epoch-2 verdict and
+    # verifies through the sidecar walk.
+    d = cluster_lib.RestartCoordinator(cluster).read()
+    assert d is not None and d.epoch == 2 and d.survivors == [1]
+
+
+# ---------------------------------------------------------------------------
+# the full campaign (slow): 50 seeded schedules over both sims
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_50_schedule_campaign(tmp_path):
+    """ISSUE-10 acceptance: `tools/chaos.py --seeds 50` (mixed train +
+    cluster sims) passes every invariant."""
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "chaos.py"),
+         "--seeds", "50", "--scenario", "mixed",
+         "--workdir", str(tmp_path / "campaign"),
+         "--metrics_jsonl", str(tmp_path / "campaign.jsonl")],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=3600)
+    assert proc.returncode == 0, proc.stdout[-4000:]
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(
+        str(tmp_path / "campaign.jsonl")) == []

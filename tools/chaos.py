@@ -1,0 +1,699 @@
+#!/usr/bin/env python
+"""Chaos campaign engine: randomized compound-fault fuzzing with
+invariant checking over the CPU recovery sims.
+
+The deterministic fault hooks (``utils/faults.py``) exercise recovery
+paths one hand-picked fault at a time (``nan@15``, ``host_lost@15``).
+This driver turns them into *systematic* coverage of the recovery state
+space: ``FaultSchedule.generate(seed, budget)`` samples N seeded
+compound-fault schedules — several faults at one step, faults that
+strike inside recovery (``ckpt_corrupt@restore``,
+``decision_corrupt@decide``), corruption of the coordination state
+itself — and runs each through the existing CPU sims (1-process
+supervised train, the 2-process cluster shrink drill, and the 2→1→2
+elastic-expand drill), checking after every run that the resilience
+stack actually held:
+
+- **bit_identical** — a recoverable schedule must end with final params
+  bit-identical to the fault-free reference run (the exact-resume
+  contract, compounded);
+- **completed** — the run reaches the requested step, exit 0, never
+  fenced (the cluster scenario's backbone corpse excepted);
+- **schema** — every process's JSONL stream passes
+  ``tools/check_jsonl_schema.py``;
+- **deadline** — no process outlives the per-run deadline (a hang is a
+  failure, not a wait);
+- **fault_pairing** — every step-triggered scheduled fault appears as
+  an ``injected: true`` ``fault`` record, and every *detected* failure
+  has a matching ``recovery`` record.
+
+A failing schedule is automatically shrunk (greedy one-fault-removal
+delta debugging) to a minimal reproducer emitted as a ready-to-paste
+``--fault_spec``. The campaign's own telemetry rides a metrics JSONL
+(``chaos`` per schedule, ``chaos_done`` summary;
+``tools/telemetry_report.py`` renders the section).
+
+Usage::
+
+    python tools/chaos.py --seeds 50 --scenario mixed   # the slow campaign
+    python tools/chaos.py --seeds 5 --scenario train    # the tier-1 smoke
+    python tools/chaos.py --spec "nan@15,ckpt_corrupt@15"  # one schedule
+    python tools/chaos.py --seeds 8 --scenario cluster  # 2-process shrink sims
+    python tools/chaos.py --seeds 4 --scenario expand   # 2→1→2 scale-UP sims
+
+Exit 1 when any schedule violates an invariant. ``--plant
+no_decision_sidecar`` reverts the RestartCoordinator sidecar check
+inside the workers (a named regression drill: the campaign must catch
+it and shrink the failure to its ``decision_corrupt`` core).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dml_cnn_cifar10_tpu.utils import faults as faults_lib  # noqa: E402
+from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger  # noqa: E402
+from tools import check_jsonl_schema  # noqa: E402
+
+#: Fault kinds whose injection must provoke a recovery action (they
+#: raise / poison the run); the others (ckpt_corrupt, decision_corrupt,
+#: heartbeat_stall) corrupt state that may or may not be read later —
+#: surviving them unnoticed is legitimate.
+RECOVERY_PROVOKING = ("nan", "data_stall")
+
+#: Named planted regressions for drill/self-test purposes: each value
+#: is a Python snippet the worker preamble executes to REVERT one piece
+#: of hardening, so a campaign can prove it catches the regression.
+PLANTS = {
+    # Revert the RestartCoordinator sha256-sidecar check: read() trusts
+    # any decodable payload again, so a corrupted decision file (bogus
+    # epoch, empty survivor set) is ADOPTED instead of classified — the
+    # run fences itself and the bit-identity/completion invariants
+    # fail.
+    "no_decision_sidecar": """
+from dml_cnn_cifar10_tpu.parallel import cluster as _cl
+def _legacy_read(self):
+    import json as _json
+    try:
+        with open(self.path) as f:
+            return _cl.RestartDecision(**_json.load(f))
+    except (OSError, ValueError, TypeError):
+        return None
+_cl.RestartCoordinator.read = _legacy_read
+""",
+}
+
+# One worker script serves every scenario: task 0 is the seat under
+# fuzz (its --fault_spec is the schedule), task 1 (cluster scenario)
+# carries the backbone host_lost. Mirrors the tests' sim workers so
+# chaos findings reproduce 1:1 under pytest.
+WORKER = """
+import json, os, sys
+from dml_cnn_cifar10_tpu.utils.platform import force_cpu
+force_cpu()
+plant = os.environ.get("DML_CHAOS_PLANT")
+task, n, data_dir, log_dir, cluster_dir, fault_spec, total_steps = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6], int(sys.argv[7]))
+import hashlib
+import numpy as np
+import jax
+from dml_cnn_cifar10_tpu.config import TrainConfig, DataConfig
+from dml_cnn_cifar10_tpu.train.supervisor import fit_supervised
+if plant:
+    exec(os.environ["DML_CHAOS_PLANT_CODE"])
+
+cfg = TrainConfig(
+    batch_size=32, total_steps=total_steps, output_every=10,
+    eval_every=20, checkpoint_every=10, log_dir=log_dir,
+    metrics_jsonl=f"{log_dir}/metrics.jsonl",
+    data=DataConfig(dataset="synthetic", data_dir=data_dir,
+                    synthetic_train_records=256,
+                    synthetic_test_records=64,
+                    normalize="scale", use_native_loader=False),
+)
+cfg.model.logit_relu = False
+cfg.optim.learning_rate = 0.05
+cfg.keep_checkpoints = 20
+cfg.check_numerics = True
+cfg.on_nonfinite = "rollback"
+cfg.recovery_retries = 8        # a compound schedule may spend several
+cfg.recovery_backoff_s = 0.05
+cfg.recovery_backoff_max_s = 0.2
+cfg.fault_spec = fault_spec or None
+cfg.parallel.process_id = task
+cfg.parallel.num_processes = n
+if cluster_dir:
+    cfg.parallel.cluster_dir = cluster_dir
+    cfg.parallel.cluster_lockstep = n > 1
+    # Multi-seat sims may re-admit returning hosts (the expand
+    # scenario's whole point); the 1-process scenario keeps the fence
+    # so an adopted-bogus-decision regression fails FAST instead of
+    # waiting out a rejoin nobody will grant.
+    cfg.parallel.elastic_expand = n > 1
+    cfg.parallel.heartbeat_interval_s = 0.1
+    cfg.parallel.straggler_after_s = 0.4
+    cfg.parallel.peer_dead_after_s = 2.5
+    cfg.parallel.collective_timeout_s = 300.0
+
+res = fit_supervised(cfg, task_index=task)
+if res is None:
+    print("RESULT " + json.dumps({"task": task, "fenced": True}))
+    sys.exit(0)
+h = hashlib.sha256()
+for leaf in jax.tree.leaves(jax.device_get(res.state.params)):
+    h.update(np.ascontiguousarray(leaf).tobytes())
+print("RESULT " + json.dumps({
+    "task": task, "fenced": False, "final_step": res.final_step,
+    "digest": h.hexdigest()}))
+"""
+
+#: The cluster scenario's fixed backbone fault on task 1: dies abruptly
+#: at step 15 so every schedule exercises the shrink protocol under its
+#: sampled compound faults.
+CLUSTER_BACKBONE = "host_lost@15"
+
+#: The expand scenario's backbone choreography: task 1 dies at 15, the
+#: surviving chief holds step 18 until the harness-respawned host
+#: announces rejoin (the 2→1→2 drill from tests/test_elastic_expand.py)
+#: — every schedule then fuzzes faults across shrink AND expand.
+EXPAND_BACKBONE = "host_lost@15"
+EXPAND_HOLD = "host_return@18"
+
+#: Which reference digest oracles a scenario: all sims are numerically
+#: identical replicas of the 1-process run (per-seat data seeds
+#: coincide in the independent-world layout), so the expand scenario
+#: reuses the train oracle for BOTH seats.
+REF_ALIAS = {"expand": "train"}
+
+
+@dataclasses.dataclass
+class RunResult:
+    """One sim execution of one fault spec."""
+
+    ok: bool
+    invariant: Optional[str]       # first violated invariant, or None
+    secs: float
+    recovery_s: float = 0.0        # slowest fault→recovery latency seen
+    injected: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+class ChaosHarness:
+    """Owns the campaign workdir: dataset, worker script, reference
+    digests (one fault-free run per scenario, cached), and the spawn
+    plumbing shared by campaign runs and shrink probes."""
+
+    def __init__(self, workdir: str, total_steps: int = 40,
+                 deadline_s: float = 300.0, plant: Optional[str] = None,
+                 verbose: bool = True,
+                 refs: Optional[Dict[str, str]] = None):
+        self.workdir = workdir
+        self.total_steps = total_steps
+        self.deadline_s = deadline_s
+        if plant is not None and plant not in PLANTS:
+            raise ValueError(f"unknown plant {plant!r} "
+                             f"(have {sorted(PLANTS)})")
+        self.plant = plant
+        self.verbose = verbose
+        self._runs = 0
+        # Pre-seeded per-scenario reference digests: the synthetic
+        # dataset and worker config are fully deterministic, so a
+        # digest computed by one harness is valid for any other with
+        # the same total_steps (the tests share one across campaigns).
+        self._refs: Dict[str, str] = dict(refs or {})
+        os.makedirs(workdir, exist_ok=True)
+        self.script = os.path.join(workdir, "chaos_worker.py")
+        with open(self.script, "w") as f:
+            f.write(WORKER)
+        self.data_dir = os.path.join(workdir, "data")
+        from dml_cnn_cifar10_tpu.config import DataConfig
+        from dml_cnn_cifar10_tpu.data import ensure_dataset
+        ensure_dataset(DataConfig(
+            dataset="synthetic", data_dir=self.data_dir,
+            synthetic_train_records=256, synthetic_test_records=64,
+            use_native_loader=False))
+
+    # -- process plumbing -------------------------------------------------
+
+    def _spawn(self, args, planted: bool):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("DML_CHAOS_PLANT", None)
+        env.pop("DML_CHAOS_PLANT_CODE", None)
+        if planted and self.plant:
+            env["DML_CHAOS_PLANT"] = self.plant
+            env["DML_CHAOS_PLANT_CODE"] = PLANTS[self.plant]
+        return subprocess.Popen(
+            [sys.executable, self.script] + [str(a) for a in args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=REPO)
+
+    @staticmethod
+    def _read_result(out: str) -> Optional[dict]:
+        lines = [ln for ln in out.splitlines()
+                 if ln.startswith("RESULT ")]
+        if not lines:
+            return None
+        return json.loads(lines[-1][len("RESULT "):])
+
+    # -- reference digests ------------------------------------------------
+
+    def reference_digest(self, scenario: str) -> str:
+        """Digest of the fault-free run of ``scenario``'s fuzzed seat
+        (task 0), computed once per campaign. The exact-resume contract
+        makes this the universal oracle: a recovered run — whatever
+        checkpoint its restore walk actually landed on — must be
+        bit-identical to the uninterrupted run from scratch. The
+        reference never runs planted code: the plant is the regression
+        under test, the oracle must stay sound."""
+        scenario = REF_ALIAS.get(scenario, scenario)
+        if scenario in self._refs:
+            return self._refs[scenario]
+        run_dir = os.path.join(self.workdir, f"ref_{scenario}")
+        logs = os.path.join(run_dir, "logs_0")
+        os.makedirs(logs, exist_ok=True)
+        cluster = os.path.join(run_dir, "cluster")
+        proc = self._spawn([0, 1, self.data_dir, logs, cluster, "",
+                            self.total_steps], planted=False)
+        out = proc.communicate(timeout=self.deadline_s)[0]
+        if proc.returncode != 0:
+            raise RuntimeError(f"fault-free reference run failed:\n{out}")
+        res = self._read_result(out)
+        if res is None or res.get("fenced") \
+                or res["final_step"] != self.total_steps:
+            raise RuntimeError(f"fault-free reference run did not "
+                               f"complete:\n{out}")
+        self._refs[scenario] = res["digest"]
+        return res["digest"]
+
+    # -- invariant checking -----------------------------------------------
+
+    def _check_stream(self, path: str, events, planted: bool):
+        """Schema + fault-pairing invariants over one JSONL stream.
+        Returns (violation-or-None, injected-counts, slowest-recovery).
+        """
+        injected: Dict[str, int] = {}
+        slowest = 0.0
+        if not os.path.exists(path):
+            return "schema: metrics stream missing", injected, slowest
+        errs = check_jsonl_schema.check_file(path)
+        if errs:
+            return f"schema: {errs[0]}", injected, slowest
+        with open(path) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        inj = [r for r in recs if r.get("kind") == "fault"
+               and r.get("injected")]
+        for r in inj:
+            injected[r["fault"]] = injected.get(r["fault"], 0) + 1
+        detected = [r for r in recs if r.get("kind") == "fault"
+                    and not r.get("injected")]
+        recoveries = [r for r in recs if r.get("kind") == "recovery"]
+        # Every step-triggered scheduled fault must have fired (phase
+        # events legitimately stay pending when no recovery reaches
+        # their seam; deferred ckpt_corrupt needs a checkpoint first —
+        # by run end one exists, so it must have fired too).
+        want: Dict[str, int] = {}
+        for ev in events:
+            if ev.phase is None:
+                want[ev.kind] = want.get(ev.kind, 0) + 1
+        for kind, n in want.items():
+            if injected.get(kind, 0) < n:
+                return (f"fault_pairing: scheduled {kind} x{n} but only "
+                        f"{injected.get(kind, 0)} injected fault "
+                        f"record(s)"), injected, slowest
+        # Every detected failure must be answered by a recovery record,
+        # and every recovery-provoking injection must lead to one.
+        for r in detected:
+            after = [v for v in recoveries if v["t"] >= r["t"]]
+            if not after:
+                return (f"fault_pairing: detected {r.get('fault')} at "
+                        f"t={r.get('t')} has no recovery record"), \
+                    injected, slowest
+            slowest = max(slowest, after[0]["t"] - r["t"])
+        for r in inj:
+            if r["fault"] not in RECOVERY_PROVOKING:
+                continue
+            after = [v for v in recoveries if v["t"] >= r["t"]]
+            if not after:
+                return (f"fault_pairing: injected {r['fault']} has no "
+                        f"matching recovery record"), injected, slowest
+            slowest = max(slowest, after[0]["t"] - r["t"])
+        return None, injected, slowest
+
+    # -- one schedule -----------------------------------------------------
+
+    def run_schedule(self, events: Sequence[faults_lib.FaultEvent],
+                     scenario: str, tag: str,
+                     backbone: str = CLUSTER_BACKBONE) -> RunResult:
+        """Run one fault schedule through ``scenario``'s sim and check
+        every invariant. ``tag`` names the run's directory;
+        ``backbone`` is the cluster scenario's fixed fault on the peer
+        seat."""
+        self._runs += 1
+        spec = faults_lib.format_fault_spec(events)
+        run_dir = os.path.join(self.workdir,
+                               f"run_{self._runs:03d}_{tag}")
+        cluster = os.path.join(run_dir, "cluster")
+        t0 = time.time()
+        ref = self.reference_digest(scenario)
+        if scenario == "expand":
+            return self._run_expand(events, spec, run_dir, cluster,
+                                    ref, t0)
+
+        n = 2 if scenario == "cluster" else 1
+        logs = [os.path.join(run_dir, f"logs_{t}") for t in range(n)]
+        for d in logs:
+            os.makedirs(d, exist_ok=True)
+        specs = [spec] if n == 1 else [spec, backbone]
+        procs = [self._spawn([t, n, self.data_dir, logs[t], cluster,
+                              specs[t], self.total_steps], planted=True)
+                 for t in range(n)]
+        outs, timed_out = [], False
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=self.deadline_s)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+                timed_out = True
+        secs = time.time() - t0
+
+        def fail(inv):
+            return RunResult(False, inv, secs)
+
+        if timed_out:
+            return fail(f"deadline: a process outlived "
+                        f"{self.deadline_s:.0f}s")
+        # The cluster backbone corpse is EXPECTED to die with the
+        # abrupt-death code; everyone else must exit 0.
+        if scenario == "cluster" \
+                and procs[1].returncode != faults_lib.EXIT_HOST_LOST:
+            return fail(f"completed: backbone host exited "
+                        f"{procs[1].returncode}, wanted "
+                        f"{faults_lib.EXIT_HOST_LOST}")
+        if procs[0].returncode != 0:
+            tail = outs[0].strip().splitlines()[-1][:200] \
+                if outs[0].strip() else ""
+            return fail(f"completed: exit {procs[0].returncode} "
+                        f"({tail})")
+        res = self._read_result(outs[0])
+        if res is None:
+            return fail("completed: no RESULT line")
+        if res.get("fenced"):
+            return fail("completed: run fenced itself")
+        if res["final_step"] != self.total_steps:
+            return fail(f"completed: final step {res['final_step']} != "
+                        f"{self.total_steps}")
+        if res["digest"] != ref:
+            return fail("bit_identical: final params differ from the "
+                        "fault-free reference")
+        injected: Dict[str, int] = {}
+        slowest = 0.0
+        for i, d in enumerate(logs):
+            # The schedule's events only apply to stream 0; the
+            # backbone stream is checked for schema + detected-fault
+            # pairing only.
+            evs = events if i == 0 else \
+                faults_lib.parse_fault_spec(backbone)
+            inv, inj, slow = self._check_stream(
+                os.path.join(d, "metrics.jsonl"), evs, planted=True)
+            if inv is not None:
+                return fail(inv)
+            for k, v in inj.items():
+                injected[k] = injected.get(k, 0) + v
+            slowest = max(slowest, slow)
+        return RunResult(True, None, secs, recovery_s=slowest,
+                         injected=injected)
+
+    def _run_expand(self, events, spec: str, run_dir: str,
+                    cluster: str, ref: str, t0: float) -> RunResult:
+        """The 2→1→2 elastic scale-UP sim under a fuzz schedule: task 1
+        dies at 15 (backbone), the surviving chief runs the schedule
+        AND holds step 18 until the harness — playing the scheduler
+        seat — respawns task 1 once the shrink decision is adopted;
+        the chief expands the world back and BOTH seats must finish
+        bit-identical to the reference."""
+        logs = [os.path.join(run_dir, f"logs_{t}") for t in (0, 1)]
+        for d in logs:
+            os.makedirs(d, exist_ok=True)
+        hold = faults_lib.parse_fault_spec(EXPAND_HOLD)
+        spec0 = faults_lib.format_fault_spec(list(events) + hold)
+        deadline = time.time() + self.deadline_s
+        procs = [self._spawn([0, 2, self.data_dir, logs[0], cluster,
+                              spec0, self.total_steps], planted=True),
+                 self._spawn([1, 2, self.data_dir, logs[1], cluster,
+                              EXPAND_BACKBONE, self.total_steps],
+                             planted=True)]
+        rejoined = None
+
+        def fail(inv):
+            for p in procs + ([rejoined] if rejoined else []):
+                if p.poll() is None:
+                    p.kill()
+            return RunResult(False, inv, time.time() - t0)
+
+        try:
+            procs[1].wait(timeout=self.deadline_s)
+        except subprocess.TimeoutExpired:
+            return fail(f"deadline: backbone host outlived "
+                        f"{self.deadline_s:.0f}s")
+        if procs[1].returncode != faults_lib.EXIT_HOST_LOST:
+            return fail(f"completed: backbone host exited "
+                        f"{procs[1].returncode}, wanted "
+                        f"{faults_lib.EXIT_HOST_LOST}")
+        # Respawn gate: the survivor must have ADOPTED the shrink
+        # before the host returns, else there is no expand to drill.
+        # Gated on the stream (not the decision file — a
+        # decision_corrupt schedule legitimately corrupts that).
+        stream0 = os.path.join(logs[0], "metrics.jsonl")
+        while True:
+            shrunk = False
+            if os.path.exists(stream0):
+                with open(stream0, errors="replace") as f:
+                    shrunk = '"elastic_restart"' in f.read()
+            if shrunk:
+                break
+            if time.time() > deadline:
+                return fail("deadline: survivor never adopted the "
+                            "shrink decision")
+            if procs[0].poll() is not None:
+                out = procs[0].communicate()[0]
+                tail = out.strip().splitlines()[-1][:200] \
+                    if out.strip() else ""
+                return fail(f"completed: survivor died before the "
+                            f"shrink (exit {procs[0].returncode}: "
+                            f"{tail})")
+            time.sleep(0.1)
+        rejoined = self._spawn([1, 2, self.data_dir, logs[1], cluster,
+                                "", self.total_steps], planted=True)
+        outs = []
+        for p in (procs[0], rejoined):
+            try:
+                outs.append(p.communicate(timeout=self.deadline_s)[0])
+            except subprocess.TimeoutExpired:
+                return fail(f"deadline: a process outlived "
+                            f"{self.deadline_s:.0f}s")
+        secs = time.time() - t0
+        for seat, (p, out) in enumerate(zip((procs[0], rejoined),
+                                            outs)):
+            if p.returncode != 0:
+                tail = out.strip().splitlines()[-1][:200] \
+                    if out.strip() else ""
+                return RunResult(
+                    False, f"completed: seat {seat} exit "
+                           f"{p.returncode} ({tail})", secs)
+            res = self._read_result(out)
+            if res is None or res.get("fenced"):
+                return RunResult(
+                    False, f"completed: seat {seat} "
+                           f"{'fenced' if res else 'no RESULT'}", secs)
+            if res["final_step"] != self.total_steps:
+                return RunResult(
+                    False, f"completed: seat {seat} final step "
+                           f"{res['final_step']}", secs)
+            if res["digest"] != ref:
+                return RunResult(
+                    False, f"bit_identical: seat {seat} params differ "
+                           f"from the fault-free reference", secs)
+        injected: Dict[str, int] = {}
+        slowest = 0.0
+        for i, d in enumerate(logs):
+            evs = (list(events) + hold) if i == 0 else \
+                faults_lib.parse_fault_spec(EXPAND_BACKBONE)
+            inv, inj, slow = self._check_stream(
+                os.path.join(d, "metrics.jsonl"), evs, planted=True)
+            if inv is not None:
+                return RunResult(False, inv, secs)
+            for k, v in inj.items():
+                injected[k] = injected.get(k, 0) + v
+            slowest = max(slowest, slow)
+        return RunResult(True, None, secs, recovery_s=slowest,
+                         injected=injected)
+
+    # -- shrinking --------------------------------------------------------
+
+    def shrink(self, events: List[faults_lib.FaultEvent], scenario: str,
+               max_runs: int = 16) -> List[faults_lib.FaultEvent]:
+        """Greedy one-fault-removal delta debugging: drop any fault
+        whose removal keeps the schedule failing. The result is
+        1-minimal (removing any single remaining fault makes the
+        failure disappear) within the run budget."""
+        events = list(events)
+        runs = 0
+        changed = True
+        while changed and len(events) > 1 and runs < max_runs:
+            changed = False
+            for i in range(len(events)):
+                candidate = events[:i] + events[i + 1:]
+                runs += 1
+                probe = self.run_schedule(
+                    candidate, scenario, tag=f"shrink{runs}")
+                if self.verbose:
+                    print(f"[chaos]   shrink probe "
+                          f"\"{faults_lib.format_fault_spec(candidate)}\""
+                          f" -> {'still fails' if not probe.ok else 'passes'}")
+                if not probe.ok:
+                    events = candidate
+                    changed = True
+                    break
+                if runs >= max_runs:
+                    break
+        return events
+
+
+def run_campaign(seeds: Sequence[int], scenario: str, workdir: str,
+                 budget: int = 3, total_steps: int = 40,
+                 deadline_s: float = 300.0, plant: Optional[str] = None,
+                 metrics_jsonl: Optional[str] = None,
+                 shrink: bool = True, explicit_spec: Optional[str] = None,
+                 verbose: bool = True,
+                 refs: Optional[Dict[str, str]] = None) -> dict:
+    """Run one chaos campaign; returns the summary dict (also logged as
+    ``chaos``/``chaos_done`` JSONL when ``metrics_jsonl`` is set).
+    ``explicit_spec`` replaces sampling with one fixed schedule per
+    seed entry (reproducer replay)."""
+    harness = ChaosHarness(workdir, total_steps=total_steps,
+                           deadline_s=deadline_s, plant=plant,
+                           verbose=verbose, refs=refs)
+    logger = MetricsLogger(metrics_jsonl)
+    vocab = {"train": faults_lib.CHAOS_VOCABULARY,
+             "cluster": faults_lib.CHAOS_CLUSTER_VOCABULARY,
+             "expand": faults_lib.CHAOS_EXPAND_VOCABULARY}[scenario]
+    results = []
+    faults_by_kind: Dict[str, int] = {}
+    slowest = 0.0
+    try:
+        for seed in seeds:
+            if explicit_spec is not None:
+                events = faults_lib.parse_fault_spec(explicit_spec)
+                sched = faults_lib.FaultSchedule(seed, events)
+            else:
+                sched = faults_lib.FaultSchedule.generate(
+                    seed, budget, vocabulary=vocab,
+                    max_step=total_steps - 5)
+            if verbose:
+                print(f"[chaos] seed {seed} [{scenario}] "
+                      f"\"{sched.spec}\"")
+            r = harness.run_schedule(sched.events, scenario,
+                                     tag=f"seed{seed}")
+            reproducer = None
+            if not r.ok and shrink and len(sched.events) > 1:
+                minimal = harness.shrink(list(sched.events), scenario)
+                reproducer = faults_lib.format_fault_spec(minimal)
+            elif not r.ok:
+                reproducer = sched.spec
+            for k, v in r.injected.items():
+                faults_by_kind[k] = faults_by_kind.get(k, 0) + v
+            slowest = max(slowest, r.recovery_s)
+            rec = {"seed": seed, "scenario": scenario,
+                   "spec": sched.spec, "ok": r.ok,
+                   "invariant": r.invariant,
+                   "secs": round(r.secs, 2)}
+            if reproducer is not None:
+                rec["reproducer"] = reproducer
+            logger.log("chaos", **rec)
+            results.append(rec)
+            if verbose:
+                if r.ok:
+                    print(f"[chaos]   OK in {r.secs:.1f}s "
+                          f"(injected {r.injected})")
+                else:
+                    print(f"[chaos]   FAILED: {r.invariant}")
+                    print(f"[chaos]   minimal reproducer: "
+                          f"--fault_spec \"{reproducer}\"")
+        summary = {
+            "schedules": len(results),
+            "passed": sum(1 for r in results if r["ok"]),
+            "failed": sum(1 for r in results if not r["ok"]),
+            "faults_by_kind": faults_by_kind,
+            "slowest_recovery_s": round(slowest, 3),
+            "results": results,
+            "reference_digests": dict(harness._refs),
+        }
+        logger.log("chaos_done",
+                   **{k: v for k, v in summary.items()
+                      if k not in ("results", "reference_digests")})
+        return summary
+    finally:
+        logger.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="chaos campaign driver (docs/RESILIENCE.md)")
+    p.add_argument("--seeds", type=int, default=5,
+                   help="number of seeded schedules to run")
+    p.add_argument("--seed_base", type=int, default=0,
+                   help="first seed (seeds are seed_base..+N-1)")
+    p.add_argument("--scenario", default="train",
+                   choices=["train", "cluster", "expand", "mixed"],
+                   help="which sim to fuzz: 1-process supervised "
+                        "train, the 2-process cluster shrink drill, "
+                        "the 2→1→2 elastic-expand drill, or an "
+                        "alternating mix of all three")
+    p.add_argument("--budget", type=int, default=3,
+                   help="faults sampled per schedule")
+    p.add_argument("--total_steps", type=int, default=40,
+                   help="steps per sim run")
+    p.add_argument("--deadline_s", type=float, default=300.0,
+                   help="per-run wall-clock deadline; an overrun is an "
+                        "invariant failure")
+    p.add_argument("--workdir", default=None,
+                   help="campaign working directory (default: a fresh "
+                        "tmp dir)")
+    p.add_argument("--metrics_jsonl", default=None,
+                   help="write chaos/chaos_done JSONL records here")
+    p.add_argument("--spec", default=None,
+                   help="run this exact --fault_spec once instead of "
+                        "sampling (reproducer replay)")
+    p.add_argument("--no_shrink", action="store_true",
+                   help="skip shrinking failing schedules")
+    p.add_argument("--plant", default=None, choices=sorted(PLANTS),
+                   help="revert a named piece of hardening inside the "
+                        "workers (regression drill: the campaign must "
+                        "catch it)")
+    args = p.parse_args(argv)
+
+    workdir = args.workdir
+    if workdir is None:
+        import tempfile
+        workdir = tempfile.mkdtemp(prefix="dml_chaos_")
+    scenarios = {"train": ["train"], "cluster": ["cluster"],
+                 "expand": ["expand"],
+                 "mixed": ["train", "cluster", "expand"]}[args.scenario]
+    seeds = list(range(args.seed_base, args.seed_base + args.seeds))
+    if args.spec is not None:
+        seeds = seeds[:1]
+    failed = 0
+    for i, scen in enumerate(scenarios):
+        scen_seeds = seeds[i::len(scenarios)]
+        if not scen_seeds:
+            continue
+        summary = run_campaign(
+            scen_seeds, scen, os.path.join(workdir, scen),
+            budget=args.budget, total_steps=args.total_steps,
+            deadline_s=args.deadline_s, plant=args.plant,
+            metrics_jsonl=args.metrics_jsonl,
+            shrink=not args.no_shrink, explicit_spec=args.spec)
+        failed += summary["failed"]
+        print(f"[chaos] {scen}: {summary['passed']}/"
+              f"{summary['schedules']} schedules passed; faults "
+              f"injected: {summary['faults_by_kind']}; slowest "
+              f"recovery {summary['slowest_recovery_s']:.2f}s")
+    print(f"[chaos] campaign {'PASSED' if not failed else 'FAILED'} "
+          f"({failed} failing schedule(s); workdir {workdir})")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

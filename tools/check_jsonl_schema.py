@@ -79,6 +79,21 @@ KIND_KEYS = {
     "host_rejoin": ("step", "process_id", "epoch"),
     "elastic_expand": ("step", "restore_step", "world_size", "epoch",
                        "attempt"),
+    # A corrupt restart-decision file classified by the hardened
+    # RestartCoordinator.read (undecodable payload or sha256-sidecar
+    # mismatch): the decision reads as absent, the poll self-heals, and
+    # this record is the evidence (rate-limited per payload digest).
+    "decision_corrupt": ("path", "error"),
+    # Chaos campaign driver (tools/chaos.py; docs/RESILIENCE.md chaos
+    # section). `chaos` is one seeded schedule's verdict (`spec` is the
+    # ready-to-paste --fault_spec, `invariant` the first violated
+    # invariant or null, and on failure `reproducer` carries the shrunk
+    # minimal spec); `chaos_done` the campaign summary (faults_by_kind
+    # counts every fault the schedules injected, slowest_recovery_s the
+    # worst fault→recovery latency observed across all runs).
+    "chaos": ("seed", "scenario", "spec", "ok", "invariant", "secs"),
+    "chaos_done": ("schedules", "passed", "failed", "faults_by_kind",
+                   "slowest_recovery_s"),
     # Sharded-checkpoint fast-resume (ckpt/sharded.py). One record per
     # shard file written (`op: save` — verify null, the digest is being
     # created) or read (`op: restore` — verify true/false/null, null =

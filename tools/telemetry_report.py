@@ -120,6 +120,27 @@ def _device_split(trains: List[dict]) -> Optional[dict]:
     return out
 
 
+def _chaos_totals(records: List[dict]) -> Optional[dict]:
+    """Sum every ``chaos_done`` record in the stream into one summary —
+    a mixed campaign (`--scenario mixed`) writes one per scenario, and
+    the section should report the whole campaign, not the last leg."""
+    dones = [r for r in records if r.get("kind") == "chaos_done"]
+    if not dones:
+        return None
+    by_kind: dict = {}
+    for r in dones:
+        for k, v in (r.get("faults_by_kind") or {}).items():
+            by_kind[k] = by_kind.get(k, 0) + v
+    return {
+        "schedules": sum(r.get("schedules") or 0 for r in dones),
+        "passed": sum(r.get("passed") or 0 for r in dones),
+        "failed": sum(r.get("failed") or 0 for r in dones),
+        "faults_by_kind": by_kind,
+        "slowest_recovery_s": max(
+            (r.get("slowest_recovery_s") or 0.0) for r in dones),
+    }
+
+
 def _fmt_bytes(n: Optional[int]) -> str:
     if not n:
         return "-"
@@ -365,6 +386,51 @@ def summarize(path: str) -> str:
             lines.append(
                 f"    [{len(prune_errs)} checkpoint prune failure(s) — "
                 f"old checkpoints may be accumulating]")
+    # Chaos campaign (tools/chaos.py; docs/RESILIENCE.md): schedules
+    # run, the fault mix they injected, which invariants failed (with
+    # the shrunk reproducer specs), and the slowest observed
+    # fault→recovery latency.
+    chaos_runs = [r for r in records if r.get("kind") == "chaos"]
+    chaos_done = _chaos_totals(records)
+    if chaos_runs or chaos_done:
+        lines.append("  chaos campaign:")
+        n = chaos_done.get("schedules") if chaos_done else len(chaos_runs)
+        passed = chaos_done.get("passed") if chaos_done \
+            else sum(1 for r in chaos_runs if r.get("ok"))
+        failed = chaos_done.get("failed") if chaos_done \
+            else sum(1 for r in chaos_runs if not r.get("ok"))
+        lines.append(f"    {n} schedule(s) run: {passed} passed, "
+                     f"{failed} failed")
+        by_kind = (chaos_done or {}).get("faults_by_kind") or {}
+        if by_kind:
+            per = ", ".join(f"{k}: {v}"
+                            for k, v in sorted(by_kind.items()))
+            lines.append(f"    faults injected by kind: {per}")
+        for r in chaos_runs:
+            if r.get("ok"):
+                continue
+            lines.append(
+                f"    FAILED seed {r.get('seed')} "
+                f"[{r.get('scenario')}] \"{r.get('spec')}\": "
+                f"{r.get('invariant')}")
+            if r.get("reproducer"):
+                lines.append(
+                    f"      minimal reproducer: --fault_spec "
+                    f"\"{r.get('reproducer')}\"")
+        slow = (chaos_done or {}).get("slowest_recovery_s")
+        if slow is not None:
+            lines.append(f"    slowest recovery: {slow:.2f} s "
+                         f"(fault record -> recovery record)")
+    # Corrupt restart-decision reads (parallel/cluster.py sidecar
+    # check): each one was classified and read as absent, never
+    # adopted — but a recurring one means the shared filesystem is
+    # serving garbage.
+    dcorr = [r for r in records if r.get("kind") == "decision_corrupt"]
+    if dcorr:
+        lines.append(f"  decision-file corruption: {len(dcorr)} "
+                     f"classified corrupt read(s)")
+        for r in dcorr[:3]:
+            lines.append(f"    {r.get('path')}: {r.get('error')}")
     # Cluster health (parallel/cluster.py): beat cadence per process,
     # straggler pressure, peer deaths, elastic restarts AND expands —
     # the stream-side answer to "did the cluster layer earn its keep".
@@ -520,6 +586,26 @@ def summarize_json(path: str) -> dict:
                                     if r.get("kind") == "swap")
         out["fleet"]["scales"] = sum(1 for r in records
                                      if r.get("kind") == "scale")
+    chaos_runs = [r for r in records if r.get("kind") == "chaos"]
+    chaos_done = _chaos_totals(records)
+    if chaos_runs or chaos_done:
+        out["chaos"] = {
+            "schedules": (chaos_done or {}).get("schedules",
+                                                len(chaos_runs)),
+            "passed": (chaos_done or {}).get(
+                "passed", sum(1 for r in chaos_runs if r.get("ok"))),
+            "failed": (chaos_done or {}).get(
+                "failed",
+                sum(1 for r in chaos_runs if not r.get("ok"))),
+            "faults_by_kind": (chaos_done or {}).get("faults_by_kind"),
+            "slowest_recovery_s": (chaos_done or {}).get(
+                "slowest_recovery_s"),
+            "failures": [
+                {"seed": r.get("seed"), "spec": r.get("spec"),
+                 "invariant": r.get("invariant"),
+                 "reproducer": r.get("reproducer")}
+                for r in chaos_runs if not r.get("ok")],
+        }
     faults = [r for r in records if r.get("kind") == "fault"]
     recoveries = [r for r in records if r.get("kind") == "recovery"]
     if faults or recoveries:
