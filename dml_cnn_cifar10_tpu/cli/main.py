@@ -496,6 +496,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-chip peak TFLOP/s; enables the MFU metric "
                         "in the jsonl stream")
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--stats_port", type=int, default=0,
+                   help="live metrics export: serve GET /metrics "
+                        "(Prometheus text exposition of the "
+                        "process-local counter/gauge/histogram "
+                        "registry) plus /healthz from a lightweight "
+                        "stats-HTTP thread while the trainer runs. "
+                        "0 = off. --mode serve and the fleet router "
+                        "expose /metrics on their existing servers "
+                        "(docs/OBSERVABILITY.md)")
+    p.add_argument("--alert_rules", type=str, default=None,
+                   help="custom streaming alert rules layered over the "
+                        "built-in defaults (goodput collapse, "
+                        "host-bound drain, nonfinite/recovery bursts, "
+                        "heartbeat staleness, shed>1%%, p99 vs "
+                        "--serve_slo_ms, HBM headroom): ';'-separated "
+                        "name=expr[@window][!severity] with expr "
+                        "'kind.field OP value' (threshold on "
+                        "consecutive records), "
+                        "'rate(kind[.field=value])>=N' (trailing "
+                        "step/'Ns' second window), or 'absent(kind)' "
+                        "(@Ns). Firing emits rate-limited alert/"
+                        "alert_resolved JSONL records "
+                        "(docs/OBSERVABILITY.md)")
     p.add_argument("--telemetry", type="bool", default=False,
                    help="run-health telemetry: host-loop span tracing, "
                         "goodput fractions, and HBM snapshots emitted "
@@ -542,6 +565,8 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
         checkpoint_every_secs=args.checkpoint_every_secs,
         log_dir=args.log_dir,
         metrics_jsonl=args.metrics_jsonl,
+        stats_port=args.stats_port,
+        alert_rules=args.alert_rules,
         telemetry=args.telemetry,
         trace_events_path=args.trace_events_path,
         health_metrics=args.health_metrics,
@@ -680,6 +705,15 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
             raise SystemExit(
                 "--optimizer_sharding zero1 needs the GSPMD (default) "
                 "step, not --explicit_collectives")
+    if args.alert_rules:
+        # Fail a typo'd rule at flag-parse time with a CLI-shaped
+        # error — a rule that silently never fires is the worst
+        # failure mode an alerting layer can have.
+        from dml_cnn_cifar10_tpu.utils.alerts import parse_alert_rules
+        try:
+            parse_alert_rules(args.alert_rules)
+        except ValueError as e:
+            raise SystemExit(f"--alert_rules: {e}")
     try:
         cfg.serve.buckets = tuple(
             int(b) for b in args.serve_buckets.split(",") if b.strip())

@@ -602,6 +602,21 @@ class TrainConfig:
     # LRU size bound for the cache directory, applied after each store.
     compile_cache_max_bytes: int = 2_000_000_000
     metrics_jsonl: Optional[str] = None   # structured metrics sink
+    # Live metrics export (utils/metrics_registry.py;
+    # docs/OBSERVABILITY.md "Live metrics"): serve `GET /metrics`
+    # (Prometheus text exposition of the process-local registry) from a
+    # lightweight stats-HTTP thread — the trainer's only HTTP surface.
+    # 0 = off (default). `--mode serve` and the fleet router expose
+    # /metrics on their existing HTTP servers instead.
+    stats_port: int = 0
+    # Custom streaming alert rules (utils/alerts.py grammar) layered
+    # over the built-in defaults: ";"-separated
+    # "name=expr[@window][!severity]" where expr is
+    # "kind.field OP value" (threshold over consecutive records),
+    # "rate(kind[.field=value]) >= N" (trailing step/second window),
+    # or "absent(kind)" (no record for @Ns). Firing emits rate-limited
+    # `alert` / `alert_resolved` JSONL records. None = built-ins only.
+    alert_rules: Optional[str] = None
     # Run-health telemetry (utils/telemetry.py): host-loop span tracing
     # (compile, data wait, dispatch, drain, eval, checkpoint, preemption
     # sync), cumulative goodput fractions, and HBM snapshots — all riding

@@ -35,7 +35,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Optional
+from typing import Optional, Sequence
 
 #: Shed fraction above which the fleet scales up (admission control is
 #: actively rejecting traffic — the loudest signal).
@@ -66,11 +66,24 @@ class ScaleDecision:
 
 def decide(signals: FleetSignals, min_replicas: int, max_replicas: int,
            slo_ms: Optional[float] = None,
-           scale_up_queue_depth: float = 8.0) -> ScaleDecision:
-    """The decision table (module docstring). Pure — no IO, no clock."""
+           scale_up_queue_depth: float = 8.0,
+           alerts_active: Sequence[str] = ()) -> ScaleDecision:
+    """The decision table (module docstring). Pure — no IO, no clock.
+
+    ``alerts_active`` is the streaming alert engine's state
+    (``utils/alerts.py`` rule names currently firing): a load-shaped
+    alert — shed, SLO burn, or any custom rule named ``scale_up*`` —
+    is one more scale-up condition, and ANY active alert vetoes
+    scale-DOWN (retiring capacity during an incident is how a page
+    becomes an outage). The direct signal checks stay: alerts are
+    rate-limited and windowed, so they lag the raw readings by design.
+    """
     total = signals.live + signals.starting
     if total < min_replicas:
         return ScaleDecision("up", "below_min")
+    alert_up = [a for a in alerts_active
+                if a in ("serve_shed", "fleet_shed", "serve_p99_slo")
+                or a.startswith("scale_up")]
     if signals.live > 0 and total < max_replicas:
         if signals.shed_fraction > SHED_UP:
             return ScaleDecision("up", "shedding")
@@ -79,9 +92,12 @@ def decide(signals: FleetSignals, min_replicas: int, max_replicas: int,
             return ScaleDecision("up", "slo_violation")
         if signals.mean_queue_depth > scale_up_queue_depth:
             return ScaleDecision("up", "queue_depth")
+        if alert_up:
+            return ScaleDecision("up", f"alert_{alert_up[0]}")
     if total > min_replicas and signals.starting == 0 \
             and signals.shed_fraction == 0.0 \
             and signals.mean_queue_depth < QUIET_QUEUE_DEPTH \
+            and not alerts_active \
             and (slo_ms is None or signals.p99_ms is None
                  or signals.p99_ms < SLO_DOWN_FRACTION * slo_ms):
         return ScaleDecision("down", "idle")

@@ -146,6 +146,15 @@ class FleetController:
         self.fleet_dir = fleet_coord_dir(cfg)
         os.makedirs(self.fleet_dir, exist_ok=True)
         self.logger = logger
+        # Streaming alerts over the controller's own stream (fleet
+        # windows, scale events, evictions via peer_lost) — and the
+        # autoscaler's extra input: active load-shaped alerts push
+        # scale-up, ANY active alert vetoes scale-down. Evaluated once
+        # per control tick, the fleet's metrics boundary.
+        from dml_cnn_cifar10_tpu.utils import alerts as alerts_lib
+        self.alerts = alerts_lib.AlertEngine.from_config(cfg)
+        if self.alerts is not None and logger is not None:
+            logger.add_observer(self.alerts.observer(logger))
         self.router = Router(
             self.fleet_dir,
             dead_after_s=cfg.fleet.replica_dead_after_s,
@@ -196,6 +205,10 @@ class FleetController:
         if now - self._last_fleet_emit >= self.cfg.fleet.metrics_every_s:
             self._last_fleet_emit = now
             self.router.emit()
+            if self.alerts is not None:
+                self.alerts.evaluate(
+                    emit=self.logger.log if self.logger is not None
+                    else None)
         if now < self._cooldown_until \
                 or now - self._last_decide < self.cfg.fleet.autoscale_every_s:
             return
@@ -205,7 +218,9 @@ class FleetController:
             sig, self.cfg.fleet.min_replicas,
             self.cfg.fleet.max_replicas,
             slo_ms=self.cfg.serve.slo_ms,
-            scale_up_queue_depth=self.cfg.fleet.scale_up_queue_depth)
+            scale_up_queue_depth=self.cfg.fleet.scale_up_queue_depth,
+            alerts_active=(self.alerts.active_names()
+                           if self.alerts is not None else ()))
         if decision.action == "hold":
             return
         if not self.cfg.fleet.autoscale and decision.reason != "below_min":

@@ -55,6 +55,11 @@ class ReplicaView:
     queue_depth: int
     phase: str
     age_s: float
+    # Median per-batch DEVICE milliseconds the worker advertises
+    # (serve/metrics.py recent_device_ms): the slow-device vs
+    # deep-queue disambiguator. None before the replica's first batch
+    # (and on beats from workers predating the field).
+    device_ms: Optional[float] = None
 
 
 def view_from_beat(beat, now: Optional[float] = None) -> ReplicaView:
@@ -65,7 +70,8 @@ def view_from_beat(beat, now: Optional[float] = None) -> ReplicaView:
         version=extra.get("version"),
         queue_depth=int(extra.get("queue_depth") or 0),
         phase=beat.phase,
-        age_s=beat.age_s(now))
+        age_s=beat.age_s(now),
+        device_ms=extra.get("device_ms"))
 
 
 def live_views(views: Sequence[ReplicaView], dead_after_s: float,
@@ -298,20 +304,32 @@ class Router:
                     "port": v.port, "version": v.version,
                     "queue_depth": v.queue_depth, "phase": v.phase,
                     "age_s": round(v.age_s, 3),
+                    "device_ms": v.device_ms,
                     "live": v.replica_id in live_ids}
                 for v in views},
         }
 
     def emit(self, final: bool = False) -> None:
         """One ``fleet`` window record; when ``final``, the cumulative
-        ``fleet_done`` follows (mirroring serve/serve_done)."""
+        ``fleet_done`` follows (mirroring serve/serve_done). The window
+        record carries the live replicas' advertised per-batch device
+        time (``device_ms``, from their beats) so the stream answers
+        slow-device-vs-deep-queue without raw beat-file spelunking —
+        the telemetry_report fleet-health section renders it."""
         if self.logger is None:
             return
-        replicas, live = len(self.views()), len(self.live())
-        self.logger.log("fleet", **self.metrics.window(replicas, live))
+        views = self.views()
+        live = self.live()
+        device_ms = {str(v.replica_id): v.device_ms for v in live
+                     if v.device_ms is not None}
+        self.logger.log("fleet",
+                        **self.metrics.window(len(views), len(live)),
+                        device_ms=device_ms)
         if final:
             self.logger.log("fleet_done",
-                            **self.metrics.cumulative(replicas, live))
+                            **self.metrics.cumulative(len(views),
+                                                      len(live)),
+                            device_ms=device_ms)
 
     # -- HTTP shell -----------------------------------------------------
 
@@ -331,7 +349,17 @@ class Router:
                 pass
 
             def do_GET(self):
-                if self.path == "/healthz":
+                if self.path == "/metrics":
+                    from dml_cnn_cifar10_tpu.utils.metrics_registry \
+                        import default_registry
+                    body = default_registry().render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/healthz":
                     self._reply(200, router.healthz())
                 elif self.path == "/stats":
                     # Cumulative and read-only: probing stats must not

@@ -211,6 +211,14 @@ def main_worker(cfg, replica_id: int, fault: Optional[str] = None,
     # per-replica file; the autoscaler and telemetry_report read these.
     cfg.metrics_jsonl = jsonl
     logger = MetricsLogger(jsonl_path=jsonl, task_index=replica_id)
+    # Per-replica streaming alerts (shed / p99-vs-SLO / custom rules):
+    # same engine the lone --mode serve path arms, emitting into this
+    # replica's stream — which the controller's signal aggregation and
+    # the live monitor already tail.
+    from dml_cnn_cifar10_tpu.utils import alerts as alerts_lib
+    alert_engine = alerts_lib.AlertEngine.from_config(cfg)
+    if alert_engine is not None:
+        logger.add_observer(alert_engine.observer(logger))
 
     # Engine over the PUBLISHED version when there is one (every
     # replica of a fleet must serve the same weights regardless of
@@ -271,7 +279,8 @@ def main_worker(cfg, replica_id: int, fault: Optional[str] = None,
     watcher = _SwapWatcher(fleet_dir, engine, trainer, state,
                            cfg.fleet.swap_poll_s, last_seq,
                            logger=logger)
-    flusher = _MetricsFlusher(metrics, logger, serve_cfg.metrics_every_s)
+    flusher = _MetricsFlusher(metrics, logger, serve_cfg.metrics_every_s,
+                              alerts=alert_engine)
     accept = threading.Thread(target=server.serve_forever,
                               name="fleet-worker-accept", daemon=True)
     drained = True

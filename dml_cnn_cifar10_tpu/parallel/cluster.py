@@ -545,10 +545,42 @@ class ClusterMonitor:
                 self.log("heartbeat", step=step,
                          process_id=self.process_id, phase=phase,
                          wallclock=round(now, 3))
+                # Live-export gauges (GET /metrics), at the same
+                # rate-limited cadence: the live world size and each
+                # peer's beat staleness — numbers that never enter the
+                # JSONL stream but are exactly what an operator (or
+                # the live monitor) watches during an incident.
+                self._export_gauges(now)
         self.check_evicted(step)
         self.watchdog.arm(step)
         self._raise_if_dead(step)
         self._maybe_raise_rejoin(step)
+
+    def _export_gauges(self, now: float) -> None:
+        """Registry-only export (utils/metrics_registry.py). Fail-open
+        and rate-limited to the heartbeat cadence by the caller — one
+        directory scan per interval, same cost as a watchdog pass."""
+        try:
+            from dml_cnn_cifar10_tpu.utils.metrics_registry import \
+                default_registry
+            reg = default_registry()
+            live = [p for p in self._survivors
+                    if p not in self.watchdog.dead_peers]
+            reg.gauge("dml_cluster_world_size",
+                      "World size adopted by the last restart decision"
+                      ).set(len(live))
+            reg.gauge("dml_cluster_epoch", "Adopted coordination epoch"
+                      ).set(self.epoch)
+            age_g = reg.gauge("dml_cluster_peer_beat_age_seconds",
+                              "Age of each peer's newest heartbeat",
+                              labelnames=("peer",))
+            for pid, beat in self.store.read_peers(
+                    self.live_set()).items():
+                age = beat.age_s(now) if beat is not None \
+                    else now - self.store.started_at
+                age_g.set(round(age, 3), peer=str(pid))
+        except Exception:
+            pass
 
     def sync(self, step: int, poll_s: float = 0.02) -> None:
         """Simulated collective barrier (``cluster_lockstep``): wait for

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Optional
 
+from dml_cnn_cifar10_tpu.utils.metrics_registry import default_registry
 from dml_cnn_cifar10_tpu.utils.telemetry import latency_summary, percentile
 
 
@@ -78,6 +79,13 @@ class ServeMetrics:
                 w.completed += 1
                 w.latencies.append(latency_s)
                 w.queue_waits.append(queue_wait_s)
+        # Live-export histogram (GET /metrics): the windowed JSONL
+        # records carry percentiles only — a Prometheus consumer wants
+        # the raw distribution. Host-side dict work per completion.
+        default_registry().histogram(
+            "dml_serve_latency_ms",
+            "End-to-end request latency (submit -> result)"
+        ).observe(latency_s * 1e3)
 
     # --- reporting ---
 

@@ -11,6 +11,10 @@ the bucket size, not the thread count). The endpoints:
   ``{"class": argmax, "logits": [...]}``. 503 with a reason on shed.
 - ``GET /stats`` — cumulative :class:`ServeMetrics` snapshot as JSON.
 - ``GET /healthz`` — liveness + the engine's input contract.
+- ``GET /metrics`` — the process-local registry in Prometheus text
+  exposition (``utils/metrics_registry.py``): live qps/latency/shed
+  gauges + counters fed by the same ``serve`` window records the JSONL
+  stream carries, plus the serving latency histogram.
 
 Artifact resolution for :func:`main_serve`: an explicit
 ``serve.artifact_path`` must exist (fail loudly — a typo'd path
@@ -49,11 +53,24 @@ def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics,
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply_text(self, code: int, text: str) -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def log_message(self, *a):  # access log -> metrics, not stderr
             pass
 
         def do_GET(self):
-            if self.path == "/healthz":
+            if self.path == "/metrics":
+                from dml_cnn_cifar10_tpu.utils.metrics_registry import \
+                    default_registry
+                self._reply_text(200, default_registry().render())
+            elif self.path == "/healthz":
                 # Everything a fleet router (or a human with curl)
                 # needs to judge this worker without submitting
                 # inference traffic: identity, the weights version it
@@ -104,18 +121,24 @@ def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics,
 
 
 class _MetricsFlusher(threading.Thread):
-    """Periodic ``serve`` window records while the server runs."""
+    """Periodic ``serve`` window records while the server runs — and,
+    when an alert engine is attached, its time-window evaluation tick
+    (the serving analogue of the trainer's metrics-boundary flush)."""
 
-    def __init__(self, metrics: ServeMetrics, logger, every_s: float):
+    def __init__(self, metrics: ServeMetrics, logger, every_s: float,
+                 alerts=None):
         super().__init__(name="serve-metrics", daemon=True)
         self._metrics = metrics
         self._logger = logger
         self._every = every_s
+        self._alerts = alerts
         self._stop = threading.Event()
 
     def run(self):
         while not self._stop.wait(self._every):
             self._metrics.emit(self._logger)
+            if self._alerts is not None:
+                self._alerts.evaluate(emit=self._logger.log)
 
     def stop(self):
         self._stop.set()
@@ -191,6 +214,14 @@ def main_serve(cfg, task_index: int = 0,
     # section of tools/telemetry_report.py totals them).
     logger = MetricsLogger(jsonl_path=cfg.metrics_jsonl,
                            task_index=task_index)
+    # Streaming alerts over the serve windows (shed > 1%, p99 vs
+    # --serve_slo_ms, plus any --alert_rules): the engine watches the
+    # records this logger writes; the flusher below gives it the
+    # periodic time-window tick.
+    from dml_cnn_cifar10_tpu.utils import alerts as alerts_lib
+    alert_engine = alerts_lib.AlertEngine.from_config(cfg)
+    if alert_engine is not None:
+        logger.add_observer(alert_engine.observer(logger))
     engine = resolve_engine(cfg, task_index, logger=logger)
     metrics = ServeMetrics()
     batcher = MicroBatcher(
@@ -207,7 +238,8 @@ def main_serve(cfg, task_index: int = 0,
     server = ThreadingHTTPServer(("", serve_cfg.port),
                                  _make_handler(batcher, metrics,
                                                replica_id=task_index))
-    flusher = _MetricsFlusher(metrics, logger, serve_cfg.metrics_every_s)
+    flusher = _MetricsFlusher(metrics, logger, serve_cfg.metrics_every_s,
+                              alerts=alert_engine)
     flusher.start()
     # The accept loop runs on its own thread so the main thread can
     # park on the shutdown signals (signal handlers only fire on the

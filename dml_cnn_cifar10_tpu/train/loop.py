@@ -37,8 +37,10 @@ from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
 from dml_cnn_cifar10_tpu.parallel import multihost
 from dml_cnn_cifar10_tpu.parallel import shardings as shardings_lib
 from dml_cnn_cifar10_tpu.parallel import step as step_lib
+from dml_cnn_cifar10_tpu.utils import alerts as alerts_lib
 from dml_cnn_cifar10_tpu.utils import devprof as devprof_lib
 from dml_cnn_cifar10_tpu.utils import faults as faults_lib
+from dml_cnn_cifar10_tpu.utils import metrics_registry
 from dml_cnn_cifar10_tpu.utils import telemetry as telemetry_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
 from dml_cnn_cifar10_tpu.utils.preemption import PreemptionGuard
@@ -60,7 +62,7 @@ class TrainResult:
 
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None, task_index: int = 0,
-                 fault_injector=None, cluster=None):
+                 fault_injector=None, cluster=None, alert_engine=None):
         self.cfg = cfg
         self.task_index = task_index
         if cfg.on_nonfinite not in ("halt", "skip", "rollback"):
@@ -82,6 +84,20 @@ class Trainer:
             cfg.metrics_jsonl, task_index=task_index,
             tensorboard_dir=(cfg.tensorboard_dir
                              if jax.process_index() == 0 else None))
+        # Live operational observability (docs/OBSERVABILITY.md): the
+        # streaming alert engine watches every record this logger
+        # writes (built-in SLO rules + --alert_rules), and --stats_port
+        # serves GET /metrics from the process registry the same
+        # records feed. The supervisor passes ONE engine across restart
+        # attempts — alert state (an un-resolved nonfinite burst) must
+        # survive the Trainer that detected it; a bare Trainer builds
+        # its own. Both are pure host work: the fetch-parity test pins
+        # zero extra device fetches.
+        self.alerts = alert_engine if alert_engine is not None \
+            else alerts_lib.AlertEngine.from_config(cfg)
+        if self.alerts is not None:
+            self.logger.add_observer(self.alerts.observer(self.logger))
+        metrics_registry.ensure_stats_server(cfg.stats_port)
         # Persistent compilation cache (compilecache/): every compile
         # seam this Trainer builds — train step/chunk, init, eval —
         # routes through it when --compile_cache_dir is set, so a
@@ -896,7 +912,8 @@ class Trainer:
                                             else None),
                                         **perf)
                         telemetry_lib.flush_boundary(tracer, self.logger,
-                                                     global_step)
+                                                     global_step,
+                                                     alerts=self.alerts)
                         if cfg.check_numerics:
                             # Loss is a replicated metric, so every
                             # process takes the same branch on the same
@@ -1018,7 +1035,8 @@ class Trainer:
                 # cumulative goodput breakdown, marked final so
                 # tools/telemetry_report.py can anchor on it.
                 telemetry_lib.flush_boundary(tracer, self.logger,
-                                             global_step, final=True)
+                                             global_step, final=True,
+                                             alerts=self.alerts)
         finally:
             # Crash paths clean up too: the async checkpoint writer must
             # drain (surfacing any background write error alongside the

@@ -73,6 +73,7 @@ from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
 from dml_cnn_cifar10_tpu.config import TrainConfig
 from dml_cnn_cifar10_tpu.data.pipeline import DataPipelineError
 from dml_cnn_cifar10_tpu.parallel import cluster as cluster_lib
+from dml_cnn_cifar10_tpu.utils import alerts as alerts_lib
 from dml_cnn_cifar10_tpu.utils import backoff
 from dml_cnn_cifar10_tpu.utils import faults as faults_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
@@ -268,6 +269,14 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
     logger = MetricsLogger(cfg.metrics_jsonl, task_index=task_index)
     monitor = cluster_lib.ClusterMonitor.from_config(cfg.parallel,
                                                      logger=logger)
+    # ONE alert engine too: the fault/recovery records the supervisor
+    # logs here must feed the same rule state as the Trainer's stream,
+    # and an alert that fired in attempt N must be able to RESOLVE in
+    # attempt N+1 (the nonfinite-burst alert resolves only after the
+    # recovered run progresses a clean window past the fault).
+    alert_engine = alerts_lib.AlertEngine.from_config(cfg)
+    if alert_engine is not None:
+        logger.add_observer(alert_engine.observer(logger))
     attempt = 0
     # Progress-based retry-budget reset (--retry_budget_window): the
     # newest checkpoint step at the time the budget was last charged.
@@ -278,7 +287,8 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
     try:
         while True:
             trainer = Trainer(cfg, task_index=task_index,
-                              fault_injector=injector, cluster=monitor)
+                              fault_injector=injector, cluster=monitor,
+                              alert_engine=alert_engine)
             try:
                 result = trainer.fit(total_steps)
             except cluster_lib.EvictedError as e:

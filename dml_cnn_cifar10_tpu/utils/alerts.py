@@ -1,0 +1,510 @@
+"""Streaming SLO/alert engine over the metrics stream.
+
+The JSONL stream records everything; nothing WATCHES it while the run
+is live — an operator learns about a goodput collapse or a shed storm
+from a post-hoc report. This module closes that gap with a small
+declarative rule engine evaluated at the seams that already see every
+number: ``MetricsLogger`` feeds each record it writes into
+:meth:`AlertEngine.observe` (``utils/logging.py`` observer hook), and
+the metrics-boundary flush / serve flusher / fleet control loop call
+:meth:`AlertEngine.evaluate` for the time-based rules. No polling
+thread, no extra device fetches, no new instrumentation.
+
+Three rule shapes cover the SLO vocabulary:
+
+- **threshold** — ``kind.field OP value`` breached on ``window``
+  CONSECUTIVE records (one flaky boundary is noise; N in a row is a
+  condition). Derived fields close the gap between raw records and
+  operator questions: ``train.drain_frac`` (drain-wait share of the
+  estimated device window — near 0 means the run flipped host-bound),
+  ``serve.shed_frac``, ``hbm.used_frac``.
+- **rate** — ≥ N matching records inside a trailing window of steps
+  (deterministic under any wall-clock, the simulation-friendly unit)
+  or seconds; optional field match (``fault=nonfinite``).
+- **absence** — no record of a kind for ``window`` seconds (armed only
+  after the first one: a run that never heartbeats is not stale, it is
+  simply not clustered).
+
+Firing emits an ``alert`` JSONL record (rule, severity, window, value)
+and recovery a paired ``alert_resolved`` — rate-limited per rule
+(``min_interval_s``) so a flapping signal cannot flood the stream: a
+suppressed re-fire also suppresses its resolution, keeping the emitted
+records strictly paired. Active state is exported live as the
+``dml_alert_active`` gauge (via the registry's record observer) and
+consumed by the fleet autoscaler as a scale-up input signal.
+
+Built-in defaults (:func:`built_in_rules`) cover the failure modes the
+repo's other layers already classify — goodput train-fraction
+collapse, drain-wait flipping host-bound, nonfinite/recovery bursts,
+heartbeat staleness, shed > 1%, p99 vs ``--serve_slo_ms``, HBM
+headroom — and ``--alert_rules`` adds custom rules in a one-line
+grammar (:func:`parse_alert_rules`; ``docs/OBSERVABILITY.md``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+_OPS = {
+    ">": lambda a, b: a > b,
+    "<": lambda a, b: a < b,
+    ">=": lambda a, b: a >= b,
+    "<=": lambda a, b: a <= b,
+}
+
+
+@dataclasses.dataclass
+class AlertRule:
+    """One declarative rule. ``window_unit`` gives ``window`` meaning:
+    ``count`` = consecutive records (threshold), ``steps`` = trailing
+    global-step window (rate), ``seconds`` = trailing wall window
+    (rate/absence)."""
+
+    name: str
+    rule_type: str                     # threshold | rate | absence
+    kind: str
+    op: str = ">"
+    value: float = 0.0
+    field: Optional[str] = None        # threshold only
+    window: float = 1.0
+    window_unit: str = "count"         # count | steps | seconds
+    severity: str = "warn"
+    match: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def window_str(self) -> str:
+        w = int(self.window) if float(self.window).is_integer() \
+            else self.window
+        unit = {"count": "consecutive", "steps": "steps",
+                "seconds": "s"}[self.window_unit]
+        return f"{w} {unit}" if unit != "s" else f"{w}s"
+
+
+def built_in_rules(slo_ms: Optional[float] = None,
+                   heartbeat_stale_s: float = 15.0) -> List[AlertRule]:
+    """The default rule set — every signal is already in the stream.
+
+    The ``serve_p99_slo`` burn rule exists only when an SLO is
+    configured (``--serve_slo_ms``); the others are universal and
+    silent on healthy runs by construction.
+    """
+    rules = [
+        # Productive-train fraction collapsed: most of the wall-clock
+        # is going to compile/data/eval/checkpoint/sync overheads.
+        # Two consecutive boundaries: the first boundary after a cold
+        # start legitimately reads compile-heavy.
+        AlertRule("goodput_train_collapse", "threshold", "goodput",
+                  field="train_frac", op="<", value=0.5, window=2,
+                  window_unit="count", severity="warn"),
+        # drain_frac ~ drain_wait / (device_step * steps): near zero
+        # means the device idles on the host (the run flipped
+        # host-bound) — the step itself is no longer the bottleneck.
+        AlertRule("host_bound_drain", "threshold", "train",
+                  field="drain_frac", op="<", value=0.10, window=3,
+                  window_unit="count", severity="warn"),
+        # A non-finite loss inside the trailing step window. Resolves
+        # once training has progressed a clean window past it — the
+        # paired alert/alert_resolved the acceptance smoke pins.
+        AlertRule("nonfinite_burst", "rate", "fault", op=">=",
+                  value=1, window=50, window_unit="steps",
+                  severity="page", match={"fault": "nonfinite"}),
+        # Recovery churn: the supervisor absorbing restarts faster
+        # than the budget was sized for.
+        AlertRule("recovery_burst", "rate", "recovery", op=">=",
+                  value=3, window=200, window_unit="steps",
+                  severity="page"),
+        # The cluster layer stopped heartbeating (armed only after
+        # the first beat record — non-cluster runs never arm it).
+        AlertRule("heartbeat_stale", "absence", "heartbeat",
+                  window=heartbeat_stale_s, window_unit="seconds",
+                  severity="page"),
+        # Admission control actively rejecting > 1% of traffic.
+        AlertRule("serve_shed", "threshold", "serve",
+                  field="shed_frac", op=">", value=0.01, window=1,
+                  window_unit="count", severity="warn"),
+        # The router-side twin (fleet window records): shed fraction
+        # across the whole fleet — what the controller's own stream
+        # sees, and a scale-up input to the autoscaler.
+        AlertRule("fleet_shed", "threshold", "fleet",
+                  field="shed_frac", op=">", value=0.01, window=1,
+                  window_unit="count", severity="warn"),
+        # Less than 8% HBM headroom: the next allocation spike OOMs.
+        AlertRule("hbm_headroom", "threshold", "hbm",
+                  field="used_frac", op=">", value=0.92, window=1,
+                  window_unit="count", severity="warn"),
+    ]
+    if slo_ms is not None:
+        rules.append(
+            AlertRule("serve_p99_slo", "threshold", "serve",
+                      field="p99_ms", op=">", value=float(slo_ms),
+                      window=2, window_unit="count", severity="page"))
+    return rules
+
+
+# --- the --alert_rules grammar --------------------------------------------
+
+_THRESHOLD_RE = re.compile(
+    r"^(?P<kind>\w+)\.(?P<field>\w+)\s*(?P<op>>=|<=|>|<)\s*"
+    r"(?P<value>-?[\d.]+)$")
+_RATE_RE = re.compile(
+    r"^rate\((?P<kind>\w+)(?:\.(?P<mfield>\w+)=(?P<mvalue>\w+))?\)\s*"
+    r"(?P<op>>=|>)\s*(?P<value>[\d.]+)$")
+_ABSENT_RE = re.compile(r"^absent\((?P<kind>\w+)\)$")
+
+
+def parse_alert_rules(spec: Optional[str]) -> List[AlertRule]:
+    """Parse the ``--alert_rules`` grammar into rules.
+
+    ``;``-separated entries, each ``name=expr[@window][!severity]``:
+
+    - ``lossy=train.loss>10@3`` — threshold, breached on 3 consecutive
+      records (default 1),
+    - ``churn=rate(recovery)>=2@300`` — ≥ 2 records in the trailing
+      300 STEPS (``@60s`` = 60 seconds; default 100 steps),
+    - ``churn2=rate(fault.fault=nonfinite)>=1@50`` — with field match,
+    - ``beatless=absent(heartbeat)@20s`` — no record for 20 s
+      (seconds required; default 30 s),
+    - ``...!page`` — severity suffix (default ``warn``).
+
+    Raises ``ValueError`` with the offending entry on any mismatch — a
+    typo'd rule must fail the run at flag-parse time, not silently
+    never fire.
+    """
+    rules: List[AlertRule] = []
+    if not spec:
+        return rules
+    for raw in spec.split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        name, eq, rest = entry.partition("=")
+        name = name.strip()
+        if not eq or not name or not re.fullmatch(r"\w+", name):
+            raise ValueError(f"bad alert rule {entry!r}: want "
+                             f"name=expr[@window][!severity]")
+        severity = "warn"
+        if "!" in rest:
+            rest, _, severity = rest.rpartition("!")
+            severity = severity.strip()
+            if not severity:
+                raise ValueError(f"bad alert rule {entry!r}: empty "
+                                 f"severity after '!'")
+        window_s: Optional[str] = None
+        if "@" in rest:
+            rest, _, window_s = rest.rpartition("@")
+            window_s = window_s.strip()
+        expr = rest.strip()
+
+        def parse_window(default: float, default_unit: str,
+                         require_seconds: bool = False
+                         ) -> Tuple[float, str]:
+            if window_s is None:
+                return default, default_unit
+            if window_s.endswith("s") and window_s[:-1]:
+                return float(window_s[:-1]), "seconds"
+            if require_seconds:
+                raise ValueError(
+                    f"bad alert rule {entry!r}: absence windows are "
+                    f"wall-clock — write @{window_s}s")
+            return float(window_s), default_unit
+
+        m = _THRESHOLD_RE.match(expr)
+        if m:
+            window, unit = parse_window(1, "count")
+            if unit == "seconds":
+                raise ValueError(
+                    f"bad alert rule {entry!r}: threshold windows "
+                    f"count consecutive records — drop the 's'")
+            rules.append(AlertRule(
+                name, "threshold", m.group("kind"),
+                field=m.group("field"), op=m.group("op"),
+                value=float(m.group("value")), window=window,
+                window_unit="count", severity=severity))
+            continue
+        m = _RATE_RE.match(expr)
+        if m:
+            window, unit = parse_window(100, "steps")
+            match = {}
+            if m.group("mfield"):
+                match[m.group("mfield")] = m.group("mvalue")
+            rules.append(AlertRule(
+                name, "rate", m.group("kind"), op=m.group("op"),
+                value=float(m.group("value")), window=window,
+                window_unit=unit, severity=severity, match=match))
+            continue
+        m = _ABSENT_RE.match(expr)
+        if m:
+            window, unit = parse_window(30.0, "seconds",
+                                        require_seconds=True)
+            rules.append(AlertRule(
+                name, "absence", m.group("kind"), window=window,
+                window_unit="seconds", severity=severity))
+            continue
+        raise ValueError(
+            f"bad alert rule {entry!r}: expr must be kind.field OP "
+            f"value, rate(kind[.field=value]) >= N, or absent(kind)")
+    names = [r.name for r in rules]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise ValueError(f"duplicate alert rule name(s): "
+                         f"{sorted(dupes)}")
+    return rules
+
+
+# --- derived fields --------------------------------------------------------
+
+def _derive(kind: str, fields: dict, state: dict) -> dict:
+    """Compute the operator-level fields rules key on from raw record
+    fields (non-destructive: returns an augmented copy when needed)."""
+    if kind == "train":
+        dev = fields.get("device_step_ms")
+        drain = fields.get("drain_wait_ms")
+        step = fields.get("step")
+        prev = state.get("prev_train_step")
+        if isinstance(step, (int, float)):
+            state["prev_train_step"] = step
+        if (isinstance(dev, (int, float)) and dev > 0
+                and isinstance(drain, (int, float))
+                and isinstance(step, (int, float))
+                and isinstance(prev, (int, float)) and step > prev):
+            out = dict(fields)
+            out["drain_frac"] = min(drain / (dev * (step - prev)), 1.0)
+            return out
+    elif kind == "serve":
+        req = fields.get("requests")
+        if isinstance(req, (int, float)) and req > 0:
+            out = dict(fields)
+            out["shed_frac"] = ((fields.get("shed_queue") or 0)
+                                + (fields.get("shed_deadline") or 0)) \
+                / req
+            return out
+    elif kind == "fleet":
+        total = (fields.get("routed") or 0) + (fields.get("shed") or 0)
+        if total > 0:
+            out = dict(fields)
+            out["shed_frac"] = (fields.get("shed") or 0) / total
+            return out
+    elif kind == "hbm":
+        limit = fields.get("bytes_limit")
+        if fields.get("available") and isinstance(limit, (int, float)) \
+                and limit > 0:
+            out = dict(fields)
+            out["used_frac"] = (fields.get("bytes_in_use") or 0) / limit
+            return out
+    return fields
+
+
+class _RuleState:
+    __slots__ = ("active", "emitted", "consecutive", "events",
+                 "last_seen", "last_emit_t", "value", "since_t")
+
+    def __init__(self):
+        self.active = False
+        self.emitted = False
+        self.consecutive = 0
+        self.events: collections.deque = collections.deque()
+        self.last_seen: Optional[float] = None   # absence arm time
+        self.last_emit_t: Optional[float] = None
+        self.value: Optional[float] = None
+        self.since_t: Optional[float] = None
+
+
+class AlertEngine:
+    """Evaluate a rule set against the record stream; emit paired,
+    rate-limited ``alert`` / ``alert_resolved`` records.
+
+    ``observe`` is called per record (via the ``MetricsLogger``
+    observer); ``evaluate`` is called at the metrics-boundary flush /
+    serve flusher tick / fleet control tick for the time-based rules.
+    Both take an ``emit(kind, **fields)`` callable — normally the
+    feeding logger's ``log`` — and an injectable ``now`` for
+    deterministic tests. Thread-safe: state mutates under one lock,
+    emissions fire after it is released (``emit`` re-enters the logger,
+    whose observers re-enter ``observe`` — which ignores alert kinds)."""
+
+    def __init__(self, rules: List[AlertRule],
+                 min_interval_s: float = 30.0):
+        self.rules = list(rules)
+        names = [r.name for r in self.rules]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(
+                f"alert rule name(s) {sorted(dupes)} defined twice "
+                f"(a custom --alert_rules entry shadowing a built-in?)")
+        self.min_interval_s = min_interval_s
+        self._lock = threading.Lock()
+        self._states = {r.name: _RuleState() for r in self.rules}
+        self._derive_state: dict = {}
+        self._max_step: Optional[float] = None
+
+    # -- feeding ---------------------------------------------------------
+
+    def observe(self, kind: str, fields: dict,
+                emit: Optional[Callable] = None,
+                now: Optional[float] = None) -> None:
+        if kind in ("alert", "alert_resolved"):
+            return
+        now = time.time() if now is None else now
+        pending: List[tuple] = []
+        with self._lock:
+            fields = _derive(kind, fields, self._derive_state)
+            step = fields.get("step")
+            if isinstance(step, (int, float)):
+                self._max_step = step if self._max_step is None \
+                    else max(self._max_step, step)
+            for rule in self.rules:
+                if rule.kind != kind:
+                    continue
+                st = self._states[rule.name]
+                if rule.rule_type == "absence":
+                    st.last_seen = now
+                    if st.active:
+                        self._resolve(rule, st, 0.0, now, pending)
+                elif rule.rule_type == "threshold":
+                    v = fields.get(rule.field)
+                    if not isinstance(v, (int, float)):
+                        continue
+                    if _OPS[rule.op](v, rule.value):
+                        st.consecutive += 1
+                        if st.consecutive >= rule.window \
+                                and not st.active:
+                            self._fire(rule, st, float(v), now, pending)
+                        elif st.active:
+                            st.value = float(v)
+                    else:
+                        st.consecutive = 0
+                        if st.active:
+                            self._resolve(rule, st, float(v), now,
+                                          pending)
+                elif rule.rule_type == "rate":
+                    if any(str(fields.get(k)) != str(v)
+                           for k, v in rule.match.items()):
+                        continue
+                    mark = now if rule.window_unit == "seconds" \
+                        else (step if isinstance(step, (int, float))
+                              else self._max_step)
+                    if mark is None:
+                        continue
+                    st.events.append(mark)
+                    self._prune_rate(rule, st, now)
+                    if len(st.events) >= rule.value and not st.active:
+                        self._fire(rule, st, float(len(st.events)),
+                                   now, pending)
+                    elif st.active:
+                        st.value = float(len(st.events))
+        self._emit_all(pending, emit)
+
+    def evaluate(self, emit: Optional[Callable] = None,
+                 now: Optional[float] = None,
+                 step: Optional[float] = None) -> None:
+        """Time/step-window pass: absence firings, rate resolutions.
+        Call at every boundary flush / control-loop tick."""
+        now = time.time() if now is None else now
+        pending: List[tuple] = []
+        with self._lock:
+            if isinstance(step, (int, float)):
+                self._max_step = step if self._max_step is None \
+                    else max(self._max_step, step)
+            for rule in self.rules:
+                st = self._states[rule.name]
+                if rule.rule_type == "absence":
+                    if st.last_seen is None:
+                        continue   # never armed
+                    age = now - st.last_seen
+                    if age > rule.window and not st.active:
+                        self._fire(rule, st, round(age, 3), now,
+                                   pending)
+                    elif st.active:
+                        st.value = round(age, 3)
+                elif rule.rule_type == "rate":
+                    self._prune_rate(rule, st, now)
+                    if st.active and len(st.events) < rule.value:
+                        self._resolve(rule, st,
+                                      float(len(st.events)), now,
+                                      pending)
+        self._emit_all(pending, emit)
+
+    # -- state transitions (lock held) -----------------------------------
+
+    def _prune_rate(self, rule: AlertRule, st: _RuleState,
+                    now: float) -> None:
+        horizon = (now - rule.window
+                   if rule.window_unit == "seconds"
+                   else (self._max_step - rule.window
+                         if self._max_step is not None else None))
+        if horizon is None:
+            return
+        while st.events and st.events[0] <= horizon:
+            st.events.popleft()
+
+    def _fire(self, rule, st, value, now, pending) -> None:
+        st.active = True
+        st.value = value
+        st.since_t = now
+        if st.last_emit_t is not None \
+                and now - st.last_emit_t < self.min_interval_s:
+            # Flap suppression: a re-fire inside the rate-limit window
+            # keeps internal state but emits nothing — and marks the
+            # cycle unemitted so its resolution stays silent too
+            # (emitted records are strictly alert/alert_resolved pairs).
+            st.emitted = False
+            return
+        st.emitted = True
+        st.last_emit_t = now
+        pending.append(("alert", rule, value))
+
+    def _resolve(self, rule, st, value, now, pending) -> None:
+        st.active = False
+        st.consecutive = 0
+        if st.emitted:
+            st.emitted = False
+            pending.append(("alert_resolved", rule, value))
+
+    def _emit_all(self, pending, emit) -> None:
+        if emit is None:
+            return
+        for record_kind, rule, value in pending:
+            emit(record_kind, rule=rule.name, severity=rule.severity,
+                 window=rule.window_str(), value=value)
+
+    # -- consumers --------------------------------------------------------
+
+    def active(self) -> List[dict]:
+        """Currently-firing rules (the autoscaler input and the live
+        monitor's "active alerts" panel)."""
+        with self._lock:
+            return [{"rule": r.name, "severity": r.severity,
+                     "value": self._states[r.name].value,
+                     "since_t": self._states[r.name].since_t}
+                    for r in self.rules if self._states[r.name].active]
+
+    def active_names(self) -> List[str]:
+        return [a["rule"] for a in self.active()]
+
+    def observer(self, logger) -> Callable:
+        """The ``MetricsLogger.add_observer`` adapter: every record the
+        logger writes feeds ``observe``, emissions go back out through
+        the same logger."""
+        return lambda kind, fields: self.observe(kind, fields,
+                                                 emit=logger.log)
+
+    @classmethod
+    def from_config(cls, cfg, extra_rules: Optional[str] = None
+                    ) -> Optional["AlertEngine"]:
+        """Engine for a :class:`~dml_cnn_cifar10_tpu.config.TrainConfig`
+        — built-ins (SLO-aware) plus the ``--alert_rules`` grammar.
+        None when there is nowhere to emit or export (no JSONL stream,
+        no stats port, no custom rules): the disarmed path costs
+        nothing."""
+        spec = extra_rules if extra_rules is not None \
+            else getattr(cfg, "alert_rules", None)
+        if not (cfg.metrics_jsonl or getattr(cfg, "stats_port", 0)
+                or spec):
+            return None
+        rules = built_in_rules(slo_ms=cfg.serve.slo_ms)
+        rules += parse_alert_rules(spec)
+        return cls(rules)

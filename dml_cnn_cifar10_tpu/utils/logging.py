@@ -5,6 +5,14 @@ Python lists that are appended and then dropped on the floor
 (``cifar10cnn.py:226-241``). This logger keeps the exact console format for
 parity and *also* persists every record as JSONL with wall-clock and
 throughput, so runs are analyzable after the fact.
+
+Live-metrics seam: every record written here also feeds the
+process-local metrics registry (``utils/metrics_registry.py`` — the
+``GET /metrics`` export surfaces render it) and any attached observers
+(the streaming alert engine, ``utils/alerts.py``). Both are pure host
+work on numbers the record already carries — no new instrumentation,
+no device fetches — and both are fail-open: a broken observer must
+never take down the training loop that logs through it.
 """
 
 from __future__ import annotations
@@ -15,6 +23,8 @@ import os
 import threading
 import time
 from typing import Optional
+
+from dml_cnn_cifar10_tpu.utils import metrics_registry
 
 
 def _finite(v):
@@ -34,6 +44,12 @@ class MetricsLogger:
         # must never interleave with another mid-write.
         self._lock = threading.Lock()
         self._file = None
+        # Observers see (kind, fields) for every record, called OUTSIDE
+        # the write lock: an observer that re-enters log() (the alert
+        # engine emitting an `alert` record) must not deadlock. The
+        # registry feed is unconditional — a process that never exports
+        # pays one dict-dispatch per record.
+        self._observers = []
         if jsonl_path:
             os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
             self._file = open(jsonl_path, "a", buffering=1)
@@ -49,6 +65,13 @@ class MetricsLogger:
             # runtime into a JAX process.
             from tensorboardX import SummaryWriter
             self._tb = SummaryWriter(log_dir=tensorboard_dir)
+
+    def add_observer(self, fn) -> None:
+        """Attach ``fn(kind, fields)`` to every subsequent record.
+        Idempotent by identity so supervisor restart attempts that
+        re-attach the same engine adapter don't double-feed it."""
+        if fn not in self._observers:
+            self._observers.append(fn)
 
     def log(self, kind: str, **fields) -> None:
         if self._file is not None:
@@ -68,6 +91,15 @@ class MetricsLogger:
                         and not isinstance(v, bool) \
                         and _finite(v) is not None:
                     self._tb.add_scalar(f"{kind}/{k}", v, step)
+        # Live-metrics feeds, after the sinks so a slow/broken observer
+        # can't lose the persisted record. observe_record is fail-open
+        # internally; attached observers get the same protection here.
+        metrics_registry.observe_record(kind, fields)
+        for fn in self._observers:
+            try:
+                fn(kind, fields)
+            except Exception:
+                pass
 
     def train_print(self, global_step: int, local_step: int,
                     train_accuracy: float) -> None:

@@ -253,21 +253,30 @@ def hbm_stats() -> dict:
 
 
 def flush_boundary(tracer: SpanTracer, logger, step: int,
-                   final: bool = False) -> None:
+                   final: bool = False, alerts=None) -> None:
     """Emit the boundary telemetry records through ``MetricsLogger``:
     every span finished since the last flush, the cumulative goodput
     breakdown, and an HBM snapshot. Pure host work — zero device fetches
-    (the ~100 ms-RTT tunnel rule)."""
-    if not tracer.enabled:
-        return
-    for name, cat, start, dur, depth in tracer.drain():
-        logger.log("span", step=step, name=name,
-                   start_s=round(start, 4), dur_s=round(dur, 4),
-                   depth=depth, **({"cat": cat} if cat else {}))
-    gp = tracer.goodput()
-    if tracer.dropped:
-        gp["dropped_spans"] = tracer.dropped
-    if final:
-        gp["final"] = 1
-    logger.log("goodput", step=step, **gp)
-    logger.log("hbm", step=step, **hbm_stats())
+    (the ~100 ms-RTT tunnel rule).
+
+    ``alerts`` (an :class:`~dml_cnn_cifar10_tpu.utils.alerts.AlertEngine`)
+    gets its time-window pass here — the record-driven rules already saw
+    every record above via the logger's observer hook; this is where
+    absence rules (heartbeat staleness) and rate-window resolutions are
+    adjudicated, so alerting runs exactly at the cadence the stream
+    already flushes. The engine may run even when the tracer is off —
+    `train`/`fault` records still flow without ``--telemetry``."""
+    if tracer.enabled:
+        for name, cat, start, dur, depth in tracer.drain():
+            logger.log("span", step=step, name=name,
+                       start_s=round(start, 4), dur_s=round(dur, 4),
+                       depth=depth, **({"cat": cat} if cat else {}))
+        gp = tracer.goodput()
+        if tracer.dropped:
+            gp["dropped_spans"] = tracer.dropped
+        if final:
+            gp["final"] = 1
+        logger.log("goodput", step=step, **gp)
+        logger.log("hbm", step=step, **hbm_stats())
+    if alerts is not None:
+        alerts.evaluate(emit=logger.log, step=step)

@@ -1,0 +1,419 @@
+"""Streaming alert engine (utils/alerts.py) + live-metrics registry
+(utils/metrics_registry.py): a decision-table unit over every built-in
+rule (fires on a synthetic unhealthy stream, stays silent on a healthy
+one, resolves when the signal recovers, the rate limit holds), the
+--alert_rules grammar, and a /metrics exposition-format lint (render →
+parse back → same numbers)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from dml_cnn_cifar10_tpu.utils.alerts import (AlertEngine, AlertRule,
+                                              built_in_rules,
+                                              parse_alert_rules)
+from dml_cnn_cifar10_tpu.utils.metrics_registry import (
+    MetricsRegistry, StatsServer, observe_record, parse_prometheus_text)
+
+
+class _Sink:
+    """Emission collector with (kind, fields) tuples."""
+
+    def __init__(self):
+        self.records = []
+
+    def __call__(self, kind, **fields):
+        self.records.append((kind, fields))
+
+    def kinds(self):
+        return [k for k, _ in self.records]
+
+    def last(self):
+        return self.records[-1]
+
+
+def _engine(min_interval_s=0.0):
+    return AlertEngine(built_in_rules(slo_ms=50.0),
+                       min_interval_s=min_interval_s)
+
+
+def _serve(requests=100, shed=0, p99=10.0):
+    return {"requests": requests, "completed": requests - shed,
+            "shed_queue": shed, "shed_deadline": 0, "qps": 10.0,
+            "p50_ms": 5.0, "p95_ms": 8.0, "p99_ms": p99,
+            "batch_fill": 0.9, "window_s": 5.0}
+
+
+# ---------------------------------------------------------------------------
+# the built-in decision table: unhealthy fires / healthy silent /
+# recovery resolves — one case per built-in rule
+# ---------------------------------------------------------------------------
+
+#: (rule, [(kind, fields) unhealthy stream], [(kind, fields) healthy
+#: stream], [(kind, fields) recovery tail]). The unhealthy stream must
+#: fire EXACTLY its rule; healthy must fire nothing; unhealthy +
+#: recovery must end resolved.
+DECISION_TABLE = [
+    ("goodput_train_collapse",
+     [("goodput", {"step": 10, "train_frac": 0.3}),
+      ("goodput", {"step": 20, "train_frac": 0.2})],
+     [("goodput", {"step": 10, "train_frac": 0.3}),     # one boundary
+      ("goodput", {"step": 20, "train_frac": 0.9})],    # is noise
+     [("goodput", {"step": 30, "train_frac": 0.9})]),
+    ("host_bound_drain",
+     # drain_frac = drain / (device * steps): 0.5/(2*10) = 0.025 < 0.1
+     # on three consecutive boundaries (the first row only anchors the
+     # previous step, so four rows = three readings).
+     [("train", {"step": s, "device_step_ms": 2.0,
+                 "drain_wait_ms": 0.5})
+      for s in (10, 20, 30, 40)],
+     # 18/(2*10) = 0.9: the host spends the window blocked on the
+     # device — device-bound, healthy.
+     [("train", {"step": s, "device_step_ms": 2.0,
+                 "drain_wait_ms": 18.0})
+      for s in (10, 20, 30, 40)],
+     [("train", {"step": s, "device_step_ms": 2.0,
+                 "drain_wait_ms": 18.0})
+      for s in (50,)]),
+    ("nonfinite_burst",
+     [("fault", {"step": 15, "fault": "nonfinite", "injected": False})],
+     [("fault", {"step": 15, "fault": "data", "injected": False})],
+     [("train", {"step": 70, "loss": 0.1})]),          # 50 steps past
+    ("recovery_burst",
+     [("recovery", {"step": s, "fault": "data", "action": "restart",
+                    "attempt": i + 1})
+      for i, s in enumerate((10, 12, 14))],
+     [("recovery", {"step": 10, "fault": "data", "action": "restart",
+                    "attempt": 1})],                   # one is routine
+     [("train", {"step": 300, "loss": 0.1})]),         # window passes
+    ("serve_shed",
+     [("serve", _serve(shed=5))],                      # 5% shed
+     [("serve", _serve(shed=0))],
+     [("serve", _serve(shed=0))]),
+    ("fleet_shed",
+     [("fleet", {"replicas": 2, "live": 2, "routed": 90, "shed": 10,
+                 "rerouted": 0, "evictions": 0})],
+     [("fleet", {"replicas": 2, "live": 2, "routed": 100, "shed": 0,
+                 "rerouted": 0, "evictions": 0})],
+     [("fleet", {"replicas": 2, "live": 2, "routed": 100, "shed": 0,
+                 "rerouted": 0, "evictions": 0})]),
+    ("serve_p99_slo",
+     [("serve", _serve(p99=80.0)), ("serve", _serve(p99=90.0))],
+     [("serve", _serve(p99=80.0)), ("serve", _serve(p99=10.0))],
+     [("serve", _serve(p99=10.0))]),
+    ("hbm_headroom",
+     [("hbm", {"step": 10, "available": True, "devices": 1,
+               "bytes_in_use": 95, "peak_bytes": 95,
+               "bytes_limit": 100})],
+     [("hbm", {"step": 10, "available": True, "devices": 1,
+               "bytes_in_use": 50, "peak_bytes": 50,
+               "bytes_limit": 100})],
+     [("hbm", {"step": 20, "available": True, "devices": 1,
+               "bytes_in_use": 50, "peak_bytes": 50,
+               "bytes_limit": 100})]),
+]
+
+
+@pytest.mark.parametrize("rule,unhealthy,healthy,recovery",
+                         DECISION_TABLE,
+                         ids=[c[0] for c in DECISION_TABLE])
+def test_builtin_rule_decision_table(rule, unhealthy, healthy,
+                                     recovery):
+    # Unhealthy stream: exactly this rule fires.
+    sink = _Sink()
+    eng = _engine()
+    now = 100.0
+    for kind, fields in unhealthy:
+        eng.observe(kind, fields, emit=sink, now=now)
+        now += 1.0
+    fired = [f["rule"] for k, f in sink.records if k == "alert"]
+    assert fired == [rule], (rule, sink.records)
+    assert eng.active_names() == [rule]
+    rec = sink.last()[1]
+    assert set(rec) == {"rule", "severity", "window", "value"}
+
+    # Healthy stream: silence.
+    sink2 = _Sink()
+    eng2 = _engine()
+    now = 100.0
+    for kind, fields in healthy:
+        eng2.observe(kind, fields, emit=sink2, now=now)
+        now += 1.0
+    eng2.evaluate(emit=sink2, now=now)
+    assert sink2.records == [], (rule, sink2.records)
+
+    # Unhealthy + recovery tail: paired fire → resolve, nothing active.
+    sink3 = _Sink()
+    eng3 = _engine()
+    now = 100.0
+    for kind, fields in unhealthy + recovery:
+        eng3.observe(kind, fields, emit=sink3, now=now)
+        now += 1.0
+    eng3.evaluate(emit=sink3, now=now)
+    kinds = sink3.kinds()
+    assert kinds == ["alert", "alert_resolved"], (rule, sink3.records)
+    assert sink3.records[0][1]["rule"] == rule
+    assert sink3.records[1][1]["rule"] == rule
+    assert eng3.active_names() == []
+
+
+def test_heartbeat_absence_rule():
+    """absence rules arm on the first record and fire from evaluate()
+    — the flush/control-loop tick — not from record flow."""
+    sink = _Sink()
+    eng = _engine()
+    # Never armed: no heartbeat ever seen, silence forever.
+    eng.evaluate(emit=sink, now=1000.0)
+    assert sink.records == []
+    eng.observe("heartbeat", {"step": 1, "process_id": 0,
+                              "phase": "train", "wallclock": 100.0},
+                emit=sink, now=100.0)
+    eng.evaluate(emit=sink, now=110.0)     # 10s < 15s: fine
+    assert sink.records == []
+    eng.evaluate(emit=sink, now=120.0)     # 20s stale: page
+    assert sink.kinds() == ["alert"]
+    assert sink.last()[1]["rule"] == "heartbeat_stale"
+    assert sink.last()[1]["severity"] == "page"
+    # The next beat resolves it.
+    eng.observe("heartbeat", {"step": 2, "process_id": 0,
+                              "phase": "train", "wallclock": 121.0},
+                emit=sink, now=121.0)
+    assert sink.kinds() == ["alert", "alert_resolved"]
+
+
+def test_rate_limit_holds_and_pairs_stay_paired():
+    """A re-fire inside min_interval_s is suppressed — and so is its
+    resolution, so the emitted stream is strictly alternating
+    alert/alert_resolved pairs; after the interval, firing resumes."""
+    sink = _Sink()
+    eng = AlertEngine(built_in_rules(), min_interval_s=30.0)
+    flap = [("serve", _serve(shed=5)), ("serve", _serve(shed=0))]
+    now = 100.0
+    for _ in range(4):                     # four flaps inside 30 s
+        for kind, fields in flap:
+            eng.observe(kind, fields, emit=sink, now=now)
+            now += 1.0
+    assert sink.kinds() == ["alert", "alert_resolved"]
+    # Past the rate-limit window the next breach emits again.
+    now = 200.0
+    for kind, fields in flap:
+        eng.observe(kind, fields, emit=sink, now=now)
+        now += 1.0
+    assert sink.kinds() == ["alert", "alert_resolved"] * 2
+    pairs = [(k, f["rule"]) for k, f in sink.records]
+    assert all(r == "serve_shed" for _, r in pairs)
+
+
+def test_alert_grammar_round_trip():
+    rules = parse_alert_rules(
+        "lossy=train.loss>10@3;"
+        "churn=rate(recovery)>=2@300!page;"
+        "nf=rate(fault.fault=nonfinite)>=1@50;"
+        "lag=rate(straggler)>=5@60s;"
+        "beatless=absent(heartbeat)@20s!page")
+    assert [r.name for r in rules] == ["lossy", "churn", "nf", "lag",
+                                       "beatless"]
+    lossy, churn, nf, lag, beatless = rules
+    assert (lossy.rule_type, lossy.kind, lossy.field, lossy.op,
+            lossy.value, lossy.window) == \
+        ("threshold", "train", "loss", ">", 10.0, 3)
+    assert churn.severity == "page" and churn.window_unit == "steps" \
+        and churn.window == 300
+    assert nf.match == {"fault": "nonfinite"}
+    assert lag.window_unit == "seconds" and lag.window == 60.0
+    assert beatless.rule_type == "absence" and beatless.window == 20.0
+    assert parse_alert_rules(None) == [] and parse_alert_rules("") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "noequals",
+    "x=train.loss~10",                  # bad op
+    "y=absent(heartbeat)@20",           # absence needs seconds
+    "z=rate(fault)<=3",                 # rate is >=/> only
+    "w=train.loss>1@3s",                # threshold windows are counts
+    "v=train.loss>1!",                  # empty severity
+    "a=train.loss>1;a=train.loss>2",    # duplicate name
+])
+def test_alert_grammar_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_alert_rules(bad)
+
+
+def test_custom_rule_fires_and_engine_rejects_shadowing():
+    sink = _Sink()
+    eng = AlertEngine(parse_alert_rules("lossy=train.loss>10@2!page"),
+                      min_interval_s=0.0)
+    eng.observe("train", {"step": 10, "loss": 50.0}, emit=sink, now=1.0)
+    assert sink.records == []              # 1 of 2 consecutive
+    eng.observe("train", {"step": 20, "loss": 60.0}, emit=sink, now=2.0)
+    assert sink.kinds() == ["alert"]
+    assert sink.last()[1]["severity"] == "page"
+    # A custom rule shadowing a built-in name is a config error.
+    with pytest.raises(ValueError):
+        AlertEngine(built_in_rules()
+                    + [AlertRule("serve_shed", "threshold", "serve",
+                                 field="qps", op="<", value=1)])
+
+
+def test_builtin_slo_rule_only_with_slo():
+    names = [r.name for r in built_in_rules()]
+    assert "serve_p99_slo" not in names
+    assert "serve_p99_slo" in [r.name for r in built_in_rules(50.0)]
+
+
+def test_autoscaler_consumes_alert_state():
+    from dml_cnn_cifar10_tpu.fleet.autoscaler import (FleetSignals,
+                                                      decide)
+    quiet = FleetSignals(live=2, starting=0, mean_queue_depth=0.0,
+                         shed_fraction=0.0, p99_ms=5.0)
+    # A load-shaped alert is a scale-up signal on its own...
+    assert decide(quiet, 1, 4,
+                  alerts_active=["serve_shed"]).action == "up"
+    assert decide(quiet, 1, 4,
+                  alerts_active=["scale_up_custom"]).reason \
+        == "alert_scale_up_custom"
+    # ...any active alert vetoes scale-down...
+    assert decide(quiet, 1, 4,
+                  alerts_active=["hbm_headroom"]).action == "hold"
+    # ...and no alerts keeps the historical table intact.
+    assert decide(quiet, 1, 4).action == "down"
+
+
+# ---------------------------------------------------------------------------
+# /metrics exposition-format lint: render → parse back → same numbers
+# ---------------------------------------------------------------------------
+
+def test_exposition_format_round_trip():
+    reg = MetricsRegistry()
+    # Feed representative records of every translated kind through the
+    # SAME path the logger uses.
+    observe_record("train", {"step": 40, "loss": 0.25,
+                             "images_per_sec": 1234.5,
+                             "device_step_ms": 2.5,
+                             "drain_wait_ms": 1.25}, reg)
+    observe_record("goodput", {"step": 40, "total_s": 10.0,
+                               "train_frac": 0.8, "compile_frac": 0.2},
+                   reg)
+    observe_record("hbm", {"step": 40, "available": True, "devices": 2,
+                           "bytes_in_use": 100, "peak_bytes": 120,
+                           "bytes_limit": 1000}, reg)
+    observe_record("serve", _serve(shed=3), reg)
+    observe_record("fleet", {"replicas": 3, "live": 2, "routed": 10,
+                             "rerouted": 1, "evictions": 1, "shed": 0},
+                   reg)
+    observe_record("fault", {"step": 10, "fault": "nonfinite"}, reg)
+    observe_record("recovery", {"step": 10, "action": "restart"}, reg)
+    observe_record("compile", {"hit": True, "compile_s": 1.5}, reg)
+    observe_record("alert", {"rule": "serve_shed", "severity": "warn",
+                             "window": "1 consecutive", "value": 0.03},
+                   reg)
+    reg.histogram("dml_serve_latency_ms", "latency",
+                  buckets=(1.0, 10.0)).observe(5.0)
+
+    text = reg.render()
+    doc = parse_prometheus_text(text)   # raises on any malformed line
+
+    # Every rendered family carries TYPE + HELP and parses back to the
+    # numbers that went in.
+    assert doc["dml_train_step"]["type"] == "gauge"
+    assert doc["dml_train_step"]["samples"][()] == 40.0
+    assert doc["dml_train_images_per_sec"]["samples"][()] == 1234.5
+    assert doc["dml_goodput_fraction"]["samples"][
+        (("category", "train"),)] == 0.8
+    assert doc["dml_hbm_bytes_in_use"]["samples"][()] == 100.0
+    assert doc["dml_serve_shed_total"]["type"] == "counter"
+    assert doc["dml_serve_shed_total"]["samples"][
+        (("reason", "queue_full"),)] == 3.0
+    assert doc["dml_faults_total"]["samples"][
+        (("fault", "nonfinite"),)] == 1.0
+    assert doc["dml_compile_lookups_total"]["samples"][
+        (("hit", "true"),)] == 1.0
+    assert doc["dml_alert_active"]["samples"][
+        (("rule", "serve_shed"), ("severity", "warn"))] == 1.0
+    # Histogram: cumulative buckets + +Inf == count.
+    b = doc["dml_serve_latency_ms_bucket"]["samples"]
+    assert b[(("le", "1"),)] == 0.0 and b[(("le", "10"),)] == 1.0
+    assert b[(("le", "+Inf"),)] == 1.0
+    assert doc["dml_serve_latency_ms_count"]["samples"][()] == 1.0
+    # Counters accumulate window deltas.
+    observe_record("serve", _serve(shed=2), reg)
+    doc2 = parse_prometheus_text(reg.render())
+    assert doc2["dml_serve_shed_total"]["samples"][
+        (("reason", "queue_full"),)] == 5.0
+    # alert_resolved flips the active gauge to 0.
+    observe_record("alert_resolved",
+                   {"rule": "serve_shed", "severity": "warn",
+                    "window": "1 consecutive", "value": 0.0}, reg)
+    doc3 = parse_prometheus_text(reg.render())
+    assert doc3["dml_alert_active"]["samples"][
+        (("rule", "serve_shed"), ("severity", "warn"))] == 0.0
+
+
+def test_exposition_parser_rejects_malformed():
+    for bad in ("name{unclosed 1", 'name{l="v} 1', "name", "name abc"):
+        with pytest.raises(ValueError):
+            parse_prometheus_text(bad)
+
+
+def test_registry_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "c")
+    c.inc(); c.inc(-5)                       # negative deltas dropped
+    assert reg.snapshot()["c_total"][()] == 1.0
+    g = reg.gauge("g", "g")
+    g.set(None); g.set(2.0)                  # None never clobbers
+    assert reg.snapshot()["g"][()] == 2.0
+    assert reg.counter("c_total", "again") is c      # idempotent
+    with pytest.raises(ValueError):
+        reg.gauge("c_total", "type clash")
+    with pytest.raises(ValueError):
+        c.inc(1, wrong_label="x")
+
+
+def test_stats_server_serves_metrics_and_healthz():
+    reg = MetricsRegistry()
+    reg.gauge("dml_train_step", "step").set(7)
+    srv = StatsServer(reg, port=0)           # ephemeral test bind
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=5) as resp:
+            assert "text/plain" in resp.headers["Content-Type"]
+            doc = parse_prometheus_text(resp.read().decode())
+        assert doc["dml_train_step"]["samples"][()] == 7.0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz",
+                timeout=5) as resp:
+            assert json.loads(resp.read())["ok"] is True
+    finally:
+        srv.close()
+
+
+def test_ensure_stats_server_off_by_default():
+    from dml_cnn_cifar10_tpu.utils.metrics_registry import \
+        ensure_stats_server
+    assert ensure_stats_server(0) is None
+    assert ensure_stats_server(None) is None
+
+
+def test_logger_feeds_engine_and_registry(tmp_path):
+    """The MetricsLogger observer seam end to end: records written
+    through the logger reach an attached engine, its alert emission
+    lands back in the SAME stream, and the registry sees everything —
+    with the schema lint clean over the result."""
+    from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+
+    path = str(tmp_path / "m.jsonl")
+    logger = MetricsLogger(path)
+    eng = AlertEngine(built_in_rules(), min_interval_s=0.0)
+    logger.add_observer(eng.observer(logger))
+    logger.log("serve", **_serve(shed=5))
+    logger.log("serve", **_serve(shed=0))
+    logger.close()
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    kinds = [r["kind"] for r in recs]
+    assert kinds == ["serve", "alert", "serve", "alert_resolved"]
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(path) == []

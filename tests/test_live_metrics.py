@@ -1,0 +1,335 @@
+"""Live operational observability, end to end: the JsonlTail shared
+tailing helper, the cluster-wide live monitor (tools/live_monitor.py),
+telemetry_report --follow, and the tier-1 acceptance smoke — a
+supervised CPU training sim with an injected non-finite fault serving
+valid Prometheus text on --stats_port WHILE it runs, with the
+nonfinite-burst alert firing and resolving as paired alert/
+alert_resolved records in a schema-clean stream."""
+
+import io
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+from dml_cnn_cifar10_tpu.utils.metrics_registry import (
+    MetricsRegistry, StatsServer, parse_prometheus_text)
+from tests.conftest import tiny_train_cfg
+from tools.live_monitor import (JsonlTail, active_alerts, build_state,
+                                render_view, run_monitor,
+                                scrape_endpoint)
+
+
+def _write(path, recs, mode="a"):
+    with open(path, mode) as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_jsonl_tail_incremental_and_partial_lines(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    tail = JsonlTail(path)
+    assert tail.poll() == []                  # not created yet
+    _write(path, [{"kind": "train", "t": 1.0, "task": 0, "step": 10}])
+    assert [r["step"] for r in tail.poll()] == [10]
+    assert tail.poll() == []                  # nothing new
+    _write(path, [{"kind": "train", "t": 2.0, "task": 0, "step": 20},
+                  {"kind": "train", "t": 3.0, "task": 0, "step": 30}])
+    assert [r["step"] for r in tail.poll()] == [20, 30]
+    # A writer mid-append: the partial line waits for its newline.
+    with open(path, "a") as f:
+        f.write('{"kind": "train", "t": 4.0, "ta')
+    assert tail.poll() == []
+    with open(path, "a") as f:
+        f.write('sk": 0, "step": 40}\n')
+    assert [r["step"] for r in tail.poll()] == [40]
+
+
+def test_active_alert_pairing_order():
+    recs = [
+        {"kind": "alert", "rule": "a", "severity": "warn"},
+        {"kind": "alert_resolved", "rule": "a", "severity": "warn"},
+        {"kind": "alert", "rule": "a", "severity": "warn"},
+        {"kind": "alert", "rule": "b", "severity": "page"},
+        {"kind": "alert_resolved", "rule": "b", "severity": "page"},
+    ]
+    # fire/resolve/REFIRE = still active; b ended resolved.
+    assert [a["rule"] for a in active_alerts(recs)] == ["a"]
+
+
+def test_build_state_and_render_multi_stream(tmp_path):
+    train_stream = [
+        {"kind": "heartbeat", "t": 1.0, "task": 0, "step": 10,
+         "process_id": 0, "phase": "train", "wallclock": 1001.0},
+        {"kind": "train", "t": 2.0, "task": 0, "step": 20, "loss": 0.5,
+         "images_per_sec": 500.0, "device_step_ms": 2.0,
+         "drain_wait_ms": 1.0},
+        {"kind": "goodput", "t": 2.1, "task": 0, "step": 20,
+         "total_s": 2.0, "train_frac": 0.7, "compile_frac": 0.3},
+        {"kind": "elastic_restart", "t": 2.5, "task": 0, "step": 20,
+         "restore_step": 10, "world_size": 2, "epoch": 3,
+         "attempt": 1},
+        {"kind": "alert", "t": 3.0, "task": 0, "rule": "x",
+         "severity": "page", "window": "50 steps", "value": 1.0},
+    ]
+    serve_stream = [
+        {"kind": "serve", "t": 1.0, "task": 1, "qps": 42.0,
+         "p50_ms": 1.0, "p99_ms": 9.0, "completed": 100,
+         "shed_queue": 1, "shed_deadline": 0, "batch_fill": 0.8},
+        {"kind": "serve_done", "t": 2.0, "task": 1, "qps": 42.0},
+    ]
+    state = build_state({"train.jsonl": train_stream,
+                         "serve.jsonl": serve_stream},
+                        now=1005.0)
+    assert state["world_size"] == 2 and state["epoch"] == 3
+    t0, t1 = state["tasks"]
+    assert t0["train"]["step"] == 20 and not t0["finished"]
+    # Aligned age: offset = 1001 - 1 = 1000; last t = 3.0 → age 2.0.
+    assert t0["age_s"] == 2.0
+    assert t1["serve"]["qps"] == 42.0 and t1["finished"]
+    assert t1["age_s"] is None            # no heartbeat: unaligned
+    assert [a["rule"] for a in state["alerts"]] == ["x"]
+    assert not state["finished"]          # one stream still running
+    view = render_view(state)
+    assert "world size 2" in view and "epoch 3" in view
+    assert "step 20" in view and "42.0 qps" in view
+    assert "ACTIVE ALERTS (1)" in view and "[page] x" in view
+    assert "goodput: train 70% compile 30%" in view
+
+
+def test_monitor_scrapes_endpoint_and_renders(tmp_path):
+    reg = MetricsRegistry()
+    reg.gauge("dml_train_step", "s").set(120)
+    reg.gauge("dml_serve_qps", "q").set(33.5)
+    reg.gauge("dml_alert_active", "a",
+              labelnames=("rule", "severity")
+              ).set(1, rule="hbm_headroom", severity="warn")
+    srv = StatsServer(reg, port=0)
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        scrape = scrape_endpoint(url)
+        assert scrape["ok"]
+        state = build_state({}, [scrape])
+        e = state["endpoints"][0]
+        assert e["step"] == 120.0 and e["qps"] == 33.5
+        assert [a["rule"] for a in state["alerts"]] == ["hbm_headroom"]
+        view = render_view(state)
+        assert "step 120" in view and "hbm_headroom" in view
+        # A dead endpoint is a finding, not a crash.
+        dead = scrape_endpoint("http://127.0.0.1:1")
+        assert not dead["ok"]
+        assert "UNREACHABLE" in render_view(build_state({}, [dead]))
+    finally:
+        srv.close()
+
+
+def test_monitor_one_shot_on_finished_run(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    _write(path, [
+        {"kind": "train", "t": 1.0, "task": 0, "step": 10,
+         "loss": 0.1, "images_per_sec": 100.0},
+        {"kind": "done", "t": 2.0, "task": 0, "step": 10,
+         "images_per_sec": 90.0},
+    ])
+    buf = io.StringIO()
+    # No --once flag: the finished stream itself degrades the monitor
+    # to a single snapshot (no refresh loop to kill).
+    assert run_monitor([path], [], refresh_s=0.0, out=buf) == 0
+    v = buf.getvalue()
+    assert "RUN FINISHED" in v and v.count("live run monitor") == 1
+    # --format json emits the state dict verbatim.
+    buf2 = io.StringIO()
+    assert run_monitor([path], [], once=True, fmt="json",
+                       out=buf2) == 0
+    state = json.loads(buf2.getvalue())
+    assert state["finished"] and state["tasks"][0]["train"]["step"] == 10
+
+
+def test_live_monitor_cli_requires_input():
+    import pytest
+
+    from tools import live_monitor
+    with pytest.raises(SystemExit):
+        live_monitor.main([])
+
+
+def test_telemetry_report_follow_tails_growing_stream(tmp_path):
+    """--follow re-renders as the stream grows and exits when the
+    final record lands (shared JsonlTail helper)."""
+    from tools import telemetry_report
+
+    path = str(tmp_path / "m.jsonl")
+    _write(path, [{"kind": "train", "t": 1.0, "task": 0, "step": 10,
+                   "loss": 0.5, "train_accuracy": 0.5,
+                   "images_per_sec": 100.0, "lr": 0.1,
+                   "device_step_ms": None, "drain_wait_ms": None,
+                   "optimizer_ms": None}])
+    buf = io.StringIO()
+    done = threading.Event()
+
+    def grow():
+        time.sleep(0.2)
+        _write(path, [{"kind": "train", "t": 2.0, "task": 0,
+                       "step": 20, "loss": 0.4, "train_accuracy": 0.6,
+                       "images_per_sec": 110.0, "lr": 0.1,
+                       "device_step_ms": None, "drain_wait_ms": None,
+                       "optimizer_ms": None},
+                      {"kind": "done", "t": 3.0, "task": 0, "step": 20,
+                       "images_per_sec": 105.0}])
+        done.set()
+
+    t = threading.Thread(target=grow)
+    t.start()
+    rc = telemetry_report.follow([path], refresh_s=0.1,
+                                 max_refreshes=100, clear=False,
+                                 out=buf)
+    t.join()
+    assert rc == 0 and done.is_set()
+    out = buf.getvalue()
+    # First render saw step 10; a later one saw the grown stream's
+    # final record (which also ended the loop).
+    assert "steps: 10" in out and "steps: 20" in out
+    assert "run-average throughput: 105.0" in out
+
+
+def test_fleet_record_device_ms_from_beats(tmp_path):
+    """ReplicaView carries the beats' device_ms and the router's fleet
+    window records expose it (the PR-8 field, now rendered)."""
+    from dml_cnn_cifar10_tpu.fleet.router import Router
+    from dml_cnn_cifar10_tpu.parallel.cluster import HeartbeatStore
+    from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+
+    fleet_dir = str(tmp_path / "fleet")
+    for rid, dev_ms in ((0, 1.2), (1, 9.8)):
+        HeartbeatStore(fleet_dir, process_id=rid).publish(
+            5, "serve", extra={"replica_id": rid, "version": "1",
+                               "queue_depth": 0, "port": 9000 + rid,
+                               "device_ms": dev_ms})
+    jsonl = str(tmp_path / "router.jsonl")
+    logger = MetricsLogger(jsonl)
+    router = Router(fleet_dir, dead_after_s=60.0, logger=logger)
+    views = {v.replica_id: v for v in router.views()}
+    assert views[0].device_ms == 1.2 and views[1].device_ms == 9.8
+    assert router.healthz()["replicas"]["1"]["device_ms"] == 9.8
+    router.emit(final=True)
+    logger.close()
+    with open(jsonl) as f:
+        recs = [json.loads(line) for line in f]
+    fleet = [r for r in recs if r["kind"] == "fleet"][-1]
+    assert fleet["device_ms"] == {"0": 1.2, "1": 9.8}
+    from tools import check_jsonl_schema, telemetry_report
+    assert check_jsonl_schema.check_file(jsonl) == []
+    out = telemetry_report.summarize(jsonl)
+    assert "per-replica device_ms" in out and "r1: 9.8 ms" in out
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE-11 acceptance smoke (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_supervised_run_serves_live_metrics_and_pairs_alerts(
+        data_cfg, tmp_path):
+    """Supervised CPU sim with an injected non-finite fault and
+    --stats_port: GET /metrics serves valid Prometheus text exposition
+    (step counter, goodput fractions, drain-wait gauge) WHILE the run
+    is live; the nonfinite-burst alert fires and later resolves as
+    paired alert/alert_resolved records; the whole stream passes the
+    schema lint. (The zero-extra-device-fetch contract is pinned
+    separately by test_telemetry's fetch-parity assert.)"""
+    from dml_cnn_cifar10_tpu.train.supervisor import fit_supervised
+    from dml_cnn_cifar10_tpu.utils import metrics_registry
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    cfg = tiny_train_cfg(data_cfg, str(tmp_path), total_steps=80)
+    cfg.output_every = 10
+    cfg.eval_every = 20
+    cfg.checkpoint_every = 10
+    cfg.check_numerics = True
+    cfg.on_nonfinite = "rollback"
+    cfg.fault_spec = "nan@15"
+    cfg.telemetry = True
+    cfg.stats_port = port
+    cfg.metrics_jsonl = os.path.join(str(tmp_path), "m.jsonl")
+
+    result_box = {}
+
+    def run():
+        result_box["result"] = fit_supervised(cfg)
+
+    worker = threading.Thread(target=run)
+    worker.start()
+    live_scrapes = []
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline and worker.is_alive():
+            alive_before = worker.is_alive()
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=2) as resp:
+                    text = resp.read().decode()
+            except OSError:
+                time.sleep(0.1)
+                continue
+            # Only scrapes bracketed by a live worker count as
+            # MID-RUN evidence.
+            if alive_before and worker.is_alive():
+                doc = parse_prometheus_text(text)   # must be valid
+                if "dml_train_step" in doc:
+                    live_scrapes.append(doc)
+            time.sleep(0.2)
+        worker.join(timeout=240)
+    finally:
+        metrics_registry.stop_stats_server()
+    assert not worker.is_alive(), "supervised run never finished"
+    assert result_box["result"].final_step == 80
+
+    # (a) live export: at least one mid-run scrape served the step
+    # counter, the goodput fractions, and the drain-wait gauge.
+    assert live_scrapes, "never scraped /metrics while the run was live"
+    best = live_scrapes[-1]
+    step = best["dml_train_step"]["samples"][()]
+    assert 0 < step <= 80
+    assert best["dml_train_step"]["type"] == "gauge"
+    gp = {labels[0][1]: v for labels, v in
+          best["dml_goodput_fraction"]["samples"].items()}
+    assert "train" in gp and 0.0 <= gp["train"] <= 1.0
+    assert () in best["dml_drain_wait_ms"]["samples"]
+    # The injected fault is live too (counter fed by the stream).
+    assert best["dml_faults_total"]["samples"][
+        (("fault", "nonfinite"),)] >= 1.0
+
+    # (b) the stream is schema-clean with the new kinds present...
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []
+    with open(cfg.metrics_jsonl) as f:
+        recs = [json.loads(line) for line in f]
+    nf_alerts = [r for r in recs if r.get("kind") == "alert"
+                 and r.get("rule") == "nonfinite_burst"]
+    nf_resolved = [r for r in recs if r.get("kind") == "alert_resolved"
+                   and r.get("rule") == "nonfinite_burst"]
+    # ...with the nonfinite-burst alert fired at the fault and
+    # resolved once training progressed a clean window past it.
+    assert len(nf_alerts) == 1 and len(nf_resolved) == 1
+    assert recs.index(nf_alerts[0]) < recs.index(nf_resolved[0])
+    assert nf_alerts[0]["severity"] == "page"
+
+    # (c) the reports surface the alert lifecycle.
+    from tools import telemetry_report
+    out = telemetry_report.summarize(cfg.metrics_jsonl)
+    assert "nonfinite_burst" in out and "resolved" in out
+    j = telemetry_report.summarize_json(cfg.metrics_jsonl)
+    assert j["alerts"]["fired"] >= 1
+    assert all(a["rule"] != "nonfinite_burst"
+               for a in j["alerts"]["active"])
+    # And the live monitor's one-shot degradation renders the run.
+    buf = io.StringIO()
+    assert run_monitor([cfg.metrics_jsonl], [], once=True,
+                       out=buf) == 0
+    assert "FINISHED" in buf.getvalue()

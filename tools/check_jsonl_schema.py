@@ -121,6 +121,17 @@ KIND_KEYS = {
     "devtime": ("step", "device", "total_ms", "compute_ms",
                 "collective_ms", "infeed_ms", "optimizer_ms",
                 "window_ms", "top_ops"),
+    # Streaming alert engine (utils/alerts.py; docs/OBSERVABILITY.md
+    # Alerting section). `alert` fires when a rule's condition holds
+    # (threshold on consecutive records / rate over a trailing
+    # step-or-second window / record absence); `alert_resolved` pairs
+    # it when the signal recovers. Emission is rate-limited per rule,
+    # and a suppressed re-fire suppresses its resolution too, so the
+    # emitted records are strictly paired. `window` is the rule's
+    # window descriptor ("2 consecutive" / "50 steps" / "15s"),
+    # `value` the reading that crossed (or recovered past) the line.
+    "alert": ("rule", "severity", "window", "value"),
+    "alert_resolved": ("rule", "severity", "window", "value"),
     # Serving runtime (serve/metrics.py; docs/SERVING.md). Percentile
     # values are null until the window has completions.
     "serve": ("requests", "completed", "shed_queue", "shed_deadline",

@@ -1,0 +1,396 @@
+#!/usr/bin/env python
+"""Cluster-wide live run monitor: one auto-refreshing terminal view of
+everything the fleet is doing RIGHT NOW.
+
+Every other observability tool here is post-hoc — reports and traces
+read after the run. This one watches a run while it is live, from the
+two surfaces the live layer exports:
+
+- **`GET /metrics` endpoints** (``--endpoints``): the Prometheus-text
+  registries served by ``--stats_port`` (trainer), ``--mode serve``,
+  and the fleet router — scraped each refresh and parsed with the same
+  :func:`~dml_cnn_cifar10_tpu.utils.metrics_registry.parse_prometheus_text`
+  the exposition lint uses.
+- **`--metrics_jsonl` streams** (positional paths): tailed
+  incrementally (:class:`JsonlTail` — shared with
+  ``tools/telemetry_report.py --follow``), each stream aligned onto one
+  clock via its heartbeat wallclock anchors
+  (``tools/trace_aggregate.py``'s alignment, reused).
+
+The view: world size and epoch, per-task step / step rate / goodput
+split, serve QPS/p99 per replica, fleet routing counters, and the
+active alerts (``alert`` records not yet paired with an
+``alert_resolved``). On a FINISHED run (every stream carries its final
+record, no endpoints to poll) it degrades to a one-shot snapshot and
+exits — the same renderer, no refresh loop.
+
+Usage:
+  python tools/live_monitor.py logs_0/m.jsonl logs_1/m.jsonl \\
+      [--endpoints http://host:8080 ...] [--refresh 2] [--once] \\
+      [--format text|json]
+
+Pure seams for tests: :func:`build_state` (records + scrapes → plain
+dict) and :func:`render_view` (dict → text).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dml_cnn_cifar10_tpu.utils.metrics_registry import \
+    parse_prometheus_text  # noqa: E402
+from tools.trace_aggregate import clock_offset  # noqa: E402
+
+#: Record kinds that mark a stream as finished (one-shot degradation).
+FINAL_KINDS = ("done", "serve_done", "fleet_done", "chaos_done")
+
+
+class JsonlTail:
+    """Incremental JSONL reader: each :meth:`poll` returns the records
+    appended since the last one. Tolerates a file that does not exist
+    yet (a worker still warming up) and a partial last line (a writer
+    mid-append) — both simply wait for the next poll. Shared by this
+    monitor and ``telemetry_report.py --follow``."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pos = 0
+        self._partial = ""
+
+    def poll(self) -> List[dict]:
+        try:
+            with open(self.path, "r") as f:
+                f.seek(self._pos)
+                chunk = f.read()
+                self._pos = f.tell()
+        except OSError:
+            return []
+        if not chunk:
+            return []
+        text = self._partial + chunk
+        lines = text.split("\n")
+        # No trailing newline ⇒ the last element is a partial line the
+        # writer has not finished; hold it for the next poll.
+        self._partial = "" if text.endswith("\n") else lines.pop()
+        out = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue   # torn write; the record is lost, not fatal
+        return out
+
+
+def scrape_endpoint(url: str, timeout_s: float = 2.0) -> dict:
+    """One ``GET <url>/metrics`` scrape → ``{"url", "ok", "families"}``
+    (families = parsed exposition doc; ``ok: False`` + ``error`` when
+    the endpoint is unreachable — a dead endpoint is a finding, not a
+    crash)."""
+    target = url.rstrip("/")
+    if not target.endswith("/metrics"):
+        target += "/metrics"
+    try:
+        with urllib.request.urlopen(target, timeout=timeout_s) as resp:
+            text = resp.read().decode("utf-8", errors="replace")
+        return {"url": url, "ok": True,
+                "families": parse_prometheus_text(text)}
+    except Exception as e:
+        return {"url": url, "ok": False, "error": str(e),
+                "families": {}}
+
+
+def _last(records: List[dict], kind: str) -> Optional[dict]:
+    for r in reversed(records):
+        if r.get("kind") == kind:
+            return r
+    return None
+
+
+def active_alerts(records: List[dict]) -> List[dict]:
+    """Alert firings not yet paired with a resolution, in fire order."""
+    active: Dict[str, dict] = {}
+    for r in records:
+        if r.get("kind") == "alert":
+            active[r.get("rule")] = r
+        elif r.get("kind") == "alert_resolved":
+            active.pop(r.get("rule"), None)
+    return list(active.values())
+
+
+def stream_finished(records: List[dict]) -> bool:
+    return any(r.get("kind") in FINAL_KINDS for r in records)
+
+
+def build_state(streams: Dict[str, List[dict]],
+                scrapes: List[dict] = (),
+                now: Optional[float] = None) -> dict:
+    """Fold the tailed streams + endpoint scrapes into one plain-dict
+    view state (JSON-ready — ``--format json`` prints it verbatim)."""
+    now = time.time() if now is None else now
+    tasks = []
+    world_size = None
+    epoch = None
+    alerts: List[dict] = []
+    for path, records in streams.items():
+        offset = clock_offset(records)
+        last_t = max((r.get("t") or 0.0 for r in records), default=None)
+        train = _last(records, "train")
+        serve = _last(records, "serve")
+        fleet = _last(records, "fleet")
+        goodput = _last(records, "goodput")
+        task_ids = [r.get("task") for r in records
+                    if r.get("task") is not None]
+        entry = {
+            "path": path,
+            "task": task_ids[-1] if task_ids else 0,
+            "records": len(records),
+            "finished": stream_finished(records),
+            # Age of the newest record on the shared clock — only
+            # computable for heartbeat-aligned streams.
+            "age_s": (round(now - (offset + last_t), 1)
+                      if offset is not None and last_t is not None
+                      else None),
+        }
+        if train:
+            entry["train"] = {
+                k: train.get(k)
+                for k in ("step", "loss", "images_per_sec",
+                          "device_step_ms", "drain_wait_ms")}
+        if goodput:
+            entry["goodput"] = {
+                k[:-len("_frac")]: v for k, v in goodput.items()
+                if k.endswith("_frac")}
+        if serve:
+            entry["serve"] = {
+                k: serve.get(k)
+                for k in ("qps", "p50_ms", "p99_ms", "completed",
+                          "shed_queue", "shed_deadline", "batch_fill")}
+        if fleet:
+            entry["fleet"] = {
+                k: fleet.get(k)
+                for k in ("replicas", "live", "routed", "rerouted",
+                          "evictions", "shed", "device_ms")}
+        tasks.append(entry)
+        for decision_kind in ("elastic_expand", "elastic_restart"):
+            d = _last(records, decision_kind)
+            if d and (epoch is None or (d.get("epoch") or 0) > epoch):
+                epoch = d.get("epoch")
+                world_size = d.get("world_size")
+        for a in active_alerts(records):
+            alerts.append({"path": path, "rule": a.get("rule"),
+                           "severity": a.get("severity"),
+                           "value": a.get("value"),
+                           "window": a.get("window")})
+    if world_size is None and tasks:
+        # No restart decisions yet: approximate the world as the
+        # distinct task indices observed across the streams.
+        world_size = len({t["task"] for t in tasks})
+    endpoints = []
+    for s in scrapes:
+        e = {"url": s.get("url"), "ok": s.get("ok", False)}
+        if not s.get("ok"):
+            e["error"] = s.get("error")
+        fam = s.get("families") or {}
+
+        def sample(name):
+            f = fam.get(name)
+            if not f or not f.get("samples"):
+                return None
+            return next(iter(f["samples"].values()))
+
+        for name, key in (("dml_train_step", "step"),
+                          ("dml_train_images_per_sec", "images_per_sec"),
+                          ("dml_serve_qps", "qps"),
+                          ("dml_serve_p99_ms", "p99_ms"),
+                          ("dml_fleet_live_replicas", "live_replicas"),
+                          ("dml_cluster_world_size", "world_size")):
+            v = sample(name)
+            if v is not None:
+                e[key] = v
+        fam_active = fam.get("dml_alert_active", {}).get("samples", {})
+        firing = [dict(labels) for labels, v in fam_active.items()
+                  if v == 1.0]
+        if firing:
+            e["alerts"] = firing
+            for a in firing:
+                alerts.append({"path": s.get("url"),
+                               "rule": a.get("rule"),
+                               "severity": a.get("severity"),
+                               "value": None, "window": None})
+        endpoints.append(e)
+    return {
+        "now_unix": round(now, 3),
+        "world_size": world_size,
+        "epoch": epoch,
+        "tasks": sorted(tasks, key=lambda t: (t["task"], t["path"])),
+        "endpoints": endpoints,
+        "alerts": alerts,
+        "finished": bool(tasks) and all(t["finished"] for t in tasks),
+    }
+
+
+def _fmt(v, digits=2):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{digits}f}"
+    return str(v)
+
+
+def render_view(state: dict) -> str:
+    """The terminal view (pure: state dict → text)."""
+    lines = []
+    head = "== live run monitor"
+    if state.get("world_size") is not None:
+        head += f" · world size {state['world_size']}"
+    if state.get("epoch") is not None:
+        head += f" · epoch {state['epoch']}"
+    if state.get("finished"):
+        head += " · RUN FINISHED (one-shot view)"
+    lines.append(head + " ==")
+    for t in state.get("tasks", []):
+        age = f" ({t['age_s']}s ago)" if t.get("age_s") is not None \
+            else ""
+        lines.append(f"  task {t['task']} [{t['path']}] "
+                     f"{t['records']} records"
+                     f"{' FINISHED' if t['finished'] else ''}{age}")
+        tr = t.get("train")
+        if tr:
+            lines.append(
+                f"    train: step {tr.get('step')}, "
+                f"{_fmt(tr.get('images_per_sec'), 1)} img/s, loss "
+                f"{_fmt(tr.get('loss'), 4)}, device step "
+                f"{_fmt(tr.get('device_step_ms'))} ms "
+                f"(drain-wait {_fmt(tr.get('drain_wait_ms'))} ms)")
+        gp = t.get("goodput")
+        if gp:
+            split = " ".join(
+                f"{cat} {100 * (gp.get(cat) or 0):.0f}%"
+                for cat in ("train", "compile", "data", "eval",
+                            "checkpoint", "sync") if cat in gp)
+            lines.append(f"    goodput: {split}")
+        sv = t.get("serve")
+        if sv:
+            lines.append(
+                f"    serve: {_fmt(sv.get('qps'), 1)} qps, p50/p99 "
+                f"{_fmt(sv.get('p50_ms'))}/{_fmt(sv.get('p99_ms'))} ms,"
+                f" shed {(sv.get('shed_queue') or 0) + (sv.get('shed_deadline') or 0)},"
+                f" fill {_fmt(sv.get('batch_fill'))}")
+        fl = t.get("fleet")
+        if fl:
+            lines.append(
+                f"    fleet: {fl.get('live')}/{fl.get('replicas')} "
+                f"live, routed {fl.get('routed')} "
+                f"(re-routed {fl.get('rerouted')}), evictions "
+                f"{fl.get('evictions')}, shed {fl.get('shed')}")
+            if fl.get("device_ms"):
+                per = ", ".join(f"r{rid} {_fmt(ms, 1)} ms" for rid, ms
+                                in sorted(fl["device_ms"].items()))
+                lines.append(f"    fleet device_ms: {per}")
+    for e in state.get("endpoints", []):
+        if not e.get("ok"):
+            lines.append(f"  endpoint {e['url']}: UNREACHABLE "
+                         f"({e.get('error')})")
+            continue
+        bits = []
+        for key, label in (("step", "step"),
+                           ("images_per_sec", "img/s"),
+                           ("qps", "qps"), ("p99_ms", "p99 ms"),
+                           ("live_replicas", "live replicas"),
+                           ("world_size", "world")):
+            if key in e:
+                bits.append(f"{label} {_fmt(e[key], 1)}")
+        lines.append(f"  endpoint {e['url']}: "
+                     + (", ".join(bits) if bits else "up"))
+    alerts = state.get("alerts", [])
+    if alerts:
+        lines.append(f"  ACTIVE ALERTS ({len(alerts)}):")
+        for a in alerts:
+            lines.append(
+                f"    [{a.get('severity')}] {a.get('rule')} "
+                f"value={_fmt(a.get('value'), 4)} "
+                f"window={a.get('window')} ({a.get('path')})")
+    else:
+        lines.append("  no active alerts")
+    return "\n".join(lines)
+
+
+def run_monitor(paths: List[str], endpoints: List[str],
+                refresh_s: float = 2.0, once: bool = False,
+                max_refreshes: Optional[int] = None,
+                fmt: str = "text", out=None) -> int:
+    """The monitor loop. ``once`` (or a finished run with no
+    endpoints) renders a single snapshot; ``max_refreshes`` bounds the
+    loop for tests/batch use."""
+    out = sys.stdout if out is None else out
+    tails = {p: JsonlTail(p) for p in paths}
+    streams: Dict[str, List[dict]] = {p: [] for p in paths}
+    n = 0
+    while True:
+        for p, tail in tails.items():
+            streams[p].extend(tail.poll())
+        scrapes = [scrape_endpoint(u) for u in endpoints]
+        state = build_state(streams, scrapes)
+        if fmt == "json":
+            print(json.dumps(state), file=out)
+        else:
+            if not once and out is sys.stdout and n > 0:
+                out.write("\x1b[2J\x1b[H")   # clear + home
+            print(render_view(state), file=out)
+        n += 1
+        done = once \
+            or (state["finished"] and not endpoints and paths) \
+            or (max_refreshes is not None and n >= max_refreshes)
+        if done:
+            return 0
+        try:
+            time.sleep(refresh_s)
+        except KeyboardInterrupt:
+            return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="auto-refreshing live view over --metrics_jsonl "
+                    "streams and GET /metrics endpoints")
+    p.add_argument("streams", nargs="*",
+                   help="--metrics_jsonl files to tail (they may not "
+                        "exist yet — workers still warming up)")
+    p.add_argument("--endpoints", nargs="*", default=[],
+                   help="base URLs serving GET /metrics "
+                        "(--stats_port trainers, serve servers, fleet "
+                        "routers)")
+    p.add_argument("--refresh", type=float, default=2.0,
+                   help="seconds between refreshes")
+    p.add_argument("--once", action="store_true",
+                   help="render one snapshot and exit (automatic when "
+                        "every stream is finished and there are no "
+                        "endpoints)")
+    p.add_argument("--max-refreshes", type=int, default=None,
+                   help="stop after N refreshes (batch/test use)")
+    p.add_argument("--format", choices=("text", "json"),
+                   default="text")
+    args = p.parse_args(argv)
+    if not args.streams and not args.endpoints:
+        p.error("nothing to watch: give JSONL stream paths and/or "
+                "--endpoints")
+    return run_monitor(args.streams, args.endpoints,
+                       refresh_s=args.refresh, once=args.once,
+                       max_refreshes=args.max_refreshes,
+                       fmt=args.format)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

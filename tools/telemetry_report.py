@@ -22,7 +22,12 @@ Reads the stream written by ``--metrics_jsonl`` (schema:
 Usage: ``python tools/telemetry_report.py run.jsonl [more.jsonl ...]``
 ``--format json`` emits the same summary as one machine-readable JSON
 document (``summarize_json``) for the perf gate / CI; the text renderer
-stays the default.
+stays the default. ``--follow`` switches to an incremental tail mode
+that re-renders the summary as the stream grows (shared tailing helper
+with ``tools/live_monitor.py``), exiting when the run's final record
+lands. An alerts section reports what fired/resolved while the run was
+live (``utils/alerts.py``) and which rules were still firing at stream
+end.
 """
 
 from __future__ import annotations
@@ -30,6 +35,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -152,8 +158,15 @@ def _fmt_bytes(n: Optional[int]) -> str:
 
 
 def summarize(path: str) -> str:
-    records = load_records(path)
-    lines = [f"== {path} =="]
+    return summarize_records(load_records(path), path)
+
+
+def summarize_records(records: List[dict], header: str) -> str:
+    """The report body over an in-memory record list — the seam
+    ``--follow`` re-renders from as the stream grows (no re-reading
+    the whole file per refresh) and ``summarize`` wraps for the
+    one-shot path."""
+    lines = [f"== {header} =="]
     if not records:
         return "\n".join(lines + ["  (no records)"])
 
@@ -360,6 +373,46 @@ def summarize(path: str) -> str:
             lines.append(
                 f"    autoscale {r.get('action')} "
                 f"({r.get('reason')}) -> {r.get('replicas')} worker(s)")
+        # Per-replica device time, from the newest fleet window that
+        # carries the beats' advertised device_ms: a replica whose
+        # device_ms is ~uniform with its peers but whose queue is deep
+        # is overloaded (scale up); one whose device_ms is the outlier
+        # is a slow DEVICE (drain + replace) — visible here without
+        # raw beat-file spelunking.
+        dev_rows = [r for r in fleets + ([fleet_done] if fleet_done
+                                         else [])
+                    if r.get("device_ms")]
+        if dev_rows:
+            per = ", ".join(
+                f"r{rid}: {ms} ms" for rid, ms in
+                sorted(dev_rows[-1]["device_ms"].items()))
+            lines.append(f"    per-replica device_ms (beats, last "
+                         f"window): {per}")
+    # Alerting (utils/alerts.py; docs/OBSERVABILITY.md Alerting
+    # section): what fired while the run was live, what resolved, and
+    # what was STILL firing when the stream ended — the post-hoc view
+    # of the live alert state.
+    alert_recs = [r for r in records if r.get("kind") == "alert"]
+    resolved_recs = [r for r in records
+                     if r.get("kind") == "alert_resolved"]
+    if alert_recs or resolved_recs:
+        lines.append(f"  alerts: {len(alert_recs)} fired, "
+                     f"{len(resolved_recs)} resolved")
+        # Sequential pairing (fire/resolve/fire again = active): the
+        # rules still firing are the ones whose LAST event is a fire.
+        still_active = {}
+        for r in records:
+            if r.get("kind") == "alert":
+                still_active[r.get("rule")] = r
+            elif r.get("kind") == "alert_resolved":
+                still_active.pop(r.get("rule"), None)
+        for r in alert_recs:
+            state = "STILL ACTIVE at stream end" \
+                if still_active.get(r.get("rule")) is r else "resolved"
+            lines.append(
+                f"    [{r.get('severity')}] {r.get('rule')} fired at "
+                f"t={r.get('t')}s (value {r.get('value')}, window "
+                f"{r.get('window')}) — {state}")
     # Resilience events (docs/RESILIENCE.md): how many faults the run
     # absorbed, and what the recovery path did about them.
     faults = [r for r in records if r.get("kind") == "fault"]
@@ -606,6 +659,24 @@ def summarize_json(path: str) -> dict:
                  "reproducer": r.get("reproducer")}
                 for r in chaos_runs if not r.get("ok")],
         }
+    alert_recs = [r for r in records if r.get("kind") == "alert"]
+    resolved_recs = [r for r in records
+                     if r.get("kind") == "alert_resolved"]
+    if alert_recs or resolved_recs:
+        still_active = {}
+        for r in records:
+            if r.get("kind") == "alert":
+                still_active[r.get("rule")] = r
+            elif r.get("kind") == "alert_resolved":
+                still_active.pop(r.get("rule"), None)
+        out["alerts"] = {
+            "fired": len(alert_recs),
+            "resolved": len(resolved_recs),
+            "active": [
+                {"rule": r.get("rule"), "severity": r.get("severity"),
+                 "value": r.get("value"), "window": r.get("window")}
+                for r in still_active.values()],
+        }
     faults = [r for r in records if r.get("kind") == "fault"]
     recoveries = [r for r in records if r.get("kind") == "recovery"]
     if faults or recoveries:
@@ -644,9 +715,45 @@ def summarize_json(path: str) -> dict:
     return out
 
 
+def follow(paths: List[str], refresh_s: float = 2.0,
+           max_refreshes: Optional[int] = None, clear: bool = True,
+           out=None) -> int:
+    """Incremental tail mode (``--follow``): re-render the summary as
+    the JSONL streams grow, sharing the live monitor's tailing helper
+    (``tools/live_monitor.py``). Exits when every stream has flushed
+    its final record (``done``/``serve_done``/``fleet_done``), on
+    Ctrl-C, or after ``max_refreshes`` (test/batch bound)."""
+    from tools.live_monitor import FINAL_KINDS, JsonlTail
+    out = sys.stdout if out is None else out
+    tails = {p: JsonlTail(p) for p in paths}
+    records = {p: [] for p in paths}
+    n = 0
+    while True:
+        for p, tail in tails.items():
+            records[p].extend(tail.poll())
+        if clear and n > 0 and out is sys.stdout:
+            out.write("\x1b[2J\x1b[H")
+        for p in paths:
+            print(summarize_records(records[p],
+                                    f"{p} (following)"), file=out)
+        n += 1
+        finished = all(
+            any(r.get("kind") in FINAL_KINDS for r in records[p])
+            for p in paths) and paths
+        if finished or (max_refreshes is not None
+                        and n >= max_refreshes):
+            return 0
+        try:
+            time.sleep(refresh_s)
+        except KeyboardInterrupt:
+            return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     fmt = "text"
+    usage = ("usage: telemetry_report.py [--format text|json] "
+             "[--follow [--refresh S]] run.jsonl [more.jsonl ...]")
     if "--format" in argv:
         i = argv.index("--format")
         try:
@@ -655,13 +762,28 @@ def main(argv=None) -> int:
             fmt = ""
         del argv[i:i + 2]
         if fmt not in ("text", "json"):
-            print("usage: telemetry_report.py [--format text|json] "
-                  "run.jsonl [more.jsonl ...]")
+            print(usage)
             return 2
+    follow_mode = "--follow" in argv
+    if follow_mode:
+        argv.remove("--follow")
+    refresh_s = 2.0
+    if "--refresh" in argv:
+        i = argv.index("--refresh")
+        try:
+            refresh_s = float(argv[i + 1])
+        except (IndexError, ValueError):
+            print(usage)
+            return 2
+        del argv[i:i + 2]
     if not argv:
-        print("usage: telemetry_report.py [--format text|json] "
-              "run.jsonl [more.jsonl ...]")
+        print(usage)
         return 2
+    if follow_mode:
+        if fmt != "text":
+            print("--follow renders text only")
+            return 2
+        return follow(argv, refresh_s=refresh_s)
     if fmt == "json":
         docs = [summarize_json(path) for path in argv]
         print(json.dumps(docs[0] if len(docs) == 1
