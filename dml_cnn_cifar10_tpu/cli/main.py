@@ -128,6 +128,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="p99 latency objective in ms; the fleet "
                         "autoscaler scales up while the replicas' p99 "
                         "sits above it (declarative elsewhere)")
+    p.add_argument("--trace_sample_rate", type=float, default=0.0,
+                   help="distributed request tracing: head-sample this "
+                        "fraction of serving requests at the trace root "
+                        "(client or first hop) and emit one `rspan` "
+                        "JSONL record per hop; shed or retried requests "
+                        "are always captured regardless of the rate "
+                        "(docs/OBSERVABILITY.md Request-tracing)")
     p.add_argument("--fleet_min_replicas", type=int, default=2,
                    help="serving-fleet floor: the pool starts this many "
                         "workers and a fleet below it always scales "
@@ -519,6 +526,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(@Ns). Firing emits rate-limited alert/"
                         "alert_resolved JSONL records "
                         "(docs/OBSERVABILITY.md)")
+    p.add_argument("--postmortem_dir", type=str, default=None,
+                   help="arm the alert-triggered flight recorder: keep "
+                        "a bounded in-memory ring of the last "
+                        "--flightrec_size metrics records and, when a "
+                        "streaming alert fires, write an atomic "
+                        "post-mortem bundle (ring + alert + config + "
+                        "env + live context) under this directory — one "
+                        "bundle per alert firing. Render with "
+                        "tools/postmortem.py (docs/OBSERVABILITY.md)")
+    p.add_argument("--flightrec_size", type=int, default=256,
+                   help="flight-recorder ring capacity in records "
+                        "(per process; needs --postmortem_dir)")
     p.add_argument("--telemetry", type="bool", default=False,
                    help="run-health telemetry: host-loop span tracing, "
                         "goodput fractions, and HBM snapshots emitted "
@@ -729,6 +748,9 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.serve.metrics_every_s = args.serve_metrics_every_s
     cfg.serve.drain_deadline_s = args.serve_drain_deadline_s
     cfg.serve.slo_ms = args.serve_slo_ms
+    cfg.serve.trace_sample_rate = args.trace_sample_rate
+    cfg.postmortem_dir = args.postmortem_dir
+    cfg.flightrec_size = args.flightrec_size
     if args.fleet_min_replicas < 1 \
             or args.fleet_max_replicas < args.fleet_min_replicas:
         raise SystemExit(

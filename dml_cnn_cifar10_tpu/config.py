@@ -414,6 +414,13 @@ class ServeConfig:
     # autoscaler treats a p99 above it as a scale-up signal
     # (fleet/autoscaler.py). None = no objective.
     slo_ms: Optional[float] = None
+    # Head-sampling rate for distributed request tracing
+    # (utils/reqtrace.py; docs/OBSERVABILITY.md request-tracing
+    # section): this fraction of requests emit one `rspan` JSONL
+    # record per hop (client, router attempt, worker, batcher queue,
+    # engine dispatch, batch). Shed or retried requests are
+    # force-sampled regardless. 0 = off.
+    trace_sample_rate: float = 0.0
 
 
 @dataclasses.dataclass
@@ -602,6 +609,16 @@ class TrainConfig:
     # LRU size bound for the cache directory, applied after each store.
     compile_cache_max_bytes: int = 2_000_000_000
     metrics_jsonl: Optional[str] = None   # structured metrics sink
+    # Alert-triggered flight recorder (utils/flightrec.py;
+    # docs/OBSERVABILITY.md flight-recorder section). postmortem_dir
+    # arms it: a bounded in-memory ring of the last flightrec_size
+    # records (fed from the logger's observer hook — zero new
+    # instrumentation) is snapshotted into an atomic post-mortem
+    # bundle directory whenever a streaming alert FIRES, one bundle
+    # per firing (suppressed re-fires capture nothing). Training
+    # captures also arm a one-shot devprof window. None = off.
+    postmortem_dir: Optional[str] = None
+    flightrec_size: int = 256
     # Live metrics export (utils/metrics_registry.py;
     # docs/OBSERVABILITY.md "Live metrics"): serve `GET /metrics`
     # (Prometheus text exposition of the process-local registry) from a

@@ -160,7 +160,8 @@ class FleetController:
             dead_after_s=cfg.fleet.replica_dead_after_s,
             route_retries=cfg.fleet.route_retries,
             route_timeout_s=cfg.fleet.route_timeout_s,
-            logger=logger)
+            logger=logger,
+            trace_sample_rate=cfg.serve.trace_sample_rate)
         config_path = os.path.join(self.fleet_dir, "worker_config.json")
         from dml_cnn_cifar10_tpu.config import config_to_dict
         worker_cfg = config_to_dict(cfg)

@@ -43,6 +43,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence
 
 from dml_cnn_cifar10_tpu.parallel.cluster import HeartbeatStore
+from dml_cnn_cifar10_tpu.utils import reqtrace
 
 
 @dataclasses.dataclass
@@ -175,7 +176,8 @@ class Router:
 
     def __init__(self, fleet_dir: str, dead_after_s: float = 3.0,
                  route_retries: int = 3, route_timeout_s: float = 30.0,
-                 logger=None, host: str = "127.0.0.1"):
+                 logger=None, host: str = "127.0.0.1",
+                 trace_sample_rate: float = 0.0):
         # process_id -1: the router reads every beat but publishes none.
         self.store = HeartbeatStore(fleet_dir, process_id=-1)
         self.dead_after_s = dead_after_s
@@ -183,6 +185,7 @@ class Router:
         self.route_timeout_s = route_timeout_s
         self.logger = logger
         self.host = host
+        self.trace_sample_rate = float(trace_sample_rate)
         self.metrics = RouterMetrics()
         self._lock = threading.Lock()
         self._rr = 0
@@ -243,7 +246,8 @@ class Router:
 
     # -- the proxy ------------------------------------------------------
 
-    def proxy_predict(self, body: bytes) -> tuple:
+    def proxy_predict(self, body: bytes,
+                      trace_header: Optional[str] = None) -> tuple:
         """Route one request; returns ``(status, payload_dict)``.
 
         Worker failure at the socket (refused / reset mid-read /
@@ -251,7 +255,21 @@ class Router:
         next pick — the re-route that turns a worker kill into zero
         client errors. Worker 4xx/5xx HTTP answers pass through (they
         are the worker speaking, not dying).
+
+        Tracing: one ``rspan`` per placement ATTEMPT, buffered until
+        the request resolves — a retry or a shed forces the trace, and
+        the buffer means the attempts BEFORE the forcing event (the one
+        that landed on the soon-dead worker) still make the stream.
         """
+        ctx = reqtrace.parse(trace_header, self.trace_sample_rate)
+        attempts: list = []
+
+        def _flush_spans():
+            for a in attempts:
+                reqtrace.emit_span(self.logger, ctx, "router",
+                                   a.pop("dur_s"), a.pop("wallclock"),
+                                   **a)
+
         tried: set = set()
         for attempt in range(self.route_retries + 1):
             with self._lock:
@@ -260,18 +278,32 @@ class Router:
             target = pick_replica(self.live(extra_exclude=tried), rr)
             if target is None:
                 self.metrics.record_shed()
+                ctx.force()
+                _flush_spans()
+                reqtrace.emit_span(self.logger, ctx, "router", 0.0,
+                                   time.time(), attempt=attempt,
+                                   shed="no_live_replicas")
                 return 503, {"shed": "no_live_replicas"}
             if attempt:
                 self.metrics.record_rerouted()
             req = urllib.request.Request(
                 f"http://{self.host}:{target.port}/predict", data=body,
-                headers={"Content-Type": "application/octet-stream"})
+                headers={"Content-Type": "application/octet-stream",
+                         reqtrace.TRACE_HEADER: ctx.header()})
+            t0 = time.perf_counter()
             try:
                 with urllib.request.urlopen(
                         req, timeout=self.route_timeout_s) as resp:
                     payload = json.loads(resp.read())
                 self.metrics.record_routed(payload.get("version"))
                 payload["replica_id"] = target.replica_id
+                attempts.append(
+                    {"dur_s": time.perf_counter() - t0,
+                     "wallclock": reqtrace.wallclock_at(t0),
+                     "attempt": attempt, "status": 200,
+                     "replica_id": target.replica_id,
+                     "version": payload.get("version")})
+                _flush_spans()
                 return 200, payload
             except urllib.error.HTTPError as e:
                 # The worker answered: shed/size errors pass through
@@ -282,15 +314,36 @@ class Router:
                     payload = {"error": f"worker http {e.code}"}
                 if e.code == 503:
                     self.metrics.record_shed()
+                    ctx.force()
+                attempts.append(
+                    {"dur_s": time.perf_counter() - t0,
+                     "wallclock": reqtrace.wallclock_at(t0),
+                     "attempt": attempt, "status": e.code,
+                     "replica_id": target.replica_id})
+                _flush_spans()
                 return e.code, payload
             except (urllib.error.URLError, http.client.HTTPException,
                     ConnectionError, TimeoutError, OSError):
                 # The worker DIED mid-conversation (or never answered):
-                # evict and re-route this same request.
+                # evict and re-route this same request. Force the trace
+                # — a retried request is exactly what tracing is for —
+                # and buffer the failed attempt's span (it shows the
+                # placement on the dead worker).
+                ctx.force()
+                attempts.append(
+                    {"dur_s": time.perf_counter() - t0,
+                     "wallclock": reqtrace.wallclock_at(t0),
+                     "attempt": attempt, "status": 0,
+                     "replica_id": target.replica_id,
+                     "error": "connect_error"})
                 tried.add(target.replica_id)
                 self.evict(target.replica_id,
                            "replica_evicted_connect_error")
         self.metrics.record_shed()
+        ctx.force()
+        _flush_spans()
+        reqtrace.emit_span(self.logger, ctx, "router", 0.0, time.time(),
+                           shed="route_retries_exhausted")
         return 503, {"shed": "route_retries_exhausted"}
 
     def healthz(self) -> dict:
@@ -322,14 +375,16 @@ class Router:
         live = self.live()
         device_ms = {str(v.replica_id): v.device_ms for v in live
                      if v.device_ms is not None}
+        # wallclock: the clock-alignment anchor for streams with no
+        # heartbeat records (tools/trace_aggregate.py falls back to it).
         self.logger.log("fleet",
                         **self.metrics.window(len(views), len(live)),
-                        device_ms=device_ms)
+                        device_ms=device_ms, wallclock=time.time())
         if final:
             self.logger.log("fleet_done",
                             **self.metrics.cumulative(len(views),
                                                       len(live)),
-                            device_ms=device_ms)
+                            device_ms=device_ms, wallclock=time.time())
 
     # -- HTTP shell -----------------------------------------------------
 
@@ -375,7 +430,9 @@ class Router:
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 n = int(self.headers.get("Content-Length", 0))
-                code, payload = router.proxy_predict(self.rfile.read(n))
+                code, payload = router.proxy_predict(
+                    self.rfile.read(n),
+                    trace_header=self.headers.get(reqtrace.TRACE_HEADER))
                 self._reply(code, payload)
 
         return Handler

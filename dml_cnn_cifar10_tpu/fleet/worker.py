@@ -216,6 +216,19 @@ def main_worker(cfg, replica_id: int, fault: Optional[str] = None,
     # replica's stream — which the controller's signal aggregation and
     # the live monitor already tail.
     from dml_cnn_cifar10_tpu.utils import alerts as alerts_lib
+    from dml_cnn_cifar10_tpu.utils.flightrec import FlightRecorder
+    # Flight recorder first (observers run in attach order — the record
+    # that trips an alert must be ringed before the capture fires); the
+    # engine doesn't exist yet, so context goes through a holder.
+    holder: dict = {}
+    flightrec = FlightRecorder.from_config(
+        cfg, context_fn=lambda: {
+            "active_version": getattr(holder.get("engine"), "version",
+                                      None),
+            "replica_id": replica_id},
+        logger=logger)
+    if flightrec is not None:
+        logger.add_observer(flightrec.observer())
     alert_engine = alerts_lib.AlertEngine.from_config(cfg)
     if alert_engine is not None:
         logger.add_observer(alert_engine.observer(logger))
@@ -247,6 +260,7 @@ def main_worker(cfg, replica_id: int, fault: Optional[str] = None,
         trainer.model_def, cfg.model, cfg.data, params, mstate,
         compile_cache=trainer.compile_cache, logger=logger,
         version=version, replica_id=replica_id)
+    holder["engine"] = engine
 
     store = HeartbeatStore(fleet_dir, process_id=replica_id)
     phase_ref = {"phase": "warmup"}
@@ -266,7 +280,7 @@ def main_worker(cfg, replica_id: int, fault: Optional[str] = None,
         batch_window_s=serve_cfg.batch_window_ms / 1e3,
         default_deadline_s=None if serve_cfg.deadline_ms is None
         else serve_cfg.deadline_ms / 1e3,
-        metrics=metrics)
+        metrics=metrics, logger=logger)
     beats = _BeatPublisher(store, batcher, engine,
                            cfg.fleet.heartbeat_interval_s, port_ref,
                            phase_ref)
@@ -274,7 +288,9 @@ def main_worker(cfg, replica_id: int, fault: Optional[str] = None,
 
     server = ThreadingHTTPServer(
         ("", serve_cfg.port),
-        _make_handler(batcher, metrics, replica_id=replica_id))
+        _make_handler(batcher, metrics, replica_id=replica_id,
+                      hop="worker", logger=logger,
+                      sample_rate=serve_cfg.trace_sample_rate))
     port_ref["port"] = server.server_address[1]
     watcher = _SwapWatcher(fleet_dir, engine, trainer, state,
                            cfg.fleet.swap_poll_s, last_seq,

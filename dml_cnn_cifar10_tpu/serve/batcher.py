@@ -32,6 +32,7 @@ occupying device lanes nobody is waiting for.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -42,6 +43,7 @@ import numpy as np
 
 from dml_cnn_cifar10_tpu.serve.engine import ServingEngine
 from dml_cnn_cifar10_tpu.serve.metrics import ServeMetrics
+from dml_cnn_cifar10_tpu.utils import reqtrace
 
 
 class ShedError(RuntimeError):
@@ -71,13 +73,14 @@ def _versioned_row(row, version) -> VersionedLogits:
 
 
 class _Request:
-    __slots__ = ("image", "future", "t_enqueue", "deadline")
+    __slots__ = ("image", "future", "t_enqueue", "deadline", "trace")
 
-    def __init__(self, image, future, t_enqueue, deadline):
+    def __init__(self, image, future, t_enqueue, deadline, trace=None):
         self.image = image
         self.future = future
         self.t_enqueue = t_enqueue
         self.deadline = deadline
+        self.trace = trace
 
 
 class MicroBatcher:
@@ -97,7 +100,8 @@ class MicroBatcher:
                  batch_window_s: float = 0.002,
                  default_deadline_s: Optional[float] = None,
                  metrics: Optional[ServeMetrics] = None,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 logger=None):
         bs = [int(b) for b in buckets]
         if not bs or any(b <= 0 for b in bs) or sorted(set(bs)) != bs:
             raise ValueError(
@@ -107,6 +111,7 @@ class MicroBatcher:
         self.batch_window_s = float(batch_window_s)
         self.default_deadline_s = default_deadline_s
         self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.logger = logger
         self._q: "queue.Queue[_Request]" = queue.Queue(
             maxsize=int(max_queue_depth))
         self._stop = threading.Event()
@@ -121,11 +126,13 @@ class MicroBatcher:
     # --- client side ---
 
     def submit(self, image: np.ndarray,
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               trace: Optional[reqtrace.TraceContext] = None) -> Future:
         """Enqueue one ``uint8 [H, W, C]`` image; returns a Future of
         its ``[K]`` logits row. Raises :class:`ShedError` immediately
         when the queue is at depth (admission control) or the server is
-        stopping."""
+        stopping. ``trace`` is the request's trace context; sheds force
+        it so the interesting requests appear even at sample rate 0."""
         image = np.asarray(image)
         if image.shape != self.engine.image_shape \
                 or image.dtype != np.uint8:
@@ -137,11 +144,16 @@ class MicroBatcher:
         now = time.perf_counter()
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
         req = _Request(image, Future(), now,
-                       None if dl is None else now + dl)
+                       None if dl is None else now + dl, trace)
         try:
             self._q.put_nowait(req)
         except queue.Full:
             self.metrics.record_shed("queue_full")
+            if trace is not None:
+                trace.force()
+                reqtrace.emit_span(self.logger, trace, "batcher", 0.0,
+                                   reqtrace.wallclock_at(now),
+                                   shed="queue_full")
             raise ShedError("queue_full") from None
         self.metrics.record_submit()
         return req.future
@@ -227,6 +239,13 @@ class MicroBatcher:
         for r in batch:
             if r.deadline is not None and t_start > r.deadline:
                 self.metrics.record_shed("deadline")
+                if r.trace is not None:
+                    r.trace.force()
+                    reqtrace.emit_span(
+                        self.logger, r.trace, "batcher",
+                        t_start - r.t_enqueue,
+                        reqtrace.wallclock_at(r.t_enqueue),
+                        shed="deadline")
                 r.future.set_exception(ShedError("deadline"))
             else:
                 live.append(r)
@@ -255,6 +274,30 @@ class MicroBatcher:
             return
         self.metrics.record_batch(bucket, len(live), device_s)
         t_done = time.perf_counter()
+        emitting = [r for r in live
+                    if r.trace is not None and r.trace.emit]
+        if emitting and self.logger is not None:
+            # One batch span causally linked (via batch_id) to its N
+            # member spans: the coalescing penalty each member paid in
+            # the queue is visible per request, while the batch span
+            # carries the shared device context once.
+            batch_id = os.urandom(4).hex()
+            reqtrace.emit_span(
+                self.logger,
+                reqtrace.TraceContext(batch_id, True), "batch",
+                t_done - t_start, reqtrace.wallclock_at(t_start),
+                n=len(live), bucket=bucket,
+                device_ms=round(device_s * 1e3, 3), version=version)
+            for r in emitting:
+                reqtrace.emit_span(
+                    self.logger, r.trace, "batcher",
+                    t_start - r.t_enqueue,
+                    reqtrace.wallclock_at(r.t_enqueue),
+                    batch_id=batch_id, version=version)
+                reqtrace.emit_span(
+                    self.logger, r.trace, "engine", device_s,
+                    reqtrace.wallclock_at(t_start),
+                    batch_id=batch_id, version=version)
         for i, r in enumerate(live):
             self.metrics.record_done(t_done - r.t_enqueue,
                                      t_start - r.t_enqueue)

@@ -153,8 +153,13 @@ class ServeMetrics:
         cumulative ``serve_done``) through ``MetricsLogger``."""
         if logger is None:
             return
-        logger.log("serve", **self.window(reset=True))
+        # wallclock: serve-only streams have no heartbeat records, so
+        # these windows are the clock-alignment anchor that lets
+        # tools/trace_aggregate.py place this stream on the merged
+        # timeline.
+        logger.log("serve", **self.window(reset=True),
+                   wallclock=time.time())
         if final:
             done = self.cumulative()
             done["total_s"] = done.pop("window_s")
-            logger.log("serve_done", **done)
+            logger.log("serve_done", **done, wallclock=time.time())

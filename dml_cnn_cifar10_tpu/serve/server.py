@@ -35,10 +35,12 @@ from typing import Optional
 from dml_cnn_cifar10_tpu.serve.batcher import MicroBatcher, ShedError
 from dml_cnn_cifar10_tpu.serve.engine import ServingEngine
 from dml_cnn_cifar10_tpu.serve.metrics import ServeMetrics
+from dml_cnn_cifar10_tpu.utils import reqtrace
 
 
 def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics,
-                  replica_id: int = 0):
+                  replica_id: int = 0, hop: str = "server",
+                  logger=None, sample_rate: float = 0.0):
     image_bytes = 1
     for d in batcher.engine.image_shape:
         image_bytes *= d
@@ -103,9 +105,21 @@ def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics,
                 return
             image = np.frombuffer(body, np.uint8).reshape(
                 batcher.engine.image_shape)
+            # Adopt the caller's trace context (or become the trace
+            # root for header-less external callers). The context is
+            # shared by reference with the batcher dispatch thread, so
+            # a deadline shed there forces this hop's span too.
+            ctx = reqtrace.parse(self.headers.get(reqtrace.TRACE_HEADER),
+                                 sample_rate)
+            t0 = time.perf_counter()
             try:
-                logits = batcher.submit(image).result()
+                logits = batcher.submit(image, trace=ctx).result()
             except ShedError as e:
+                reqtrace.emit_span(logger, ctx, hop,
+                                   time.perf_counter() - t0,
+                                   reqtrace.wallclock_at(t0),
+                                   status=503, shed=e.reason,
+                                   replica_id=replica_id)
                 self._reply(503, {"shed": e.reason})
                 return
             payload = {"class": int(logits.argmax()),
@@ -115,6 +129,11 @@ def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics,
                 # The weights version that computed THIS response —
                 # what makes a hot-swap rollout observable end-to-end.
                 payload["version"] = version
+            reqtrace.emit_span(logger, ctx, hop,
+                               time.perf_counter() - t0,
+                               reqtrace.wallclock_at(t0),
+                               status=200, version=version,
+                               replica_id=replica_id)
             self._reply(200, payload)
 
     return Handler
@@ -219,10 +238,26 @@ def main_serve(cfg, task_index: int = 0,
     # records this logger writes; the flusher below gives it the
     # periodic time-window tick.
     from dml_cnn_cifar10_tpu.utils import alerts as alerts_lib
+    from dml_cnn_cifar10_tpu.utils.flightrec import FlightRecorder
+    # Flight recorder BEFORE the alert observer: observers run in
+    # attach order, so the record that trips a rule is ringed before
+    # the nested `alert` emission triggers the capture. The engine
+    # does not exist yet — the context_fn reads it through a holder
+    # filled in below.
+    holder: dict = {}
+    flightrec = FlightRecorder.from_config(
+        cfg, context_fn=lambda: {
+            "active_version": getattr(holder.get("engine"), "version",
+                                      None),
+            "replica_id": task_index},
+        logger=logger)
+    if flightrec is not None:
+        logger.add_observer(flightrec.observer())
     alert_engine = alerts_lib.AlertEngine.from_config(cfg)
     if alert_engine is not None:
         logger.add_observer(alert_engine.observer(logger))
     engine = resolve_engine(cfg, task_index, logger=logger)
+    holder["engine"] = engine
     metrics = ServeMetrics()
     batcher = MicroBatcher(
         engine, buckets=serve_cfg.buckets,
@@ -230,14 +265,16 @@ def main_serve(cfg, task_index: int = 0,
         batch_window_s=serve_cfg.batch_window_ms / 1e3,
         default_deadline_s=None if serve_cfg.deadline_ms is None
         else serve_cfg.deadline_ms / 1e3,
-        metrics=metrics)
+        metrics=metrics, logger=logger)
     print(f"[serve] engine={engine.source} image_shape="
           f"{engine.image_shape} buckets={batcher.buckets} "
           f"compile_s={batcher.compile_secs}")
 
-    server = ThreadingHTTPServer(("", serve_cfg.port),
-                                 _make_handler(batcher, metrics,
-                                               replica_id=task_index))
+    server = ThreadingHTTPServer(
+        ("", serve_cfg.port),
+        _make_handler(batcher, metrics, replica_id=task_index,
+                      hop="server", logger=logger,
+                      sample_rate=serve_cfg.trace_sample_rate))
     flusher = _MetricsFlusher(metrics, logger, serve_cfg.metrics_every_s,
                               alerts=alert_engine)
     flusher.start()

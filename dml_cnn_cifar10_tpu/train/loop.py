@@ -62,7 +62,8 @@ class TrainResult:
 
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None, task_index: int = 0,
-                 fault_injector=None, cluster=None, alert_engine=None):
+                 fault_injector=None, cluster=None, alert_engine=None,
+                 flight_recorder=None):
         self.cfg = cfg
         self.task_index = task_index
         if cfg.on_nonfinite not in ("halt", "skip", "rollback"):
@@ -93,6 +94,18 @@ class Trainer:
         # survive the Trainer that detected it; a bare Trainer builds
         # its own. Both are pure host work: the fetch-parity test pins
         # zero extra device fetches.
+        # Flight recorder BEFORE the alert observer (attach order is
+        # run order): the record that trips a rule must reach the ring
+        # before the engine's nested `alert` emission triggers the
+        # capture. Like the alert engine, the supervisor passes ONE
+        # recorder across restart attempts; a bare Trainer builds its
+        # own (armed only by --postmortem_dir).
+        from dml_cnn_cifar10_tpu.utils.flightrec import FlightRecorder
+        self.flightrec = flight_recorder if flight_recorder is not None \
+            else FlightRecorder.from_config(cfg, logger=self.logger)
+        if self.flightrec is not None:
+            self.flightrec.logger = self.logger
+            self.logger.add_observer(self.flightrec.observer())
         self.alerts = alert_engine if alert_engine is not None \
             else alerts_lib.AlertEngine.from_config(cfg)
         if self.alerts is not None:
@@ -513,6 +526,10 @@ class Trainer:
         dev_est = devprof_lib.DeviceStepEstimator()
         devwin = devprof_lib.ProfileWindow.from_config(cfg,
                                                        logger=self.logger)
+        # True when `devwin` was popped from the flight recorder (an
+        # alert-armed one-shot) rather than --profile_at_steps: those
+        # retire once done so a later capture can arm a fresh window.
+        flight_win = False
         # Online train-and-serve (--fleet_publish): every committed
         # checkpoint is published to the fleet's coordination dir so
         # live serve workers hot-swap to it between micro-batches. The
@@ -654,6 +671,16 @@ class Trainer:
                     cfg.profile_dir if devwin is None else None):
                 while global_step < total_steps and not stop:
                     drained = False
+                    if devwin is None and cfg.profile_dir is None \
+                            and self.flightrec is not None:
+                        # An alert capture arms a one-shot post-mortem
+                        # window; adopting it as `devwin` lets the
+                        # existing stop/close seams drive it. Skipped
+                        # whenever --profile_dir or --profile_at_steps
+                        # already owns the profiler.
+                        devwin = self.flightrec.pop_devprof_window(
+                            global_step, logger=self.logger)
+                        flight_win = devwin is not None
                     if devwin is not None:
                         devwin.maybe_start(global_step)
                     if self.cluster is not None:
@@ -980,6 +1007,9 @@ class Trainer:
                         # at/after its stop step — quiesced devices, no
                         # truncated in-flight dispatches.
                         devwin.maybe_stop(global_step, drained=drained)
+                        if flight_win and devwin.state == "done":
+                            devwin = None
+                            flight_win = False
 
                 # Final save covers both normal completion and preemption: the
                 # in-flight step finished, so the checkpoint loses zero work.

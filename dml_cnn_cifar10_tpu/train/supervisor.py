@@ -76,6 +76,7 @@ from dml_cnn_cifar10_tpu.parallel import cluster as cluster_lib
 from dml_cnn_cifar10_tpu.utils import alerts as alerts_lib
 from dml_cnn_cifar10_tpu.utils import backoff
 from dml_cnn_cifar10_tpu.utils import faults as faults_lib
+from dml_cnn_cifar10_tpu.utils import flightrec as flightrec_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
 
 #: Failure classes the supervisor may retry.
@@ -269,6 +270,14 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
     logger = MetricsLogger(cfg.metrics_jsonl, task_index=task_index)
     monitor = cluster_lib.ClusterMonitor.from_config(cfg.parallel,
                                                      logger=logger)
+    # ONE flight recorder across attempts (ring + per-rule capture
+    # sequence survive restarts), attached BEFORE the alert engine's
+    # observer so the record that trips a rule is ringed before the
+    # nested `alert` emission snapshots the ring.
+    flightrec = flightrec_lib.FlightRecorder.from_config(cfg,
+                                                         logger=logger)
+    if flightrec is not None:
+        logger.add_observer(flightrec.observer())
     # ONE alert engine too: the fault/recovery records the supervisor
     # logs here must feed the same rule state as the Trainer's stream,
     # and an alert that fired in attempt N must be able to RESOLVE in
@@ -288,7 +297,8 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
         while True:
             trainer = Trainer(cfg, task_index=task_index,
                               fault_injector=injector, cluster=monitor,
-                              alert_engine=alert_engine)
+                              alert_engine=alert_engine,
+                              flight_recorder=flightrec)
             try:
                 result = trainer.fit(total_steps)
             except cluster_lib.EvictedError as e:

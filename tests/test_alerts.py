@@ -416,4 +416,4 @@ def test_logger_feeds_engine_and_registry(tmp_path):
     kinds = [r["kind"] for r in recs]
     assert kinds == ["serve", "alert", "serve", "alert_resolved"]
     from tools import check_jsonl_schema
-    assert check_jsonl_schema.check_file(path) == []
+    assert check_jsonl_schema.check_file(path, strict=True) == []

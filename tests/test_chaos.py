@@ -290,7 +290,7 @@ def test_chaos_smoke_campaign_fixed_seeds(chaos_workdir, chaos_refs):
     assert len(summary["faults_by_kind"]) >= 2
     assert sum(summary["faults_by_kind"].values()) >= 5
     from tools import check_jsonl_schema, telemetry_report
-    assert check_jsonl_schema.check_file(jsonl) == []
+    assert check_jsonl_schema.check_file(jsonl, strict=True) == []
     out = telemetry_report.summarize(jsonl)
     assert "chaos campaign" in out and "5 passed" in out
 
@@ -409,7 +409,7 @@ def test_chief_killed_between_decide_and_restore(chaos_workdir,
     from tools import check_jsonl_schema
     for recs in (chief, surv):
         assert check_jsonl_schema.check_lines(
-            json.dumps(r) for r in recs) == []
+            (json.dumps(r) for r in recs), strict=True) == []
     # The final decision on disk is the survivor's epoch-2 verdict and
     # verifies through the sidecar walk.
     d = cluster_lib.RestartCoordinator(cluster).read()
@@ -437,4 +437,4 @@ def test_chaos_50_schedule_campaign(tmp_path):
     assert proc.returncode == 0, proc.stdout[-4000:]
     from tools import check_jsonl_schema
     assert check_jsonl_schema.check_file(
-        str(tmp_path / "campaign.jsonl")) == []
+        str(tmp_path / "campaign.jsonl"), strict=True) == []

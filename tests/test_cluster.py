@@ -384,7 +384,7 @@ def _run_failure_scenario(tmp_path, data_cfg, fault_spec,
     # prints the cluster-health section.
     from tools import check_jsonl_schema, telemetry_report
     assert check_jsonl_schema.check_lines(
-        json.dumps(r) for r in recs) == []
+        (json.dumps(r) for r in recs), strict=True) == []
     out = telemetry_report.summarize(os.path.join(logs[0],
                                                   "metrics.jsonl"))
     assert "cluster health" in out and "elastic restart" in out
@@ -473,4 +473,4 @@ def test_preempted_nonchief_exits_without_saving(data_cfg, tmp_path):
     assert notice[0]["process_id"] == 1
     assert any(r["kind"] == "preempt" for r in recs)
     from tools import check_jsonl_schema
-    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl, strict=True) == []

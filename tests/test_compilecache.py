@@ -505,8 +505,8 @@ def test_warm_restart_after_sigterm_is_bit_identical(data_cfg, tmp_path):
 
     # Both streams pass the documented-schema lint, and the report
     # prints the compile-cost section.
-    assert check_jsonl_schema.check_file(jsonl) == []
-    assert check_jsonl_schema.check_file(cfg2.metrics_jsonl) == []
+    assert check_jsonl_schema.check_file(jsonl, strict=True) == []
+    assert check_jsonl_schema.check_file(cfg2.metrics_jsonl, strict=True) == []
     from tools import telemetry_report
     out = telemetry_report.summarize(cfg2.metrics_jsonl)
     assert "compile cost" in out

@@ -169,7 +169,7 @@ def test_profile_at_steps_trainer_run(tmp_path):
 
     # Schema-clean (devtime + the new train keys are registered kinds).
     from tools import check_jsonl_schema
-    assert check_jsonl_schema.check_file(jsonl) == []
+    assert check_jsonl_schema.check_file(jsonl, strict=True) == []
 
     # Both report renderers cover the new sections.
     from tools import telemetry_report

@@ -321,7 +321,7 @@ def test_sim_2_1_2_expand_bit_identical_to_uninterrupted(tmp_path,
             streams.append([json.loads(ln) for ln in f if ln.strip()])
     for recs in streams:
         assert check_jsonl_schema.check_lines(
-            json.dumps(r) for r in recs) == []
+            (json.dumps(r) for r in recs), strict=True) == []
     s_kinds = {r["kind"] for r in streams[0]}
     assert {"peer_lost", "elastic_restart", "host_rejoin",
             "elastic_expand"} <= s_kinds

@@ -175,7 +175,7 @@ def test_supervisor_recovers_nan_and_corrupt_checkpoint(data_cfg,
     assert any(r["step"] == 20 for r in fallbacks)
     # The stream passes the documented-schema lint.
     from tools import check_jsonl_schema
-    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl, strict=True) == []
     # And the report CLI summarizes the recovery.
     from tools import telemetry_report
     out = telemetry_report.summarize(cfg.metrics_jsonl)
@@ -235,7 +235,7 @@ def test_on_nonfinite_skip_discards_update_and_continues(data_cfg,
     trains = [r for r in recs if r["kind"] == "train"]
     assert trains[-1]["loss"] is not None
     from tools import check_jsonl_schema
-    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl, strict=True) == []
 
 
 def test_on_nonfinite_skip_budget_degrades_to_halt(data_cfg, tmp_path):

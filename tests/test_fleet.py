@@ -380,7 +380,7 @@ def test_fleet_jsonl_kinds_pass_schema_lint(tmp_path):
     logger.log("fleet_publish", seq=2, version="20", step=20,
                path="/x/ckpt_20.msgpack")
     logger.close()
-    assert check_jsonl_schema.check_file(path) == []
+    assert check_jsonl_schema.check_file(path, strict=True) == []
 
 
 def test_telemetry_report_prints_fleet_section(tmp_path):
@@ -698,7 +698,7 @@ def test_fleet_survives_kill_and_hot_swaps_zero_failures(
     # records the eviction + the self-healing scale-up; the report CLI
     # prints the fleet-health section; replica streams lint too.
     from tools import check_jsonl_schema, telemetry_report
-    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl, strict=True) == []
     with open(cfg.metrics_jsonl) as f:
         recs = [json.loads(ln) for ln in f if ln.strip()]
     kinds = {r["kind"] for r in recs}
@@ -712,7 +712,7 @@ def test_fleet_survives_kill_and_hot_swaps_zero_failures(
     assert "fleet health" in report
     tele = os.path.join(cfg.fleet.dir, "telemetry")
     replica0 = os.path.join(tele, "replica_0.jsonl")
-    assert check_jsonl_schema.check_file(replica0) == []
+    assert check_jsonl_schema.check_file(replica0, strict=True) == []
     with open(replica0) as f:
         r0 = [json.loads(ln) for ln in f if ln.strip()]
     swaps = [r for r in r0 if r["kind"] == "swap"]

@@ -1,0 +1,241 @@
+"""Alert-triggered flight recorder (utils/flightrec.py): ring bounds,
+the one-bundle-per-emitted-firing contract against the alert engine's
+rate limit (suppressed re-fires capture nothing, ``alert_resolved``
+never captures), bundle atomicity/contents — and the acceptance drill:
+a supervised train sim with ``nan@15`` where the ``nonfinite_burst``
+firing auto-captures exactly one bundle, ``tools/postmortem.py``
+renders it, the ring holds the records leading to the fault, and
+arming the recorder adds ZERO device fetches."""
+
+import json
+import os
+
+import pytest
+
+from dml_cnn_cifar10_tpu.utils.alerts import AlertEngine, built_in_rules
+from dml_cnn_cifar10_tpu.utils.flightrec import FlightRecorder
+from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+
+
+def _serve(shed=0, p99=10.0):
+    return {"requests": 100, "completed": 100 - shed,
+            "shed_queue": shed, "shed_deadline": 0, "qps": 50.0,
+            "p50_ms": 2.0, "p95_ms": 5.0, "p99_ms": p99,
+            "batch_fill": 0.5, "window_s": 2.0}
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# ring mechanics
+# ---------------------------------------------------------------------------
+
+def test_ring_is_bounded_and_ordered():
+    fr = FlightRecorder(size=8, postmortem_dir=None)
+    for i in range(20):
+        fr.observe("train", {"step": i})
+    snap = fr.snapshot()
+    assert [r["step"] for r in snap] == list(range(12, 20))
+    assert all(r["kind"] == "train" and "wallclock" in r for r in snap)
+
+
+def test_ring_coerces_unjsonable_fields(tmp_path):
+    fr = FlightRecorder(size=4, postmortem_dir=str(tmp_path))
+    fr.observe("train", {"step": 1, "weird": object()})
+    (rec,) = fr.snapshot()
+    json.dumps(rec)                        # ring stays JSON-ready
+    assert rec["step"] == 1
+
+
+def test_from_config_armed_only_by_postmortem_dir(tmp_path):
+    class Cfg:
+        postmortem_dir = None
+        flightrec_size = 16
+
+    assert FlightRecorder.from_config(Cfg()) is None
+    Cfg.postmortem_dir = str(tmp_path / "pm")
+    fr = FlightRecorder.from_config(Cfg())
+    assert fr is not None and fr.size == 16
+
+
+# ---------------------------------------------------------------------------
+# alert → capture contract (rate limit, resolution, atomicity)
+# ---------------------------------------------------------------------------
+
+def _recorder_with_engine(tmp_path, min_interval_s=30.0):
+    """Production wiring with an injectable clock: logger → flight
+    recorder observer (FIRST) → alert engine observer."""
+    pm_dir = str(tmp_path / "pm")
+    logger = MetricsLogger(jsonl_path=str(tmp_path / "m.jsonl"))
+    fr = FlightRecorder(size=32, postmortem_dir=pm_dir, logger=logger)
+    logger.add_observer(fr.observer())
+    eng = AlertEngine(built_in_rules(), min_interval_s=min_interval_s)
+    clock = {"now": 100.0}
+    logger.add_observer(
+        lambda kind, fields: eng.observe(kind, fields, emit=logger.log,
+                                         now=clock["now"]))
+    return logger, fr, pm_dir, clock
+
+
+def test_one_bundle_per_emitted_firing_rate_limited(tmp_path):
+    logger, fr, pm_dir, clock = _recorder_with_engine(tmp_path)
+    # Four shed/recover flaps inside the 30 s rate-limit window: ONE
+    # emitted alert (+ its resolution), so exactly one bundle — the
+    # suppressed re-fires emit no record and capture nothing, and the
+    # alert_resolved records never capture.
+    for _ in range(4):
+        logger.log("serve", **_serve(shed=5))
+        clock["now"] += 1.0
+        logger.log("serve", **_serve(shed=0))
+        clock["now"] += 1.0
+    assert len(fr.bundles) == 1
+    # Past the window the next breach fires — and captures — again.
+    clock["now"] = 200.0
+    logger.log("serve", **_serve(shed=5))
+    assert len(fr.bundles) == 2
+    logger.close()
+
+    assert sorted(os.listdir(pm_dir)) == [os.path.basename(b)
+                                          for b in fr.bundles]
+    assert all("serve_shed" in b for b in fr.bundles)
+    # The stream says both captures happened (and passes strict lint).
+    recs = _read_jsonl(str(tmp_path / "m.jsonl"))
+    pms = [r for r in recs if r["kind"] == "postmortem"]
+    assert [r["dir"] for r in pms] == fr.bundles
+    assert sum(1 for r in recs if r["kind"] == "alert") == 2
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(str(tmp_path / "m.jsonl"),
+                                         strict=True) == []
+
+
+def test_bundle_contents_and_atomicity(tmp_path):
+    logger, fr, pm_dir, clock = _recorder_with_engine(tmp_path)
+    logger.log("train", step=10, loss=1.0)
+    logger.log("serve", **_serve(shed=5))
+    (bundle,) = fr.bundles
+    # Atomic publish: no temp dirs left behind, all files present.
+    assert all(".tmp" not in n for n in os.listdir(pm_dir))
+    names = set(os.listdir(bundle))
+    assert {"ring.jsonl", "alert.json", "env.json",
+            "context.json"} <= names
+    with open(os.path.join(bundle, "alert.json")) as f:
+        alert = json.load(f)
+    assert alert["rule"] == "serve_shed" and "captured_wallclock" in alert
+    # The ring holds the causal prefix: the records BEFORE the firing,
+    # then the alert record itself (the observer attach order contract).
+    ring = _read_jsonl(os.path.join(bundle, "ring.jsonl"))
+    assert [r["kind"] for r in ring] == ["train", "serve", "alert"]
+    logger.close()
+
+
+def test_capture_failure_is_fail_open(tmp_path):
+    target = tmp_path / "pm"
+    target.write_text("not a directory")   # capture will fail
+    logger, fr, _, _ = _recorder_with_engine(tmp_path)
+    logger.log("serve", **_serve(shed=5))  # must not raise
+    assert fr.bundles == []
+    logger.close()
+
+
+def test_devprof_window_pops_once(tmp_path):
+    logger, fr, _, _ = _recorder_with_engine(tmp_path)
+    assert fr.pop_devprof_window(5) is None
+    logger.log("serve", **_serve(shed=5))
+    win = fr.pop_devprof_window(7)
+    assert win is not None and win.start_step == 7
+    assert win.out_dir == os.path.join(fr.bundles[0], "devprof")
+    assert fr.pop_devprof_window(8) is None      # one-shot
+    logger.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance drill: supervised nan@15, one bundle, rendered post-mortem
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_drill_supervised_nan(data_cfg, tmp_path,
+                                              monkeypatch):
+    import jax
+
+    from dml_cnn_cifar10_tpu.train.supervisor import fit_supervised
+    from tests.conftest import tiny_train_cfg
+
+    counts = {"n": 0}
+    real_get = jax.device_get
+
+    def counting_get(x):
+        counts["n"] += 1
+        return real_get(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+
+    def run(sub, postmortem_dir):
+        cfg = tiny_train_cfg(data_cfg, str(tmp_path / sub),
+                             total_steps=30)
+        cfg.checkpoint_every = 10
+        cfg.output_every = 10
+        cfg.eval_every = 30
+        cfg.check_numerics = True
+        cfg.on_nonfinite = "rollback"
+        cfg.recovery_backoff_s = 0.01
+        cfg.fault_spec = "nan@15"
+        cfg.metrics_jsonl = os.path.join(str(tmp_path / sub), "m.jsonl")
+        cfg.postmortem_dir = postmortem_dir
+        counts["n"] = 0
+        result = fit_supervised(cfg)
+        assert result.final_step == 30
+        return counts["n"], cfg
+
+    pm_dir = str(tmp_path / "pm")
+    fetches_armed, cfg = run("armed", pm_dir)
+
+    # Exactly one bundle: nonfinite_burst fired once for the one fault.
+    bundles = [os.path.join(pm_dir, n) for n in sorted(os.listdir(pm_dir))]
+    assert len(bundles) == 1 and "nonfinite_burst" in bundles[0]
+
+    # The ring holds the run leading to the fault: training boundaries
+    # before it, the fault record itself, then the firing that tripped
+    # the capture.
+    ring = _read_jsonl(os.path.join(bundles[0], "ring.jsonl"))
+    kinds = [r["kind"] for r in ring]
+    assert kinds[-1] == "alert"
+    assert "fault" in kinds and "train" in kinds
+    faults = [r for r in ring if r["kind"] == "fault"]
+    # Both the injected poison and its boundary detection are ringed,
+    # in causal order, before the firing.
+    assert [r["fault"] for r in faults] == ["nan", "nonfinite"]
+    assert faults[0].get("injected") and not faults[1].get("injected")
+
+    # The capture armed a one-shot devprof window; the restarted
+    # attempt's loop popped it and wrote under the bundle.
+    assert os.path.isdir(os.path.join(bundles[0], "devprof"))
+
+    # The stream records the capture and still lints strictly.
+    recs = _read_jsonl(cfg.metrics_jsonl)
+    pms = [r for r in recs if r["kind"] == "postmortem"]
+    assert len(pms) == 1 and pms[0]["dir"] == bundles[0]
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl,
+                                         strict=True) == []
+
+    # tools/postmortem.py renders the bundle (text + scan + merged
+    # Perfetto of the ring).
+    from tools import postmortem
+    assert postmortem.scan(pm_dir) == bundles
+    text = postmortem.render_bundle(postmortem.load_bundle(bundles[0]))
+    assert "nonfinite_burst" in text and "fault" in text
+    out = str(tmp_path / "pm_trace.json")
+    assert postmortem.main([bundles[0], "--out", out]) == 0
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["otherData"]["bundles"] == [bundles[0]]
+    assert any(str(e.get("name", "")).startswith("fault")
+               for e in doc["traceEvents"])
+
+    # Zero extra device fetches: the armed run's fetch count equals an
+    # identical unarmed run's (the recorder rides the observer hook).
+    fetches_off, _ = run("unarmed", None)
+    assert fetches_armed == fetches_off, \
+        "flight recorder must not add device fetches"

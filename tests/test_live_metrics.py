@@ -220,7 +220,7 @@ def test_fleet_record_device_ms_from_beats(tmp_path):
     fleet = [r for r in recs if r["kind"] == "fleet"][-1]
     assert fleet["device_ms"] == {"0": 1.2, "1": 9.8}
     from tools import check_jsonl_schema, telemetry_report
-    assert check_jsonl_schema.check_file(jsonl) == []
+    assert check_jsonl_schema.check_file(jsonl, strict=True) == []
     out = telemetry_report.summarize(jsonl)
     assert "per-replica device_ms" in out and "r1: 9.8 ms" in out
 
@@ -307,7 +307,7 @@ def test_supervised_run_serves_live_metrics_and_pairs_alerts(
 
     # (b) the stream is schema-clean with the new kinds present...
     from tools import check_jsonl_schema
-    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl, strict=True) == []
     with open(cfg.metrics_jsonl) as f:
         recs = [json.loads(line) for line in f]
     nf_alerts = [r for r in recs if r.get("kind") == "alert"

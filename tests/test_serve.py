@@ -229,7 +229,7 @@ def test_serve_metrics_jsonl_schema(tmp_path):
     metrics.emit(logger)            # window record mid-run
     metrics.emit(logger, final=True)
     logger.close()
-    assert check_jsonl_schema.check_file(path) == []
+    assert check_jsonl_schema.check_file(path, strict=True) == []
     kinds = [json.loads(l)["kind"] for l in open(path)]
     assert kinds == ["serve", "serve", "serve_done"]
 
@@ -274,7 +274,7 @@ def test_loadgen_closed_loop_smoke(tmp_path):
     assert 0.0 < report["batch_fill"] <= 1.0
 
     from tools import check_jsonl_schema
-    assert check_jsonl_schema.check_file(jsonl_path) == []
+    assert check_jsonl_schema.check_file(jsonl_path, strict=True) == []
 
 
 # ---- graceful SIGTERM/stop drain (serve/server.py) ----
@@ -360,4 +360,4 @@ def test_main_serve_graceful_stop_drains_and_flushes(tmp_path):
     finals = [r for r in recs if r["kind"] == "serve_done"]
     assert finals and finals[-1]["completed"] >= 1
     from tools import check_jsonl_schema
-    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl, strict=True) == []

@@ -216,7 +216,7 @@ def test_shard_io_stream_is_schema_clean_and_reported(tmp_path):
                              logger=log, shard_io_threads=4)
     ckpt_lib.restore_checkpoint(str(tmp_path), _state(seed=6), logger=log)
     log.close()
-    assert check_jsonl_schema.check_file(jsonl) == []
+    assert check_jsonl_schema.check_file(jsonl, strict=True) == []
     out = telemetry_report.summarize(jsonl)
     assert "shard io:" in out
     assert "save:" in out and "restore:" in out
@@ -246,7 +246,7 @@ def test_report_world_size_timeline_and_rejoins():
          "joined": [1]},
     ]
     assert check_jsonl_schema.check_lines(
-        json.dumps(r) for r in recs) == []
+        (json.dumps(r) for r in recs), strict=True) == []
     with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
                                      delete=False) as f:
         for r in recs:

@@ -277,7 +277,7 @@ def test_telemetry_run_and_fetch_parity(data_cfg, tmp_path, monkeypatch):
 
     # The stream passes the documented-schema lint (wired into tier 1).
     from tools import check_jsonl_schema
-    assert check_jsonl_schema.check_file(cfg.metrics_jsonl) == []
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl, strict=True) == []
 
     # And the report CLI summarizes it.
     from tools import telemetry_report
@@ -332,13 +332,17 @@ def test_check_jsonl_schema_catches_violations(tmp_path):
     errs = lint.check_lines(['{"kind": "eval", "t": 1.0, "task": 0, '
                              '"step": 1}'])
     assert errs and "test_accuracy" in errs[0]
-    # Unknown kind must be registered (schema drift guard).
-    errs = lint.check_lines(['{"kind": "mystery", "t": 1.0, "task": 0}'])
+    # Unknown kind: tolerated by default (an old checkout reading a
+    # newer stream), rejected under strict — the drift guard the repo's
+    # own tests run with.
+    mystery = '{"kind": "mystery", "t": 1.0, "task": 0}'
+    assert lint.check_lines([mystery]) == []
+    errs = lint.check_lines([mystery], strict=True)
     assert errs and "unknown kind" in errs[0]
     # Garbage line.
     assert lint.check_lines(["not json"])
     # File-level entry point.
     p = tmp_path / "m.jsonl"
     p.write_text(json.dumps(good) + "\n")
-    assert lint.check_file(str(p)) == []
-    assert lint.main([str(p)]) == 0
+    assert lint.check_file(str(p), strict=True) == []
+    assert lint.main(["--strict", str(p)]) == 0

@@ -1,0 +1,394 @@
+"""End-to-end request tracing (utils/reqtrace.py + the rspan seams in
+serve/ and fleet/): context mint/parse/force mechanics, the batcher's
+causally-linked batch/member spans against a stub engine, the merged
+Perfetto hop lanes + flow events, the per-hop report section — and the
+acceptance smoke: a 2-worker fleet under forced sampling where a
+worker kill mid-load leaves a retried trace showing BOTH placements,
+every sampled trace's hops causally linked client→router→worker→
+batcher→engine, per-hop durations nesting inside the measured
+end-to-end latency, and every stream passing the strict schema lint."""
+
+import copy
+import dataclasses
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from dml_cnn_cifar10_tpu.config import TrainConfig
+from dml_cnn_cifar10_tpu.serve import MicroBatcher, ServeMetrics, ShedError
+from dml_cnn_cifar10_tpu.utils import reqtrace
+from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+from tests.test_fleet import (FakeLogger, _fleet_cfg, _free_port,
+                              _healthz, _save_ckpt, _worker_log_tails)
+from tests.test_serve import StubEngine, _images
+
+
+# ---------------------------------------------------------------------------
+# trace-context mechanics (pure)
+# ---------------------------------------------------------------------------
+
+def test_mint_parse_header_round_trip():
+    ctx = reqtrace.mint(1.0)
+    assert len(ctx.trace_id) == 16 and ctx.sampled and ctx.emit
+    assert ctx.header() == f"{ctx.trace_id};s=1"
+    back = reqtrace.parse(ctx.header(), 0.0)
+    assert back.trace_id == ctx.trace_id and back.sampled
+    # Rate 0: minted but not sampled; the id still propagates.
+    cold = reqtrace.mint(0.0)
+    assert not cold.sampled and not cold.emit
+    assert cold.header().endswith(";s=0")
+    assert reqtrace.parse(cold.header(), 1.0).sampled is False
+
+
+def test_parse_mints_on_absent_or_malformed():
+    for bad in (None, "", ";s=1", "  ;s=1"):
+        ctx = reqtrace.parse(bad, 1.0)
+        assert len(ctx.trace_id) == 16 and ctx.sampled
+    # A foreign id is adopted as-is; an unparsable s bit reads unsampled
+    # (tracing never fails a request).
+    ctx = reqtrace.parse("abc;s=2", 1.0)
+    assert ctx.trace_id == "abc" and not ctx.sampled
+
+
+def test_force_upgrades_emit_and_header():
+    ctx = reqtrace.mint(0.0)
+    assert not ctx.emit
+    ctx.force()
+    # Sampling decision unchanged; emission (and the downstream header)
+    # upgraded — shed/retried requests become fully traced.
+    assert not ctx.sampled and ctx.emit and ctx.forced
+    assert ctx.header().endswith(";s=1")
+
+
+def test_emit_span_respects_decision_and_clamps():
+    log = FakeLogger()
+    reqtrace.emit_span(log, reqtrace.mint(0.0), "client", 0.01, 100.0)
+    reqtrace.emit_span(None, reqtrace.mint(1.0), "client", 0.01, 100.0)
+    reqtrace.emit_span(log, None, "client", 0.01, 100.0)
+    assert log.records == []
+    ctx = reqtrace.mint(1.0)
+    reqtrace.emit_span(log, ctx, "engine", -0.5, 100.0, batch_id="ab")
+    (r,) = log.records
+    assert r["kind"] == "rspan" and r["trace_id"] == ctx.trace_id
+    assert r["hop"] == "engine" and r["dur_ms"] == 0.0
+    assert r["wallclock"] == 100.0 and r["batch_id"] == "ab"
+
+
+# ---------------------------------------------------------------------------
+# batcher spans against the stub engine
+# ---------------------------------------------------------------------------
+
+def test_batcher_emits_linked_batch_and_member_spans():
+    eng = StubEngine(forward_s=0.01)
+    log = FakeLogger()
+    traces = [reqtrace.mint(1.0) for _ in range(3)]
+    with MicroBatcher(eng, buckets=(1, 4), batch_window_s=0.2,
+                      warmup=False, logger=log) as b:
+        futs = [b.submit(im, trace=t)
+                for im, t in zip(_images(3), traces)]
+        for f in futs:
+            f.result(timeout=10)
+    spans = [r for r in log.records if r["kind"] == "rspan"]
+    by_hop = {}
+    for s in spans:
+        by_hop.setdefault(s["hop"], []).append(s)
+    # One batch span; its batch_id links every member's queue wait
+    # (batcher) and device share (engine).
+    (batch,) = by_hop["batch"]
+    assert batch["n"] == 3
+    assert len(by_hop["batcher"]) == 3 and len(by_hop["engine"]) == 3
+    ids = {t.trace_id for t in traces}
+    for s in by_hop["batcher"] + by_hop["engine"]:
+        assert s["batch_id"] == batch["trace_id"]
+        assert s["trace_id"] in ids
+    # The engine span carries the batch's device time, not queue time.
+    for s in by_hop["engine"]:
+        assert s["dur_ms"] >= 10.0 - 1e-6
+
+
+def test_batcher_unsampled_requests_emit_nothing():
+    eng = StubEngine()
+    log = FakeLogger()
+    with MicroBatcher(eng, buckets=(1, 4), batch_window_s=0.1,
+                      warmup=False, logger=log) as b:
+        futs = [b.submit(im, trace=reqtrace.mint(0.0))
+                for im in _images(3)]
+        futs.append(b.submit(_images(1)[0]))     # untraced caller
+        for f in futs:
+            f.result(timeout=10)
+    assert [r for r in log.records if r["kind"] == "rspan"] == []
+
+
+def test_batcher_sheds_force_sampling():
+    gate = threading.Event()
+    eng = StubEngine(gate=gate)
+    log = FakeLogger()
+    b = MicroBatcher(eng, buckets=(1,), max_queue_depth=1,
+                     batch_window_s=0.0, metrics=ServeMetrics(),
+                     warmup=False, logger=log)
+    try:
+        b.submit(_images(1)[0], trace=reqtrace.mint(0.0))  # wedged
+        time.sleep(0.1)
+        doomed = b.submit(_images(1)[0], deadline_s=0.01,
+                          trace=reqtrace.mint(0.0))        # queued
+        shed_ctx = reqtrace.mint(0.0)
+        with pytest.raises(ShedError):
+            b.submit(_images(1)[0], trace=shed_ctx)        # queue full
+        assert shed_ctx.emit                               # forced
+        time.sleep(0.05)
+    finally:
+        gate.set()
+        b.close()
+    with pytest.raises(ShedError):
+        doomed.result(timeout=10)
+    sheds = {r.get("shed") for r in log.records
+             if r["kind"] == "rspan" and r["hop"] == "batcher"}
+    assert sheds == {"queue_full", "deadline"}
+
+
+# ---------------------------------------------------------------------------
+# merged Perfetto lanes + clock-anchor fallback
+# ---------------------------------------------------------------------------
+
+def _span_rec(t, trace_id, hop, dur_ms, wallclock, **extra):
+    return {"kind": "rspan", "t": t, "task": 0, "trace_id": trace_id,
+            "hop": hop, "dur_ms": dur_ms, "wallclock": wallclock,
+            **extra}
+
+
+def test_merged_trace_links_hops_with_flow_events(tmp_path):
+    from tools.trace_aggregate import build_merged_trace
+
+    w0 = 1_700_000_000.0
+    client = [_span_rec(0.01, "aa" * 8, "client", 30.0, w0)]
+    serve = [
+        {"kind": "serve", "t": 0.5, "task": 1, "requests": 2,
+         "completed": 2, "shed_queue": 0, "shed_deadline": 0,
+         "qps": 4.0, "p50_ms": 5.0, "p95_ms": 9.0, "p99_ms": 9.0,
+         "batch_fill": 1.0, "window_s": 0.5, "wallclock": w0 + 0.49},
+        _span_rec(0.011, "aa" * 8, "server", 25.0, w0 + 0.001),
+        _span_rec(0.012, "aa" * 8, "batcher", 5.0, w0 + 0.002,
+                  batch_id="bb" * 4),
+        _span_rec(0.013, "aa" * 8, "engine", 15.0, w0 + 0.007,
+                  batch_id="bb" * 4),
+        _span_rec(0.013, "cc" * 8, "batch", 15.0, w0 + 0.007, n=1),
+    ]
+    p1, p2 = tmp_path / "client.jsonl", tmp_path / "serve.jsonl"
+    p1.write_text("".join(json.dumps(r) + "\n" for r in client))
+    p2.write_text("".join(json.dumps(r) + "\n" for r in serve))
+    doc = build_merged_trace([str(p1), str(p2)])
+    events = doc["traceEvents"]
+    xs = [e for e in events if e.get("cat") == "rspan"
+          and e["ph"] == "X"]
+    assert {e["args"]["hop"] for e in xs} == \
+        {"client", "server", "batcher", "engine", "batch"}
+    # Hop lanes: each hop gets its own tid so lanes nest visually.
+    assert len({(e["pid"], e["tid"]) for e in xs}) == len(xs)
+    # One flow thread for the multi-span trace: start → steps → finish
+    # in wallclock order, client first.
+    flows = sorted((e for e in events if e.get("cat") == "rspan"
+                    and e["ph"] in ("s", "t", "f")),
+                   key=lambda e: e["ts"])
+    assert [e["ph"] for e in flows] == ["s", "t", "t", "f"]
+    assert len({e["id"] for e in flows}) == 1
+    # The serve stream aligned via its window-record wallclock anchor
+    # (no heartbeats anywhere in it).
+    client_x = next(e for e in xs if e["args"]["hop"] == "client")
+    server_x = next(e for e in xs if e["args"]["hop"] == "server")
+    assert server_x["ts"] - client_x["ts"] == pytest.approx(1e3, abs=50)
+
+
+def test_clock_offset_falls_back_to_serve_anchor():
+    from tools.trace_aggregate import clock_offset
+
+    recs = [{"kind": "fleet", "t": 2.0, "task": 0, "replicas": 2,
+             "live": 2, "routed": 10, "rerouted": 0, "evictions": 0,
+             "shed": 0, "version_mix": {"1": 2}, "window_s": 2.0,
+             "wallclock": 1002.0}]
+    assert clock_offset(recs) == pytest.approx(1000.0)
+    assert clock_offset([{"kind": "train", "t": 1.0, "task": 0}]) is None
+
+
+def test_report_renders_per_hop_breakdown(tmp_path):
+    from tools import telemetry_report
+
+    recs = []
+    for i in range(4):
+        tid = f"{i + 1:016x}"
+        recs.append(_span_rec(0.1 * i, tid, "client", 20.0 + i, 100.0))
+        recs.append(_span_rec(0.1 * i, tid, "engine", 5.0, 100.0,
+                              version="7"))
+    recs.append(_span_rec(0.9, "dd" * 8, "batch", 9.0, 100.0, n=4))
+    path = tmp_path / "m.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    out = telemetry_report.summarize(str(path))
+    assert "request tracing" in out and "client" in out
+    js = telemetry_report.summarize_json(str(path))
+    hop = js["request_tracing"]
+    assert hop["traces"] == 4
+    by_hop = {h["hop"]: h for h in hop["hops"]}
+    assert by_hop["client"]["spans"] == 4
+    # Text/JSON parity on the slowest-trace exemplars: the batch span
+    # is infrastructure, not a request — excluded from totals.
+    slowest = hop["slowest"][0]
+    assert slowest["total_ms"] == pytest.approx(23.0 + 5.0)
+    assert slowest["trace_id"] in out and slowest["version"] == "7"
+
+
+# ---------------------------------------------------------------------------
+# acceptance smoke: traced 2-worker fleet surviving a worker kill
+# ---------------------------------------------------------------------------
+
+def _traced_predict(port, img, logger, sample_rate=1.0):
+    """One client request with a minted trace context: send the header,
+    emit the client span (forced on shed/failure like loadgen)."""
+    ctx = reqtrace.mint(sample_rate)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=img.tobytes(),
+        headers={"Content-Type": "application/octet-stream",
+                 reqtrace.TRACE_HEADER: ctx.header()})
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            body = json.loads(resp.read())
+    except Exception:
+        ctx.force()
+        reqtrace.emit_span(logger, ctx,
+                           "client", time.perf_counter() - t0,
+                           reqtrace.wallclock_at(t0), status=0)
+        raise
+    reqtrace.emit_span(logger, ctx, "client",
+                       time.perf_counter() - t0,
+                       reqtrace.wallclock_at(t0), status=200,
+                       version=body.get("version"))
+    return ctx.trace_id, body
+
+
+def test_fleet_tracing_smoke_kill_retry_and_causal_chain(
+        tmp_path, data_cfg, monkeypatch, rng):
+    from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+    from dml_cnn_cifar10_tpu.fleet.controller import main_fleet
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+    from tools import check_jsonl_schema
+    from tools.trace_aggregate import build_merged_trace
+
+    monkeypatch.setenv("XLA_FLAGS", "")
+    cfg = _fleet_cfg(tmp_path, data_cfg)
+    cfg.serve.trace_sample_rate = 1.0      # sampling forced on
+    cfg.fleet.worker_fault = "1:host_lost@15"
+
+    seed_cfg = copy.deepcopy(cfg)
+    seed_cfg.metrics_jsonl = None
+    trainer = Trainer(seed_cfg)
+    host_state = ckpt_lib.fetch_to_host(trainer.init_or_restore())
+    _save_ckpt(cfg, host_state, 1)
+
+    images = rng.integers(0, 256, (4, 32, 32, 3)).astype(np.uint8)
+    client_jsonl = str(tmp_path / "client.jsonl")
+    client_log = MetricsLogger(jsonl_path=client_jsonl)
+
+    ready, stop = threading.Event(), threading.Event()
+    rc = {}
+    t = threading.Thread(
+        target=lambda: rc.setdefault("rc", main_fleet(
+            cfg, ready_event=ready, stop_event=stop)),
+        daemon=True)
+    t.start()
+    port = cfg.fleet.port
+    trace_ids = []
+    e2e = {}     # trace_id -> client-measured latency (s)
+    try:
+        assert ready.wait(60), "router never became ready"
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if _healthz(port)["live"] >= 2:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("fleet never reached 2 live replicas\n"
+                        + _worker_log_tails(cfg.fleet.dir))
+        for i in range(60):
+            t0 = time.perf_counter()
+            tid, resp = _traced_predict(port, images[i % 4], client_log)
+            e2e[tid] = time.perf_counter() - t0
+            assert "class" in resp, f"request {i} failed: {resp}"
+            trace_ids.append(tid)
+            time.sleep(0.01)
+        hz = _healthz(port)
+        assert hz["replicas"]["1"]["live"] is False, \
+            "replica 1 was never killed/evicted\n" \
+            + _worker_log_tails(cfg.fleet.dir)
+    finally:
+        stop.set()
+        t.join(120)
+        client_log.close()
+    assert not t.is_alive() and rc.get("rc") == 0
+
+    tele = os.path.join(cfg.fleet.dir, "telemetry")
+    streams = [client_jsonl, cfg.metrics_jsonl] + sorted(
+        os.path.join(tele, n) for n in os.listdir(tele)
+        if n.endswith(".jsonl"))
+    spans = []
+    for path in streams:
+        # Every stream — client, router, every replica — passes the
+        # strict schema lint (unknown kinds rejected).
+        assert check_jsonl_schema.check_file(path, strict=True) == [], \
+            path
+        with open(path) as f:
+            spans.extend(r for r in (json.loads(ln) for ln in f
+                                     if ln.strip())
+                         if r["kind"] == "rspan")
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+
+    # Every client trace is causally complete: the request is visible
+    # at every hop of the path that served it.
+    chain = ("client", "router", "worker", "batcher", "engine")
+    for tid in trace_ids:
+        hops = {s["hop"] for s in by_trace[tid]}
+        assert set(chain) <= hops, (tid, hops)
+        # ... and the batcher/engine spans link to a real batch span.
+        links = {s.get("batch_id") for s in by_trace[tid]
+                 if s["hop"] in ("batcher", "engine")} - {None}
+        assert links and links <= set(by_trace), (tid, links)
+
+    # The kill left at least one retried request whose trace shows BOTH
+    # placements: router attempt spans naming two distinct replicas.
+    retried = [tid for tid in trace_ids
+               if len({s.get("replica_id")
+                       for s in by_trace[tid]
+                       if s["hop"] == "router"
+                       and s.get("replica_id") is not None}) >= 2]
+    assert retried, "no trace recorded a failover across replicas"
+
+    # Per-hop durations nest inside the measured end-to-end latency:
+    # queue wait + device share fit in the worker's handler span, which
+    # fits in the client's wall time (generous slack for scheduling).
+    for tid in trace_ids:
+        by_hop = {}
+        for s in by_trace[tid]:
+            by_hop.setdefault(s["hop"], []).append(s["dur_ms"])
+        interior = max(by_hop["batcher"]) + max(by_hop["engine"])
+        assert interior <= max(by_hop["worker"]) + 100.0, (tid, by_hop)
+        assert max(by_hop["worker"]) <= e2e[tid] * 1e3 + 150.0, \
+            (tid, by_hop, e2e[tid])
+
+    # The merged Perfetto file causally links the hops: one flow id per
+    # multi-span trace, threading start → finish.
+    doc = build_merged_trace(streams)
+    flow = [e for e in doc["traceEvents"]
+            if e.get("cat") == "rspan" and e["ph"] in ("s", "t", "f")]
+    starts = sum(1 for e in flow if e["ph"] == "s")
+    finishes = sum(1 for e in flow if e["ph"] == "f")
+    assert starts == finishes and starts >= len(set(trace_ids))
+    lanes = {(e["pid"], e["tid"]) for e in doc["traceEvents"]
+             if e.get("cat") == "rspan" and e["ph"] == "X"}
+    assert len(lanes) >= len(chain)

@@ -286,7 +286,7 @@ class ChaosHarness:
         slowest = 0.0
         if not os.path.exists(path):
             return "schema: metrics stream missing", injected, slowest
-        errs = check_jsonl_schema.check_file(path)
+        errs = check_jsonl_schema.check_file(path, strict=True)
         if errs:
             return f"schema: {errs[0]}", injected, slowest
         with open(path) as f:

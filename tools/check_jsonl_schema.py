@@ -6,14 +6,17 @@ consumer — ``tools/telemetry_report.py``, ``tools/convergence_report.py``,
 ad-hoc pandas — parses. This lint enforces the contract documented in
 ``docs/OBSERVABILITY.md``: every line is strict JSON (no NaN/Infinity
 tokens — the writer maps non-finite floats to null), every record carries
-the base keys, and each known ``kind`` carries its required keys. Unknown
-kinds are errors: a new record kind must be added to ``KIND_KEYS`` here
-AND to the schema table in the doc, which is exactly the drift this lint
-exists to catch.
+the base keys, and each known ``kind`` carries its required keys.
 
-Usage: ``python tools/check_jsonl_schema.py run.jsonl [more.jsonl ...]``
-(exit 1 on any violation). ``tests/test_telemetry.py`` runs it over a
-real training run's stream as part of the tier-1 suite.
+Unknown kinds are tolerated by default (a stream from a NEWER build must
+stay lintable by an older tool) but rejected under ``--strict``: a new
+record kind must be added to ``KIND_KEYS`` here AND to the schema table
+in the doc, which is exactly the drift strict mode exists to catch — a
+typo'd kind never lints again. The tier-1 suite runs strict everywhere.
+
+Usage: ``python tools/check_jsonl_schema.py [--strict] run.jsonl
+[more.jsonl ...]`` (exit 1 on any violation). ``tests/test_telemetry.py``
+runs it over a real training run's stream as part of the tier-1 suite.
 """
 
 from __future__ import annotations
@@ -156,6 +159,20 @@ KIND_KEYS = {
     "swap_rejected": ("replica_id", "version", "reason"),
     "scale": ("action", "reason", "replicas"),
     "fleet_publish": ("seq", "version", "step", "path"),
+    # Distributed request tracing (utils/reqtrace.py;
+    # docs/OBSERVABILITY.md Request-tracing section). One span per hop
+    # a sampled-or-forced request crossed: `trace_id` is the join key
+    # across process streams, `hop` the stage (client / router / server
+    # / worker / batcher / engine / batch), `dur_ms` the hop's own
+    # latency contribution, `wallclock` unix seconds at hop start (what
+    # places the span on the merged timeline). Hop-specific context
+    # (batch_id, version, shed, attempt, replica_id) rides as extra
+    # keys.
+    "rspan": ("trace_id", "hop", "dur_ms", "wallclock"),
+    # Flight recorder (utils/flightrec.py). One record per post-mortem
+    # bundle captured on an alert firing: the rule that fired, the
+    # bundle directory, and how many ring records it snapshotted.
+    "postmortem": ("rule", "dir", "records"),
 }
 
 
@@ -163,9 +180,11 @@ def _reject_constant(name: str):
     raise ValueError(f"non-strict JSON constant {name}")
 
 
-def check_lines(lines: Iterable[str], source: str = "<stream>"
-                ) -> List[str]:
-    """Validate JSONL lines; returns a list of human-readable errors."""
+def check_lines(lines: Iterable[str], source: str = "<stream>",
+                strict: bool = False) -> List[str]:
+    """Validate JSONL lines; returns a list of human-readable errors.
+    ``strict`` additionally rejects unknown kinds (see module
+    docstring)."""
     errors = []
     for ln, line in enumerate(lines, 1):
         line = line.strip()
@@ -185,9 +204,11 @@ def check_lines(lines: Iterable[str], source: str = "<stream>"
             errors.append(f"{where}: missing base keys {missing}")
         kind = rec.get("kind")
         if kind not in KIND_KEYS:
-            errors.append(
-                f"{where}: unknown kind {kind!r} (add it to "
-                f"tools/check_jsonl_schema.py and docs/OBSERVABILITY.md)")
+            if strict:
+                errors.append(
+                    f"{where}: unknown kind {kind!r} (add it to "
+                    f"tools/check_jsonl_schema.py and "
+                    f"docs/OBSERVABILITY.md)")
             continue
         missing = [k for k in KIND_KEYS[kind] if k not in rec]
         if missing:
@@ -200,9 +221,9 @@ def check_lines(lines: Iterable[str], source: str = "<stream>"
     return errors
 
 
-def check_file(path: str) -> List[str]:
+def check_file(path: str, strict: bool = False) -> List[str]:
     with open(path) as f:
-        return check_lines(f, source=path)
+        return check_lines(f, source=path, strict=strict)
 
 
 def list_kinds() -> List[str]:
@@ -218,14 +239,18 @@ def main(argv=None) -> int:
         for kind in list_kinds():
             print(kind)
         return 0
+    strict = False
+    while "--strict" in argv:
+        argv.remove("--strict")
+        strict = True
     if not argv:
         print(__doc__.strip().splitlines()[0])
-        print("usage: check_jsonl_schema.py [--list-kinds] "
+        print("usage: check_jsonl_schema.py [--strict] [--list-kinds] "
               "FILE.jsonl [...]")
         return 2
     failed = False
     for path in argv:
-        errs = check_file(path)
+        errs = check_file(path, strict=strict)
         for e in errs:
             print(e)
         if errs:

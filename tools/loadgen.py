@@ -134,13 +134,16 @@ class ClientStats:
         self.shed = 0
         self.rejected = 0
         self.latencies = []
+        self.samples = []   # (latency_s, trace_id, version) per completion
         self.versions = {}
 
-    def record(self, outcome: str, dt: float = 0.0, version=None):
+    def record(self, outcome: str, dt: float = 0.0, version=None,
+               trace_id=None):
         with self.lock:
             if outcome == "ok":
                 self.completed += 1
                 self.latencies.append(dt)
+                self.samples.append((dt, trace_id, version))
                 if version is not None:
                     key = str(version)
                     self.versions[key] = self.versions.get(key, 0) + 1
@@ -156,14 +159,17 @@ class _HttpClient:
     def __init__(self, target: str):
         self.target = target.rstrip("/")
 
-    def predict(self, body: bytes):
+    def predict(self, body: bytes, trace_header=None):
         """("ok", version) | ("shed", None) | ("rejected", None)."""
         import urllib.error
         import urllib.request
 
+        headers = {"Content-Type": "application/octet-stream"}
+        if trace_header:
+            from dml_cnn_cifar10_tpu.utils import reqtrace
+            headers[reqtrace.TRACE_HEADER] = trace_header
         req = urllib.request.Request(
-            f"{self.target}/predict", data=body,
-            headers={"Content-Type": "application/octet-stream"})
+            f"{self.target}/predict", data=body, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
                 payload = json.loads(resp.read())
@@ -231,6 +237,11 @@ def run_open(submit, images, args, stats, rate_fn=None,
 def _row(stats: ClientStats, wall: float, latency_summary) -> dict:
     total = stats.completed + stats.shed
     lat = latency_summary(stats.latencies)
+    # The p99 exemplars: each slowest request's trace_id makes it
+    # directly findable in the merged Perfetto trace
+    # (tools/trace_aggregate.py --out), and its version says which
+    # weights answered it.
+    slowest = sorted(stats.samples, key=lambda s: -s[0])[:5]
     return {
         "requests": total,
         "completed": stats.completed,
@@ -244,6 +255,9 @@ def _row(stats: ClientStats, wall: float, latency_summary) -> dict:
             "max": lat["max_ms"],
         },
         "version_mix": dict(stats.versions),
+        "slowest": [{"latency_ms": round(dt * 1e3, 3),
+                     "trace_id": tid, "version": ver}
+                    for dt, tid, ver in slowest],
     }
 
 
@@ -281,15 +295,26 @@ def main(argv=None) -> int:
     ap.add_argument("--dataset", type=str, default="synthetic")
     ap.add_argument("--data_dir", type=str, default="cifar10data")
     ap.add_argument("--metrics_jsonl", type=str, default=None,
-                    help="also append serve/serve_done JSONL records "
-                         "(in-process only)")
+                    help="also append JSONL records: client rspan spans "
+                         "(both targets) and serve/serve_done windows "
+                         "(in-process)")
+    ap.add_argument("--trace_sample_rate", type=float, default=0.0,
+                    help="head-sample this fraction of requests for "
+                         "end-to-end tracing (rspan records; shed or "
+                         "retried requests are always captured)")
     ap.add_argument("--report", type=str, default="loadgen_report.json")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
     import numpy as np
 
+    from dml_cnn_cifar10_tpu.utils import reqtrace
     from dml_cnn_cifar10_tpu.utils.telemetry import latency_summary
+
+    logger = None
+    if args.metrics_jsonl:
+        from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+        logger = MetricsLogger(jsonl_path=args.metrics_jsonl)
 
     mixes = None
     if args.mix:
@@ -313,9 +338,17 @@ def main(argv=None) -> int:
             # any worker behind the router) must answer 400 without
             # disturbing in-flight well-formed requests.
             body = img.tobytes() + (b"\x00" if oversize else b"")
+            ctx = reqtrace.mint(args.trace_sample_rate)
             t0 = time.perf_counter()
-            outcome, version = client.predict(body)
-            stats.record(outcome, time.perf_counter() - t0, version)
+            outcome, version = client.predict(
+                body, trace_header=ctx.header())
+            dt = time.perf_counter() - t0
+            if outcome == "shed":
+                ctx.force()
+            reqtrace.emit_span(logger, ctx, "client", dt,
+                               reqtrace.wallclock_at(t0),
+                               outcome=outcome, version=version)
+            stats.record(outcome, dt, version, trace_id=ctx.trace_id)
     else:
         from dml_cnn_cifar10_tpu.serve.batcher import (MicroBatcher,
                                                        ShedError)
@@ -330,7 +363,7 @@ def main(argv=None) -> int:
             batch_window_s=args.batch_window_ms / 1e3,
             default_deadline_s=None if args.deadline_ms is None
             else args.deadline_ms / 1e3,
-            metrics=metrics)
+            metrics=metrics, logger=logger)
         print(f"[loadgen] engine ready (compile_s="
               f"{batcher.compile_secs}); driving for "
               f"{args.duration_s}s per profile", flush=True)
@@ -341,13 +374,23 @@ def main(argv=None) -> int:
             if oversize:
                 img = np.zeros((img.shape[0] + 1, *img.shape[1:]),
                                np.uint8)
+            ctx = reqtrace.mint(args.trace_sample_rate)
             t0 = time.perf_counter()
             try:
-                row = batcher.submit(img).result()
-                stats.record("ok", time.perf_counter() - t0,
-                             getattr(row, "version", None))
+                row = batcher.submit(img, trace=ctx).result()
+                dt = time.perf_counter() - t0
+                version = getattr(row, "version", None)
+                reqtrace.emit_span(logger, ctx, "client", dt,
+                                   reqtrace.wallclock_at(t0),
+                                   outcome="ok", version=version)
+                stats.record("ok", dt, version, trace_id=ctx.trace_id)
             except ShedError:
-                stats.record("shed", time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                ctx.force()
+                reqtrace.emit_span(logger, ctx, "client", dt,
+                                   reqtrace.wallclock_at(t0),
+                                   outcome="shed")
+                stats.record("shed", dt, trace_id=ctx.trace_id)
             except ValueError:
                 stats.record("rejected")
 
@@ -410,11 +453,10 @@ def main(argv=None) -> int:
 
     if batcher is not None:
         batcher.close()
-        if args.metrics_jsonl:
-            from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
-            logger = MetricsLogger(jsonl_path=args.metrics_jsonl)
+        if logger is not None:
             metrics.emit(logger, final=True)
-            logger.close()
+    if logger is not None:
+        logger.close()
 
     with open(args.report, "w") as f:
         json.dump(report, f, indent=2)

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Render a flight-recorder post-mortem bundle into a human-readable
+timeline (and, optionally, a merged Perfetto trace).
+
+A bundle is the atomic directory ``utils/flightrec.py`` writes the
+moment an alert fires: ``ring.jsonl`` (the last N records the process
+logged, wallclock-stamped), ``alert.json`` (the firing that triggered
+the capture), ``config.json`` / ``env.json`` / ``context.json`` (what
+the process was, where it ran, what it was serving), and — for training
+captures — a ``devprof/`` directory once the one-shot device-profile
+window the capture armed has landed.
+
+Usage:
+  python tools/postmortem.py BUNDLE_DIR [more ...] [--out merged.json]
+
+``--out`` funnels the ring through ``tools/trace_aggregate.py``'s
+merged-trace builder, so ring ``rspan`` records become causally-linked
+hop lanes and everything else becomes instants on the shared clock —
+one file to open next to the run's other streams. With no bundle
+argument, ``--scan DIR`` lists the bundles under a ``--postmortem_dir``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def load_bundle(bundle_dir: str) -> dict:
+    """Parse one bundle directory into plain data (JSON-ready)."""
+    ring = []
+    ring_path = os.path.join(bundle_dir, "ring.jsonl")
+    try:
+        with open(ring_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        ring.append(json.loads(line))
+                    except ValueError:
+                        continue
+    except OSError:
+        pass
+    devprof = os.path.join(bundle_dir, "devprof")
+    return {
+        "dir": bundle_dir,
+        "alert": _read_json(os.path.join(bundle_dir, "alert.json")),
+        "env": _read_json(os.path.join(bundle_dir, "env.json")),
+        "context": _read_json(os.path.join(bundle_dir, "context.json")),
+        "config": _read_json(os.path.join(bundle_dir, "config.json")),
+        "ring": ring,
+        "devprof": devprof if os.path.isdir(devprof) else None,
+    }
+
+
+def render_bundle(b: dict, ring_tail: int = 40) -> str:
+    """The human timeline: what fired, who we were, and the ring's last
+    records leading up to the capture (newest last — read bottom-up
+    from the alert)."""
+    lines = [f"== post-mortem bundle {b['dir']} =="]
+    alert = b.get("alert") or {}
+    if alert:
+        lines.append(
+            f"  alert: [{alert.get('severity')}] {alert.get('rule')} "
+            f"(value {alert.get('value')}, window {alert.get('window')})")
+        if alert.get("captured_wallclock"):
+            lines.append(
+                f"  captured at unix {alert['captured_wallclock']}")
+    env = b.get("env") or {}
+    if env:
+        parts = [f"python {env.get('python')}"]
+        if env.get("jax"):
+            parts.append(f"jax {env['jax']}")
+        parts.append(f"pid {env.get('pid')}")
+        lines.append(f"  process: {', '.join(parts)}")
+    context = b.get("context") or {}
+    if context:
+        per = ", ".join(f"{k}={v}" for k, v in sorted(context.items()))
+        lines.append(f"  context: {per}")
+    if b.get("devprof"):
+        lines.append(f"  devprof window: {b['devprof']}")
+    ring = b.get("ring") or []
+    kinds = {}
+    for r in ring:
+        kinds[r.get("kind")] = kinds.get(r.get("kind"), 0) + 1
+    per = ", ".join(f"{k}: {n}" for k, n in sorted(kinds.items(),
+                                                   key=lambda kv: -kv[1]))
+    lines.append(f"  ring: {len(ring)} record(s) ({per})")
+    wall0 = next((r["wallclock"] for r in ring
+                  if isinstance(r.get("wallclock"), (int, float))), None)
+    tail = ring[-ring_tail:]
+    if len(ring) > len(tail):
+        lines.append(f"    ... {len(ring) - len(tail)} earlier "
+                     f"record(s) omitted")
+    for r in tail:
+        w = r.get("wallclock")
+        rel = (f"+{w - wall0:8.3f}s"
+               if isinstance(w, (int, float)) and wall0 is not None
+               else " " * 10)
+        detail = {k: v for k, v in r.items()
+                  if k not in ("kind", "wallclock")}
+        lines.append(f"    {rel} {r.get('kind')} "
+                     f"{json.dumps(detail, default=str)[:120]}")
+    return "\n".join(lines)
+
+
+def write_merged_trace(bundles: List[dict], out: str) -> int:
+    """Funnel the rings through trace_aggregate's merged-trace builder:
+    write each ring back out as a JSONL stream (its records already
+    carry absolute wallclocks) and build one Perfetto document. Returns
+    the event count."""
+    import tempfile
+
+    from tools.trace_aggregate import build_merged_trace
+
+    paths = []
+    with tempfile.TemporaryDirectory(prefix="postmortem_") as tmp:
+        for i, b in enumerate(bundles):
+            p = os.path.join(tmp, f"ring_{i}.jsonl")
+            wmin = min((r["wallclock"] for r in b["ring"]
+                        if isinstance(r.get("wallclock"), (int, float))),
+                       default=0.0)
+            with open(p, "w") as f:
+                for r in b["ring"]:
+                    # Ring records came through the observer hook, so
+                    # they lack the logger-written base keys — rebuild
+                    # `t` from the ring's wallclocks so the builder's
+                    # anchor recovery (wallclock − t) lands every record
+                    # at its true place on the merged clock.
+                    w = r.get("wallclock")
+                    t = (round(w - wmin, 6)
+                         if isinstance(w, (int, float)) else 0.0)
+                    rec = {"t": t, "task": i, **r}
+                    f.write(json.dumps(rec, default=str) + "\n")
+            paths.append(p)
+        doc = build_merged_trace(paths)
+        doc.setdefault("otherData", {})["bundles"] = \
+            [b["dir"] for b in bundles]
+        os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(doc, f)
+    return len(doc["traceEvents"])
+
+
+def scan(postmortem_dir: str) -> List[str]:
+    """Bundle directories under a ``--postmortem_dir``, oldest first
+    (the ``<rule>_<seq>`` names sort in capture order per rule)."""
+    try:
+        names = sorted(os.listdir(postmortem_dir))
+    except OSError:
+        return []
+    return [os.path.join(postmortem_dir, n) for n in names
+            if os.path.isfile(os.path.join(postmortem_dir, n,
+                                           "alert.json"))]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="render flight-recorder post-mortem bundles")
+    p.add_argument("bundles", nargs="*",
+                   help="bundle directories (flightrec captures)")
+    p.add_argument("--scan", default=None,
+                   help="list bundles under this --postmortem_dir "
+                        "(and render them all)")
+    p.add_argument("--out", default=None,
+                   help="write a merged Perfetto trace of the rings")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    args = p.parse_args(argv)
+    dirs = list(args.bundles)
+    if args.scan:
+        dirs.extend(scan(args.scan))
+    if not dirs:
+        p.error("no bundles given (pass directories or --scan DIR)")
+    loaded = [load_bundle(d) for d in dirs]
+    if args.format == "json":
+        print(json.dumps([{k: v for k, v in b.items()
+                           if k != "config"} for b in loaded],
+                         default=str))
+    else:
+        for b in loaded:
+            print(render_bundle(b))
+    if args.out:
+        n = write_merged_trace(loaded, args.out)
+        print(f"merged trace ({n} events) -> {args.out}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

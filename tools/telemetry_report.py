@@ -147,6 +147,43 @@ def _chaos_totals(records: List[dict]) -> Optional[dict]:
     }
 
 
+def _hop_breakdown(records: List[dict]) -> Optional[dict]:
+    """Per-hop request-latency breakdown from the ``rspan`` records
+    (utils/reqtrace.py): span/trace counts, p50/p99 per hop, and a
+    slowest-trace exemplar table (total = the sum of the trace's hop
+    durations; its trace_id is directly findable in the merged Perfetto
+    output). ``batch`` spans carry a batch_id as their trace_id and are
+    counted as a hop but excluded from the per-trace totals."""
+    spans = [r for r in records if r.get("kind") == "rspan"
+             and isinstance(r.get("dur_ms"), (int, float))]
+    if not spans:
+        return None
+    by_hop: dict = {}
+    by_trace: dict = {}
+    for r in spans:
+        hop = r.get("hop") or "?"
+        by_hop.setdefault(hop, []).append(r["dur_ms"])
+        if hop != "batch" and r.get("trace_id"):
+            ent = by_trace.setdefault(str(r["trace_id"]),
+                                      {"total_ms": 0.0, "hops": {},
+                                       "version": None})
+            ent["hops"][hop] = round(
+                ent["hops"].get(hop, 0.0) + r["dur_ms"], 3)
+            ent["total_ms"] = round(ent["total_ms"] + r["dur_ms"], 3)
+            if r.get("version") is not None:
+                ent["version"] = r["version"]
+    hops = [{"hop": hop, "spans": len(durs),
+             "p50_ms": round(percentile(durs, 50), 3),
+             "p99_ms": round(percentile(durs, 99), 3)}
+            for hop, durs in sorted(by_hop.items())]
+    slowest = [{"trace_id": tid, **ent}
+               for tid, ent in sorted(by_trace.items(),
+                                      key=lambda kv: -kv[1]["total_ms"])
+               [:5]]
+    return {"spans": len(spans), "traces": len(by_trace),
+            "hops": hops, "slowest": slowest}
+
+
 def _fmt_bytes(n: Optional[int]) -> str:
     if not n:
         return "-"
@@ -309,6 +346,27 @@ def summarize_records(records: List[dict], header: str) -> str:
                 f"    warmup: {len(warm)} bucket(s) ready in "
                 f"{wtotal:.2f} s total ({whits} cache hit(s), "
                 f"{len(warm) - whits} compile(s))")
+    # Request tracing (utils/reqtrace.py; docs/OBSERVABILITY.md
+    # Request-tracing section): which hop ate a slow request's latency,
+    # from this stream's rspan records.
+    hopbd = _hop_breakdown(records)
+    if hopbd:
+        lines.append(
+            f"  request tracing: {hopbd['spans']} span(s) across "
+            f"{hopbd['traces']} trace(s)")
+        for h in hopbd["hops"]:
+            lines.append(
+                f"    {h['hop']:<10} {h['spans']:>5} span(s)  "
+                f"p50 {h['p50_ms']:>9.3f} ms  p99 {h['p99_ms']:>9.3f} ms")
+        if hopbd["slowest"]:
+            lines.append("    slowest traces (sum of hop durations):")
+            for t in hopbd["slowest"]:
+                per = ", ".join(f"{hop} {ms}"
+                                for hop, ms in sorted(t["hops"].items()))
+                ver = f" v{t['version']}" if t.get("version") else ""
+                lines.append(
+                    f"      {t['trace_id']}: {t['total_ms']:.3f} ms"
+                    f"{ver} ({per})")
     # Fleet health (fleet/; docs/SERVING.md fleet section): replica
     # count over time, routing/eviction counters, hot-swap latency, and
     # what the autoscaler decided — the stream-side answer to "did the
@@ -630,6 +688,9 @@ def summarize_json(path: str) -> dict:
     if serve:
         out["serve"] = {k: v for k, v in serve.items()
                         if k not in ("kind", "t", "task")}
+    hopbd = _hop_breakdown(records)
+    if hopbd:
+        out["request_tracing"] = hopbd
     fleet_done = _last(records, "fleet_done") \
         or _last(records, "fleet")
     if fleet_done:

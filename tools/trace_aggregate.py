@@ -11,8 +11,10 @@ restarted whom, how far the hosts' steps skewed — need those streams on
 one clock. This tool:
 
 - recovers a per-stream unix offset from the ``heartbeat`` records'
-  ``wallclock`` field (median of ``wallclock − t``; streams without
-  heartbeats stay unaligned and are flagged),
+  ``wallclock`` field (median of ``wallclock − t``), falling back to
+  the ``serve``/``fleet`` window records' wallclock anchors for serving
+  processes, which publish no heartbeats; streams with neither stay
+  unaligned and are flagged,
 - merges records onto one timeline keyed by ``(task, step)``, with a
   per-host step-skew table (first-seen wall-clock spread of each step
   observed on ≥ 2 aligned hosts) and a straggler bar view,
@@ -23,9 +25,11 @@ one clock. This tool:
 - optionally writes ONE merged Perfetto/Chrome trace (``--out``):
   host-loop span lanes per process (rebuilt from ``span`` records),
   instant events for the notable kinds, counter tracks for
-  ``images_per_sec`` / ``device_step_ms`` — and, via ``--traces``, any
-  per-process Chrome trace files shifted onto the same clock using
-  their recorded ``epoch_unix_s``.
+  ``images_per_sec`` / ``device_step_ms``, request-tracing hop lanes
+  rebuilt from ``rspan`` records with one Chrome flow arrow per
+  ``trace_id`` linking a request's hops across processes — and, via
+  ``--traces``, any per-process Chrome trace files shifted onto the
+  same clock using their recorded ``epoch_unix_s``.
 
 Usage:
   python tools/trace_aggregate.py logs_0/m.jsonl logs_1/m.jsonl \\
@@ -74,14 +78,28 @@ def _median(vals):
     return vals[mid] if len(vals) % 2 else (vals[mid - 1] + vals[mid]) / 2
 
 
+#: Kinds whose ``wallclock`` anchors clock alignment, in preference
+#: order: heartbeats when the stream has them (training / cluster),
+#: else the serve/fleet window records — serving processes publish no
+#: heartbeats, and without this fallback every fleet stream was flagged
+#: unalignable.
+ANCHOR_KINDS = (("heartbeat",), ("serve", "serve_done",
+                                 "fleet", "fleet_done"))
+
+
 def clock_offset(records: List[dict]) -> Optional[float]:
     """Unix seconds at this stream's ``t == 0``, recovered from the
-    heartbeat records' wallclock anchors; None without heartbeats."""
-    deltas = [r["wallclock"] - r["t"] for r in records
-              if r.get("kind") == "heartbeat"
-              and isinstance(r.get("wallclock"), (int, float))
-              and isinstance(r.get("t"), (int, float))]
-    return _median(deltas)
+    ``wallclock`` anchors of heartbeat records — or, for serve/fleet
+    streams that have none, of their periodic window records. None when
+    no anchor kind carries a wallclock."""
+    for kinds in ANCHOR_KINDS:
+        deltas = [r["wallclock"] - r["t"] for r in records
+                  if r.get("kind") in kinds
+                  and isinstance(r.get("wallclock"), (int, float))
+                  and isinstance(r.get("t"), (int, float))]
+        if deltas:
+            return _median(deltas)
+    return None
 
 
 def summarize_host(path: str, records: List[dict]) -> dict:
@@ -223,7 +241,19 @@ def build_merged_trace(paths: List[str],
     streams = {p: load_stream(p) for p in paths}
     offsets = {p: clock_offset(recs) for p, recs in streams.items()}
     known = [v for v in offsets.values() if v is not None]
-    wall0 = min(known, default=0.0)
+    # rspan records carry ABSOLUTE wallclocks, so a stream that is
+    # otherwise unalignable still places its request spans correctly —
+    # include them when choosing the merged clock's zero.
+    rspan_walls = [r["wallclock"] for recs in streams.values()
+                   for r in recs
+                   if r.get("kind") == "rspan"
+                   and isinstance(r.get("wallclock"), (int, float))]
+    wall0 = min(known + ([min(rspan_walls)] if rspan_walls else []),
+                default=0.0)
+    #: request-tracing lanes, one tid per hop, in causal order.
+    hop_tid = {"client": 10, "router": 11, "server": 12, "worker": 12,
+               "batcher": 13, "engine": 14, "batch": 15}
+    flows: Dict[str, List[dict]] = {}
     events = []
     for path, recs in streams.items():
         tasks = [r.get("task") for r in recs if r.get("task") is not None]
@@ -254,6 +284,29 @@ def build_merged_trace(paths: List[str],
                                        "pid": task, "tid": 0,
                                        "ts": round(ts_us, 1),
                                        "args": {key: r[key]}})
+            elif kind == "rspan" \
+                    and isinstance(r.get("wallclock"), (int, float)):
+                # One hop of one traced request: placed by its ABSOLUTE
+                # wallclock (no stream offset needed), one lane per
+                # hop. The span is also registered under its trace_id
+                # so the flow pass below can causally link the hops.
+                hop = r.get("hop") or "hop"
+                span = {
+                    "ph": "X",
+                    "name": f"{hop} {str(r.get('trace_id'))[:8]}",
+                    "cat": "rspan",
+                    "pid": task, "tid": hop_tid.get(hop, 19),
+                    "ts": round((r["wallclock"] - wall0) * 1e6, 1),
+                    "dur": round((r.get("dur_ms") or 0.0) * 1e3, 1),
+                    "args": {k: v for k, v in r.items()
+                             if k in ("trace_id", "hop", "dur_ms",
+                                      "batch_id", "version", "shed",
+                                      "attempt", "status", "replica_id",
+                                      "error")},
+                }
+                events.append(span)
+                if r.get("trace_id"):
+                    flows.setdefault(str(r["trace_id"]), []).append(span)
             elif kind in EVENT_KINDS:
                 events.append({"ph": "i", "s": "p",
                                "name": f"{kind}"
@@ -262,6 +315,22 @@ def build_merged_trace(paths: List[str],
                                   else ""),
                                "pid": task, "tid": 0,
                                "ts": round(ts_us, 1)})
+    # Causal links: one Chrome flow per trace_id, connecting its hop
+    # spans in wallclock order (s → t... → f). Single-span traces (and
+    # batch spans, whose batch_id is its own trace_id) need no arrow —
+    # their membership is already in args.
+    for flow_id, (trace_id, spans) in enumerate(sorted(flows.items()), 1):
+        if len(spans) < 2:
+            continue
+        spans.sort(key=lambda s: s["ts"])
+        for i, span in enumerate(spans):
+            ph = "s" if i == 0 else ("f" if i == len(spans) - 1 else "t")
+            ev = {"ph": ph, "name": "request", "cat": "rspan",
+                  "id": flow_id, "pid": span["pid"], "tid": span["tid"],
+                  "ts": round(span["ts"] + min(span["dur"], 1.0), 1)}
+            if ph == "f":
+                ev["bp"] = "e"
+            events.append(ev)
     for idx, tpath in enumerate(trace_paths or []):
         try:
             with open(tpath) as f:
@@ -297,7 +366,7 @@ def render(agg: dict) -> str:
     lines = ["== run-wide aggregation =="]
     for h in agg["hosts"]:
         off = ("aligned" if h["offset_unix"] is not None
-               else "UNALIGNED (no heartbeat wallclocks)")
+               else "UNALIGNED (no wallclock anchors)")
         lines.append(
             f"  task {h['task']}: {h['records']} record(s), "
             f"{h['train_rows']} train row(s), last step "
