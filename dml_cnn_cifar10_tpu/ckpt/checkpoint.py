@@ -381,18 +381,24 @@ def _restore_one(path: str, target: Any, host_target: Any,
 def restore_checkpoint(ckpt_dir: str, target: Any,
                        sharding=None, on_fallback=None,
                        shard_io_threads: Optional[int] = None,
-                       logger=None) -> Any:
+                       logger=None, deadline_s: float = 0.0) -> Any:
     """Restore the newest VERIFIABLE checkpoint into ``target``'s
     structure, or return ``target`` unchanged if none exists.
 
     Candidates are walked newest→oldest: one that fails its integrity
     sidecar (``verify_checkpoint``) or fails to decode is skipped with a
-    warning (and ``on_fallback(step, path, reason)`` when given — the
-    Trainer logs a ``ckpt_fallback`` JSONL record) and the next older
-    checkpoint is tried, so a corrupt/truncated latest degrades a
-    restart by one checkpoint interval instead of killing it. When
-    nothing restores, the newest candidate's error is raised (integrity
-    failures everywhere raise a summary naming every skip).
+    warning (and ``on_fallback(step, path, reason, walk_ms)`` when given
+    — the Trainer logs a ``ckpt_fallback`` JSONL record carrying the
+    wall-clock spent in the walk so far) and the next older checkpoint
+    is tried, so a corrupt/truncated latest degrades a restart by one
+    checkpoint interval instead of killing it. When nothing restores,
+    the newest candidate's error is raised (integrity failures
+    everywhere raise a summary naming every skip).
+
+    ``deadline_s`` (``--restore_deadline_s``, 0 = unbounded) budgets the
+    whole fallback walk: once exceeded, the walk stops trying older
+    candidates and raises a classified restore error instead of grinding
+    through an arbitrarily deep pile of corrupt checkpoints.
 
     ``sharding`` (e.g. a replicated NamedSharding) places the restored
     arrays back on the mesh. ``shard_io_threads`` bounds the sharded
@@ -406,15 +412,25 @@ def restore_checkpoint(ckpt_dir: str, target: Any,
     host_target = None
     first_error: Optional[ValueError] = None
     skipped = []
+    walk_t0 = time.perf_counter()
+
+    def walk_ms():
+        return (time.perf_counter() - walk_t0) * 1000.0
 
     def note(step, path, reason):
         print(f"[ckpt] skipping checkpoint {path}: {reason}; falling "
               f"back to an older checkpoint", file=sys.stderr)
         skipped.append(f"{os.path.basename(path)}: {reason}")
         if on_fallback is not None:
-            on_fallback(step, path, reason)
+            on_fallback(step, path, reason, walk_ms())
 
     for step, fmt in candidates:
+        if deadline_s and (time.perf_counter() - walk_t0) > deadline_s:
+            raise ValueError(
+                f"checkpoint restore walk in {ckpt_dir} exceeded its "
+                f"{deadline_s:.1f}s deadline after {walk_ms():.0f}ms "
+                f"({len(skipped)} candidates skipped: "
+                f"{'; '.join(skipped)}); nothing restorable in budget")
         path = _ckpt_path(ckpt_dir, step, fmt)
         ok, reason = verify_checkpoint(path)
         if not ok:

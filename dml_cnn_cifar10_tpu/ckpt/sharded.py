@@ -159,7 +159,8 @@ def shard_checksum_path(fname: str) -> str:
 
 
 def _write_one_shard(ckpt_path: str, fname: str, part: Dict[str, list],
-                     on_event: Optional[OnEvent]) -> Tuple[str, int, float]:
+                     on_event: Optional[OnEvent],
+                     source: str = "disk") -> Tuple[str, int, float]:
     """Serialize + atomically write one shard data file, then commit its
     sha256 sidecar AFTER the data file lands (same ordering contract as
     the top-level checkpoint sidecars)."""
@@ -179,7 +180,7 @@ def _write_one_shard(ckpt_path: str, fname: str, part: Dict[str, list],
     os.replace(tmp, sc)
     secs = time.perf_counter() - t0
     _emit(on_event, op="save", shard=fname, bytes=len(data),
-          secs=round(secs, 6), verify=None)
+          secs=round(secs, 6), verify=None, source=source)
     return fname, len(data), secs
 
 
@@ -290,7 +291,8 @@ def finish_sharded_save(ckpt_path: str, payload: Dict[str, list],
 
 
 def _read_one_shard(ckpt_path: str, fname: str,
-                    on_event: Optional[OnEvent]) -> Dict[str, Any]:
+                    on_event: Optional[OnEvent],
+                    source: str = "disk") -> Dict[str, Any]:
     """Read + integrity-verify + unpack one shard file. A present
     sidecar must match exactly (digest AND byte count); a missing
     sidecar passes (pre-per-shard-integrity checkpoints stay
@@ -312,14 +314,16 @@ def _read_one_shard(ckpt_path: str, fname: str,
             verify = False
         if not verify:
             _emit(on_event, op="restore", shard=fname, bytes=len(data),
-                  secs=round(time.perf_counter() - t0, 6), verify=False)
+                  secs=round(time.perf_counter() - t0, 6), verify=False,
+                  source=source)
             raise ValueError(
                 f"shard file {fname} in {ckpt_path} failed sha256 "
                 f"integrity verification (corrupt/truncated shard or "
                 f"sidecar)")
     part = serialization.msgpack_restore(data)
     _emit(on_event, op="restore", shard=fname, bytes=len(data),
-          secs=round(time.perf_counter() - t0, 6), verify=verify)
+          secs=round(time.perf_counter() - t0, 6), verify=verify,
+          source=source)
     return part
 
 
@@ -362,7 +366,7 @@ def restore_sharded(ckpt_path: str, target: Any,
               f"from a crashed same-process-count save. Re-save to "
               f"upgrade the manifest.", file=sys.stderr)
         _emit(on_event, op="legacy_glob", shard=ckpt_path, bytes=None,
-              secs=None, verify=None)
+              secs=None, verify=None, source="disk")
     missing = [f for f in files
                if not os.path.exists(os.path.join(ckpt_path, f))]
     if missing:

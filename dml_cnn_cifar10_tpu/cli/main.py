@@ -466,6 +466,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "process re-enters restore at the larger size "
                         "(docs/RESILIENCE.md). false = shrink-only: "
                         "evicted hosts stay fenced")
+    p.add_argument("--peer_redundancy", type="bool", default=False,
+                   help="diskless recovery (ckpt/peerstore.py): at every "
+                        "checkpoint boundary each host also pushes its "
+                        "local shard payload to its ring-successor's "
+                        "replica store under --cluster_dir (async, "
+                        "off the step path, sha256 sidecars); on "
+                        "host_lost the chief may decide source=peer and "
+                        "survivors restore with ZERO checkpoint reads, "
+                        "reconstructing the lost host's shards from its "
+                        "replica; any missing/stale/corrupt replica "
+                        "falls back to the disk restore walk. n=1: "
+                        "no-op (flag legal)")
+    p.add_argument("--replica_keep", type=int, default=2,
+                   help="peer-replica retention: committed replica "
+                        "payloads kept per owner (newest K checkpoint "
+                        "boundaries)")
+    p.add_argument("--restore_deadline_s", type=float, default=0.0,
+                   help="wall-clock budget for the newest→oldest "
+                        "checkpoint fallback walk at restore; exceeding "
+                        "it raises a classified ckpt_restore error "
+                        "instead of scanning a huge retention dir "
+                        "forever (0 = unbounded)")
     p.add_argument("--cluster_lockstep", type="bool", default=False,
                    help="simulation only: make the dispatch seam a "
                         "software barrier over the heartbeat store so "
@@ -671,6 +693,9 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.parallel.collective_timeout_s = args.collective_timeout_s
     cfg.parallel.min_hosts = args.min_hosts
     cfg.parallel.elastic_expand = args.elastic_expand
+    cfg.parallel.peer_redundancy = args.peer_redundancy
+    cfg.parallel.replica_keep = args.replica_keep
+    cfg.restore_deadline_s = args.restore_deadline_s
     cfg.parallel.cluster_lockstep = args.cluster_lockstep
     cfg.shard_io_threads = args.shard_io_threads
     cfg.parallel.coordinator_timeout_s = args.coordinator_timeout_s

@@ -338,6 +338,18 @@ class ParallelConfig:
     # sidecar state to migrate). Off = the PR-4 shrink-only contract:
     # once evicted, fenced forever.
     elastic_expand: bool = False
+    # Peer-redundant in-memory shards (ckpt/peerstore.py;
+    # docs/RESILIENCE.md diskless-recovery section). At every checkpoint
+    # boundary each host pushes its local shard payload to its
+    # ring-successor's replica inbox under <cluster_dir>/replicas, so an
+    # elastic restart can reconstruct the lost host's state from a
+    # surviving peer instead of walking disk checkpoints. Requires
+    # cluster_dir; a 1-process world degrades to a no-op (the flag stays
+    # legal). Off = every restore reads disk, exactly as before.
+    peer_redundancy: bool = False
+    # Replica retention: committed replica step-dirs kept per owner
+    # before the push thread prunes the oldest.
+    replica_keep: int = 2
     # Simulation only: make the dispatch seam a software barrier over
     # the heartbeat store (wait for every live peer to reach the local
     # step) so multi-process CPU runs without real collectives still
@@ -520,6 +532,11 @@ class TrainConfig:
     # either way; per-shard sha256 sidecars verify each file before
     # assembly).
     shard_io_threads: int = 4
+    # Wall-clock budget for restore_checkpoint's newest→oldest fallback
+    # walk (ckpt/checkpoint.py): a walk that exceeds it raises a
+    # classified ckpt_restore error instead of silently scanning a huge
+    # retention dir forever. 0 = no deadline.
+    restore_deadline_s: float = 0.0
     # Overlap checkpoint serialize+write with training on a background
     # writer thread (the device->host fetch stays synchronous — donated
     # step buffers would otherwise race the reader).

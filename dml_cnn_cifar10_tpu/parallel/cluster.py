@@ -126,6 +126,13 @@ class RestartDecision:
     # arrived; the scale-UP half). Default keeps pre-expand decision
     # files decodable.
     kind: str = "shrink"
+    # Where survivors restore from: "disk" (the newest-verifiable
+    # checkpoint walk — the historical behavior) or "peer" (the
+    # peer-replica store, ckpt/peerstore.py: own shards from memory,
+    # lost hosts' from their ring-successors' replicas — zero
+    # checkpoint reads). Default keeps pre-redundancy decision files
+    # decodable AND restoring exactly as today.
+    source: str = "disk"
 
 
 class HeartbeatStore:
@@ -294,6 +301,7 @@ class RestartCoordinator:
         a coordinator loss: raise ``PeerLostError(chief)`` so the
         caller fails deterministically instead of polling forever."""
         deadline = time.time() + timeout_s
+        attempt = 0
         while True:
             d = self.read()
             if d is not None and d.epoch >= min_epoch:
@@ -302,7 +310,12 @@ class RestartCoordinator:
                 raise PeerLostError(
                     [0], f"no restart decision at epoch >= {min_epoch} "
                          f"within {timeout_s:.1f}s — coordinator lost")
-            time.sleep(poll_s)
+            # Shared bounded backoff (utils/backoff.py) instead of a
+            # fixed-cadence poll: N survivors polling one shared file
+            # at 20 Hz hammers the store at larger world sizes; the
+            # cap keeps adoption latency bounded at ~10x the base.
+            attempt += 1
+            time.sleep(backoff.delay_s(poll_s, poll_s * 10.0, attempt))
 
 
 class CollectiveWatchdog(threading.Thread):
@@ -429,6 +442,7 @@ class ClusterMonitor:
                  collective_timeout_s: float = 120.0,
                  min_hosts: int = 1, lockstep: bool = False,
                  elastic_expand: bool = False,
+                 peer_redundancy: bool = False, replica_keep: int = 2,
                  logger=None, abort_fn=None):
         self.cluster_dir = cluster_dir
         self.process_id = process_id
@@ -449,6 +463,18 @@ class ClusterMonitor:
         self.store = HeartbeatStore(cluster_dir, process_id)
         self.coordinator = RestartCoordinator(cluster_dir,
                                               log_fn=self.log)
+        # Peer-replica store (ckpt/peerstore.py): rides the monitor so
+        # its in-memory payload cache, push thread, and committed-step
+        # bookkeeping span supervisor restart attempts — exactly like
+        # the epoch/world state. None = diskless recovery off.
+        self.peer_store = None
+        self._pending_peer_restore = None
+        if peer_redundancy:
+            from dml_cnn_cifar10_tpu.ckpt.peerstore import \
+                PeerReplicaStore
+            self.peer_store = PeerReplicaStore(
+                cluster_dir, process_id, list(range(num_processes)),
+                keep=replica_keep, log_fn=self.log)
         self.watchdog = CollectiveWatchdog(
             self.store, self, straggler_after_s, peer_dead_after_s,
             collective_timeout_s, abort_fn=abort_fn)
@@ -456,7 +482,7 @@ class ClusterMonitor:
         self._publisher = threading.Thread(
             target=self._publish_loop, daemon=True,
             name="heartbeat-publisher")
-        self.store.publish(0, "init")
+        self.store.publish(0, "init", extra=self._beat_extra())
         self._publisher.start()
         self.watchdog.start()
 
@@ -476,6 +502,9 @@ class ClusterMonitor:
             min_hosts=parallel_cfg.min_hosts,
             lockstep=parallel_cfg.cluster_lockstep,
             elastic_expand=getattr(parallel_cfg, "elastic_expand", False),
+            peer_redundancy=getattr(parallel_cfg, "peer_redundancy",
+                                    False),
+            replica_keep=getattr(parallel_cfg, "replica_keep", 2),
             logger=logger, abort_fn=abort_fn)
 
     # -- identity / world ------------------------------------------------
@@ -509,10 +538,20 @@ class ClusterMonitor:
 
     # -- heartbeat publishing -------------------------------------------
 
+    def _beat_extra(self) -> Optional[Dict]:
+        """Replica staleness rides the heartbeat: the chief's decide
+        seam learns every host's newest pushed replica step — including
+        a LOST host's, from its last persisted beat — without ever
+        touching the replica store."""
+        if self.peer_store is None:
+            return None
+        return {"replica_step": self.peer_store.replica_step}
+
     def _publish_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval_s):
             if not self._stalled:
-                self.store.publish(self._step, self._phase)
+                self.store.publish(self._step, self._phase,
+                                   extra=self._beat_extra())
 
     def set_phase(self, phase: str) -> None:
         self._phase = phase
@@ -533,7 +572,7 @@ class ClusterMonitor:
         self._step = step
         self._phase = phase
         if not self._stalled:
-            self.store.publish(step, phase)
+            self.store.publish(step, phase, extra=self._beat_extra())
             now = time.time()
             if now - self._last_beat_log >= self.heartbeat_interval_s:
                 self._last_beat_log = now
@@ -590,6 +629,7 @@ class ClusterMonitor:
         ``PeerLostError``, an eviction raises ``EvictedError``."""
         if not self.lockstep:
             return
+        attempt = 0
         while True:
             self._raise_if_dead(step)
             self.check_evicted(step)
@@ -598,7 +638,12 @@ class ClusterMonitor:
                    for b in beats.values()):
                 return
             self.watchdog.check_peers()
-            time.sleep(poll_s)
+            # Bounded backoff (utils/backoff.py), reset per barrier: an
+            # in-sync world pays the base poll; a straggler-bound wait
+            # decays to the cap instead of re-scanning the store at
+            # 50 Hz for the whole gap.
+            attempt += 1
+            time.sleep(backoff.delay_s(poll_s, 0.2, attempt))
 
     def end_step(self, step: int) -> None:
         self._step = step
@@ -658,18 +703,57 @@ class ClusterMonitor:
     def decide_restart(self, lost: Sequence[int],
                        restore_step: int) -> RestartDecision:
         """Chief half of the protocol: shrink the world by the lost
-        hosts and commit the decision survivors will poll. Raises
-        ``PeerLostError`` (unrecoverable by world-shrink) when the
-        survivor set would fall under ``min_hosts``."""
+        hosts, pick the restore **source** (peer replicas when every
+        old-world host — the lost one included — advertised a pushed
+        replica; the disk walk otherwise), and commit the decision
+        survivors will poll. ``restore_step`` is the disk candidate
+        (newest checkpoint); a peer-sourced decision restores at the
+        replica step instead. Raises ``PeerLostError`` (unrecoverable
+        by world-shrink) when the survivor set would fall under
+        ``min_hosts``."""
         survivors = [p for p in self._survivors if p not in set(lost)]
         if len(survivors) < self.min_hosts:
             raise PeerLostError(
                 sorted(lost),
                 f"only {len(survivors)} survivor(s) left, below "
                 f"min_hosts={self.min_hosts}; halting")
+        source, step = self._choose_restore_source(restore_step)
         return self.coordinator.record(RestartDecision(
             epoch=self.epoch + 1, world_size=len(survivors),
-            restore_step=restore_step, survivors=survivors))
+            restore_step=step, survivors=survivors, source=source))
+
+    def _choose_restore_source(self, disk_step: int):
+        """Peer-vs-disk restore choice, from the heartbeat record: the
+        newest replica step every old-world host advertised (a lost
+        host's last beat persists in the store). Viable = every host
+        pushed at least once; the restore step is the MINIMUM advertised
+        replica step, the newest one every replica set can serve. The
+        choice is logged as a ``peer_replica`` ``decide`` record with
+        the staleness (beats ahead of the replica step) telemetry_report
+        surfaces."""
+        if self.peer_store is None or not self.peer_store.enabled:
+            return "disk", disk_step
+        beats = self.store.read_all()
+        steps = []
+        for pid in self._survivors:
+            if pid == self.process_id:
+                steps.append(self.peer_store.replica_step)
+                continue
+            beat = beats.get(pid)
+            extra = beat.extra if beat is not None else None
+            steps.append(int((extra or {}).get("replica_step", -1)))
+        peer_step = min(steps) if steps else -1
+        beat_step = max(
+            [b.step for p, b in beats.items() if p in self._survivors]
+            + [self._step])
+        ok = peer_step >= 0
+        self.log("peer_replica", op="decide",
+                 step=peer_step if ok else disk_step, owner=None,
+                 bytes=None, secs=None, ok=ok, error=None,
+                 staleness=max(beat_step - peer_step, 0) if ok else None)
+        if not ok:
+            return "disk", disk_step
+        return "peer", peer_step
 
     def await_restart(self, timeout_s: float) -> RestartDecision:
         """Non-chief half: poll for the chief's decision; fence if it
@@ -687,11 +771,31 @@ class ClusterMonitor:
         """Enter the new world: the decision's survivor set (smaller on
         a shrink, larger on an expand), next epoch, dead bookkeeping
         cleared (the dead are no longer expected — and a rejoined host
-        must stop counting as a corpse)."""
+        must stop counting as a corpse). A peer-sourced decision is
+        staged for the next attempt's restore seam
+        (:meth:`take_peer_restore`); the replica ring re-forms over the
+        new world."""
+        old_world = list(self._survivors)
         self.epoch = decision.epoch
         self._survivors = list(decision.survivors)
         self.watchdog.dead_peers.clear()
         self._phase = "restart"
+        if self.peer_store is not None:
+            if getattr(decision, "source", "disk") == "peer":
+                new = set(decision.survivors)
+                lost = [p for p in old_world if p not in new]
+                world = sorted(set(old_world) | new)
+                self._pending_peer_restore = (decision, world, lost)
+            self.peer_store.set_world(list(decision.survivors))
+
+    def take_peer_restore(self):
+        """One-shot handoff to the restore seam: the staged
+        ``(decision, old_world, lost)`` of an adopted peer-sourced
+        decision, or None. Consuming clears it — a disk fallback must
+        not replay the peer attempt on the attempt after."""
+        pending = self._pending_peer_restore
+        self._pending_peer_restore = None
+        return pending
 
     # -- coordinated elastic scale-UP (expand) ---------------------------
 
@@ -763,7 +867,8 @@ class ClusterMonitor:
         self.watchdog.disarm()
         self._stalled = False
         self._phase = "rejoin"
-        self.store.publish(self._step, "rejoin")
+        self.store.publish(self._step, "rejoin",
+                           extra=self._beat_extra())
 
     def await_inclusion(self, timeout_s: float,
                         poll_s: float = 0.05) -> RestartDecision:
@@ -772,6 +877,7 @@ class ClusterMonitor:
         refused (or coordinator-lost) rejoin: raise ``PeerLostError``
         so the caller can fence cleanly instead of polling forever."""
         deadline = time.time() + timeout_s
+        attempt = 0
         while True:
             d = self.coordinator.read()
             if d is not None and d.epoch > self.epoch \
@@ -783,12 +889,17 @@ class ClusterMonitor:
                         f"{self.process_id} at epoch > {self.epoch} "
                         f"within {timeout_s:.1f}s — rejoin refused or "
                         f"coordinator lost")
-            time.sleep(poll_s)
+            # Same bounded-backoff poll as await_decision: a waiting
+            # joiner must not hammer the shared decision file.
+            attempt += 1
+            time.sleep(backoff.delay_s(poll_s, poll_s * 10.0, attempt))
 
     # -- lifecycle -------------------------------------------------------
 
     def close(self) -> None:
         self._stop.set()
         self.watchdog.stop()
+        if self.peer_store is not None:
+            self.peer_store.close()
         self._publisher.join(timeout=2.0)
         self.watchdog.join(timeout=2.0)

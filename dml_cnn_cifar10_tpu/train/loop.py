@@ -20,6 +20,7 @@ Faithful-mode details mirrored deliberately:
 from __future__ import annotations
 
 import dataclasses
+import sys
 import time
 from typing import Optional
 
@@ -29,6 +30,7 @@ import numpy as np
 
 from dml_cnn_cifar10_tpu import ckpt as ckpt_lib
 from dml_cnn_cifar10_tpu import compilecache
+from dml_cnn_cifar10_tpu.ckpt import peerstore as peerstore_lib
 from dml_cnn_cifar10_tpu.config import TrainConfig
 from dml_cnn_cifar10_tpu.data import pipeline as pipe
 from dml_cnn_cifar10_tpu.models.registry import get_model
@@ -227,13 +229,14 @@ class Trainer:
             self.cfg.optim, self.mesh, state_sharding=sharding,
             compile_cache=self.compile_cache)
 
-        def note_fallback(step, path, reason):
+        def note_fallback(step, path, reason, walk_ms=None):
             # A skipped candidate during the newest-verifiable walk
             # (ckpt/checkpoint.py) — surfaced in the JSONL stream so a
             # restart that silently lost a checkpoint interval is
-            # visible after the fact.
+            # visible after the fact. walk_ms is the wall-clock spent
+            # in the walk so far (--restore_deadline_s budgets it).
             self.logger.log("ckpt_fallback", step=step, path=path,
-                            error=str(reason))
+                            error=str(reason), walk_ms=walk_ms)
 
         if self.faults is not None:
             # Recovery-phase injection seam (utils/faults.py): a
@@ -247,11 +250,54 @@ class Trainer:
                                    logger=self.logger,
                                    cluster=self.cluster)
 
+        restored = self._restore_from_peers(state, sharding)
+        if restored is not None:
+            return restored
+
         return ckpt_lib.restore_checkpoint(
             self.cfg.log_dir, state, sharding=sharding,
             on_fallback=note_fallback,
             shard_io_threads=self.cfg.shard_io_threads,
-            logger=self.logger)
+            logger=self.logger,
+            deadline_s=self.cfg.restore_deadline_s)
+
+    def _restore_from_peers(self, state, sharding):
+        """Diskless restore (ckpt/peerstore.py): when the adopted
+        restart decision says ``source="peer"``, rebuild the state from
+        the survivors' in-memory payloads plus the lost hosts' replicas
+        — zero checkpoint reads. Any classified miss (replica missing,
+        stale, or corrupt) logs an explicit ``peer_replica`` fallback
+        record and returns None, so the caller runs the unchanged disk
+        walk. None also when no peer-sourced decision is pending."""
+        cluster = self.cluster
+        if cluster is None or cluster.peer_store is None:
+            return None
+        pending = cluster.take_peer_restore()
+        if pending is None:
+            return None
+        decision, world, lost = pending
+        store = cluster.peer_store
+        from dml_cnn_cifar10_tpu.ckpt.checkpoint import _logger_on_event
+        on_event = _logger_on_event(self.logger)
+        try:
+            restored = store.restore(state, decision.restore_step,
+                                     world, lost=lost,
+                                     on_event=on_event)
+        except peerstore_lib.ReplicaMiss as e:
+            cluster.log("peer_replica", op="fallback",
+                        step=decision.restore_step, owner=None,
+                        bytes=None, secs=None, ok=False,
+                        error=str(e)[:300], staleness=None)
+            print(f"[ckpt] peer restore at step "
+                  f"{decision.restore_step} not servable ({e}); "
+                  f"falling back to the disk restore walk",
+                  file=sys.stderr)
+            return None
+        if sharding is not None:
+            restored = jax.device_put(restored, sharding)
+        print(f"[ckpt] restored step {decision.restore_step} from peer "
+              f"replicas (zero checkpoint reads)")
+        return restored
 
     def _placed(self, batch: pipe.Batch):
         return mesh_lib.shard_batch(
@@ -628,8 +674,18 @@ class Trainer:
             if self.cluster is not None:
                 self.cluster.set_phase("checkpoint")
             with tracer.span("checkpoint", cat="checkpoint"):
-                return ckpt_mgr.maybe_save(save_state, step, force=force,
-                                           data_state=data_state)
+                saved = ckpt_mgr.maybe_save(save_state, step, force=force,
+                                            data_state=data_state)
+            if saved and self.cluster is not None:
+                store = self.cluster.peer_store
+                if store is not None and store.enabled:
+                    # Peer redundancy (ckpt/peerstore.py): mirror this
+                    # boundary's shard payload to the ring successor.
+                    # Collect happens here on the step thread (donated
+                    # buffers are not touched off-thread); only the
+                    # file push runs in the store's background worker.
+                    store.push_state_async(step, save_state)
+            return saved
 
         def _numerics_halt(loss, step):
             self.logger.log("numerics_halt", step=step)

@@ -144,7 +144,8 @@ def _adopt_decision(cfg: TrainConfig, monitor, decision, logger,
     fields = dict(step=decision.restore_step,
                   restore_step=decision.restore_step,
                   world_size=decision.world_size, epoch=decision.epoch,
-                  attempt=attempt)
+                  attempt=attempt,
+                  source=getattr(decision, "source", "disk"))
     if expand:
         joined = [p for p in decision.survivors if p not in prev]
         logger.log("elastic_expand", joined=joined, **fields)

@@ -98,7 +98,8 @@ from typing import List, Optional, Sequence
 
 FAULT_KINDS = ("nan", "ckpt_corrupt", "sigterm", "data_stall",
                "heartbeat_stall", "host_lost", "collective_hang",
-               "host_return", "decision_corrupt")
+               "host_return", "decision_corrupt", "replica_corrupt",
+               "replica_stale")
 
 #: Recovery-path seams a fault may be phase-qualified to
 #: (``kind@phase``). The seams are supervisor-owned: ``restore`` fires
@@ -247,6 +248,85 @@ def corrupt_latest_checkpoint(log_dir: str) -> Optional[str]:
     return path
 
 
+def _committed_replica_steps(cluster):
+    """``(owner_dir_path, step)`` pairs of every COMMITTED peer replica
+    (``INDEX.json`` present) under the cluster's replica store, newest
+    step first. Empty when peer redundancy is off or nothing committed
+    yet."""
+    from dml_cnn_cifar10_tpu.ckpt import peerstore
+
+    root = os.path.join(cluster.cluster_dir, peerstore.REPLICAS_DIRNAME)
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for host in sorted(os.listdir(root)):
+        hdir = os.path.join(root, host)
+        if not os.path.isdir(hdir):
+            continue
+        for name in os.listdir(hdir):
+            sdir = os.path.join(hdir, name)
+            if name.endswith(".tmp") or not os.path.isdir(sdir):
+                continue
+            if not os.path.exists(
+                    os.path.join(sdir, peerstore.INDEX)):
+                continue
+            try:
+                step = int(name.split("_", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            out.append((sdir, step))
+    out.sort(key=lambda t: (-t[1], t[0]))
+    return out
+
+
+def corrupt_peer_replicas(cluster) -> List[str]:
+    """Truncate one payload file inside every owner's NEWEST committed
+    peer replica — the replica set the next diskless restore would read.
+    The sidecar verify catches the damage (classified
+    :class:`~dml_cnn_cifar10_tpu.ckpt.peerstore.ReplicaMiss`) and the
+    restore falls back to disk. Returns the corrupted paths (empty when
+    nothing is committed yet — the event stays pending, like
+    ``ckpt_corrupt``)."""
+    victims = []
+    seen_hosts = set()
+    for sdir, _step in _committed_replica_steps(cluster):
+        host = os.path.basename(os.path.dirname(sdir))
+        if host in seen_hosts:
+            continue  # newest-first: only each owner's newest replica
+        seen_hosts.add(host)
+        parts = sorted(n for n in os.listdir(sdir)
+                       if n.endswith(".msgpack"))
+        if not parts:
+            continue
+        victim = os.path.join(sdir, parts[0])
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.truncate(size // 2)
+        victims.append(victim)
+    return victims
+
+
+def stale_peer_replicas(cluster) -> List[str]:
+    """Delete every owner's NEWEST committed peer replica step dir,
+    leaving any older ones — the beats still advertise the deleted step
+    (the stores' counters know nothing of the tampering), so a chief
+    that decides ``source=peer`` finds only older-or-no replicas and
+    the restore classifies a miss → disk fallback. Returns the deleted
+    dirs (empty = stay pending)."""
+    import shutil
+
+    removed = []
+    seen_hosts = set()
+    for sdir, _step in _committed_replica_steps(cluster):
+        host = os.path.basename(os.path.dirname(sdir))
+        if host in seen_hosts:
+            continue
+        seen_hosts.add(host)
+        shutil.rmtree(sdir, ignore_errors=True)
+        removed.append(sdir)
+    return removed
+
+
 def corrupt_decision_file(cluster) -> str:
     """Corrupt the cluster's restart-decision file the *nasty* way: a
     decodable but bogus decision (absurd epoch, empty survivor set —
@@ -300,6 +380,19 @@ CHAOS_CLUSTER_VOCABULARY = CHAOS_VOCABULARY + (
 CHAOS_EXPAND_VOCABULARY = (
     "nan@step", "ckpt_corrupt@step", "data_stall@step",
     "ckpt_corrupt@restore", "data_stall@restore",
+)
+
+#: Vocabulary for the 2-process ``peer_recovery`` scenario (peer
+#: redundancy ON): the full cluster vocabulary PLUS the replica faults.
+#: The replica kinds live ONLY here — they stay pending until a replica
+#: is committed, so a scenario with redundancy off would schedule
+#: faults that can never fire and trip the scheduled-vs-injected count
+#: invariant. Compound double-faults (backbone ``host_lost`` and a
+#: drawn ``replica_corrupt``/``replica_stale`` on the survivor) are the
+#: point: the diskless restore must degrade to the disk walk cleanly,
+#: still bit-identical.
+CHAOS_PEER_VOCABULARY = CHAOS_CLUSTER_VOCABULARY + (
+    "replica_corrupt@step", "replica_stale@step",
 )
 
 
@@ -459,6 +552,26 @@ class FaultInjector:
                 ev.fired = True
                 path = corrupt_decision_file(cluster)
                 self._log(logger, step, ev.kind, path=path)
+            elif ev.kind == "replica_corrupt":
+                if cluster is None:
+                    raise InjectedFault(
+                        "replica_corrupt injection needs --cluster_dir "
+                        "(no peer-replica store to corrupt)")
+                paths = corrupt_peer_replicas(cluster)
+                if not paths:
+                    continue  # no committed replica yet — stay pending
+                ev.fired = True
+                self._log(logger, step, ev.kind, path=paths[0])
+            elif ev.kind == "replica_stale":
+                if cluster is None:
+                    raise InjectedFault(
+                        "replica_stale injection needs --cluster_dir "
+                        "(no peer-replica store to age)")
+                paths = stale_peer_replicas(cluster)
+                if not paths:
+                    continue  # no committed replica yet — stay pending
+                ev.fired = True
+                self._log(logger, step, ev.kind, path=paths[0])
             elif ev.kind == "host_return":
                 if cluster is None:
                     raise InjectedFault(

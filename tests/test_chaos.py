@@ -355,6 +355,36 @@ def test_phase_triggers_fire_once_each_in_cluster_sim(chaos_workdir,
     assert any(r["kind"] == "ckpt_fallback" for r in stream)
 
 
+def test_chaos_peer_recovery_scenario_smoke(chaos_workdir, chaos_refs):
+    """ISSUE-14 satellite: the diskless-recovery chaos scenario — the
+    2-process shrink drill with peer redundancy ON and a replica fault
+    fired one step before the backbone host loss. The campaign must
+    pass every invariant, including the replica-fault pairing rule (a
+    damaged replica read by an elastic restart leaves a peer_replica
+    reconstruct or disk-fallback record), and the recovery must stay
+    bit-identical to the shared fault-free oracle (a peer-path restore
+    equals a disk restore by construction)."""
+    jsonl = str(chaos_workdir / "peer.jsonl")
+    summary = chaos_lib.run_campaign(
+        seeds=[0], scenario="peer_recovery",
+        workdir=str(chaos_workdir / "peer"),
+        metrics_jsonl=jsonl, refs=chaos_refs,
+        explicit_spec="replica_corrupt@14")
+    assert summary["failed"] == 0, summary
+    assert summary["faults_by_kind"].get("replica_corrupt") == 1
+    assert summary["faults_by_kind"].get("host_lost") == 1
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(jsonl, strict=True) == []
+    # The survivor's stream shows the fallback/reconstruct answer the
+    # pairing invariant demands.
+    stream = _read_jsonl(os.path.join(
+        str(chaos_workdir / "peer"), "run_001_seed0", "logs_0",
+        "metrics.jsonl"))
+    answers = [r for r in stream if r["kind"] == "peer_replica"
+               and r["op"] in ("reconstruct", "fallback")]
+    assert answers
+
+
 def test_chief_killed_between_decide_and_restore(chaos_workdir,
                                                  chaos_refs):
     """ISSUE-10 acceptance: the chief commits a shrink decision and is

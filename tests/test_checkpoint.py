@@ -67,7 +67,8 @@ def test_truncated_latest_falls_back_to_older(tmp_path):
     notes = []
     restored = ckpt_lib.restore_checkpoint(
         str(tmp_path), _state(seed=9),
-        on_fallback=lambda step, path, why: notes.append((step, why)))
+        on_fallback=lambda step, path, why, walk_ms: notes.append(
+            (step, why)))
     for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert notes and notes[0][0] == 2 and "mismatch" in notes[0][1]

@@ -238,12 +238,12 @@ def test_report_world_size_timeline_and_rejoins():
          "process_id": 1, "reason": "stale_heartbeat"},
         {"kind": "elastic_restart", "t": 1.1, "task": 0, "step": 15,
          "restore_step": 10, "world_size": 1, "epoch": 1, "attempt": 1,
-         "lost": [1]},
+         "lost": [1], "source": "disk"},
         {"kind": "host_rejoin", "t": 2.0, "task": 0, "step": 18,
          "process_id": 1, "epoch": 1},
         {"kind": "elastic_expand", "t": 2.1, "task": 0, "step": 19,
          "restore_step": 10, "world_size": 2, "epoch": 2, "attempt": 2,
-         "joined": [1]},
+         "joined": [1], "source": "disk"},
     ]
     assert check_jsonl_schema.check_lines(
         (json.dumps(r) for r in recs), strict=True) == []

@@ -379,7 +379,8 @@ def test_checkpoint_cross_layout_sidecar_fallback(tmp_path, rng):
         DATA, _optim(), mesh, state_sharding=sh_n)
     restored = ckpt_lib.restore_checkpoint(
         d, fresh, sharding=sh_n,
-        on_fallback=lambda step, path, reason: fallbacks.append(step))
+        on_fallback=lambda step, path, reason, walk_ms: fallbacks.append(
+            step))
     assert fallbacks == [2]
     assert int(jax.device_get(restored.step)) == 1
     for a, b in zip(jax.tree.leaves(good), jax.tree.leaves(restored.params)):

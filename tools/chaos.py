@@ -10,9 +10,11 @@ compound-fault schedules — several faults at one step, faults that
 strike inside recovery (``ckpt_corrupt@restore``,
 ``decision_corrupt@decide``), corruption of the coordination state
 itself — and runs each through the existing CPU sims (1-process
-supervised train, the 2-process cluster shrink drill, and the 2→1→2
-elastic-expand drill), checking after every run that the resilience
-stack actually held:
+supervised train, the 2-process cluster shrink drill, the 2→1→2
+elastic-expand drill, and the 2-process diskless-recovery drill with
+peer redundancy on and ``replica_corrupt``/``replica_stale`` in its
+vocabulary), checking after every run that the resilience stack
+actually held:
 
 - **bit_identical** — a recoverable schedule must end with final params
   bit-identical to the fault-free reference run (the exact-resume
@@ -40,6 +42,7 @@ Usage::
     python tools/chaos.py --spec "nan@15,ckpt_corrupt@15"  # one schedule
     python tools/chaos.py --seeds 8 --scenario cluster  # 2-process shrink sims
     python tools/chaos.py --seeds 4 --scenario expand   # 2→1→2 scale-UP sims
+    python tools/chaos.py --seeds 4 --scenario peer_recovery  # diskless-restore sims
 
 Exit 1 when any schedule violates an invariant. ``--plant
 no_decision_sidecar`` reverts the RestartCoordinator sidecar check
@@ -136,6 +139,10 @@ cfg.parallel.num_processes = n
 if cluster_dir:
     cfg.parallel.cluster_dir = cluster_dir
     cfg.parallel.cluster_lockstep = n > 1
+    # peer_recovery scenario: replicate shard payloads so the elastic
+    # restart restores from peers (source=peer) instead of disk.
+    cfg.parallel.peer_redundancy = bool(
+        os.environ.get("DML_CHAOS_PEER")) and n > 1
     # Multi-seat sims may re-admit returning hosts (the expand
     # scenario's whole point); the 1-process scenario keeps the fence
     # so an adopted-bogus-decision regression fails FAST instead of
@@ -172,9 +179,15 @@ EXPAND_HOLD = "host_return@18"
 
 #: Which reference digest oracles a scenario: all sims are numerically
 #: identical replicas of the 1-process run (per-seat data seeds
-#: coincide in the independent-world layout), so the expand scenario
-#: reuses the train oracle for BOTH seats.
-REF_ALIAS = {"expand": "train"}
+#: coincide in the independent-world layout), so the expand and
+#: peer_recovery scenarios reuse the train oracle — a peer-sourced
+#: restore must be BIT-IDENTICAL to a disk restore, which the shared
+#: oracle pins for free.
+REF_ALIAS = {"expand": "train", "peer_recovery": "train"}
+
+#: Scenarios that run the 2-process shrink drill (task 1 carries the
+#: backbone ``host_lost`` and must exit with its abrupt-death code).
+TWO_SEAT_SCENARIOS = ("cluster", "peer_recovery")
 
 
 @dataclasses.dataclass
@@ -225,11 +238,14 @@ class ChaosHarness:
 
     # -- process plumbing -------------------------------------------------
 
-    def _spawn(self, args, planted: bool):
+    def _spawn(self, args, planted: bool, peer: bool = False):
         env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env.pop("DML_CHAOS_PLANT", None)
         env.pop("DML_CHAOS_PLANT_CODE", None)
+        env.pop("DML_CHAOS_PEER", None)
+        if peer:
+            env["DML_CHAOS_PEER"] = "1"
         if planted and self.plant:
             env["DML_CHAOS_PLANT"] = self.plant
             env["DML_CHAOS_PLANT_CODE"] = PLANTS[self.plant]
@@ -328,6 +344,28 @@ class ChaosHarness:
                 return (f"fault_pairing: injected {r['fault']} has no "
                         f"matching recovery record"), injected, slowest
             slowest = max(slowest, after[0]["t"] - r["t"])
+        # Replica faults (peer_recovery scenario) must be ANSWERED, not
+        # absorbed silently: any elastic restart AFTER a damaged replica
+        # set either reconstructs from a (re-pushed) replica or degrades
+        # to an EXPLICIT disk fallback — both leave a peer_replica
+        # record. A replica fault with no restart after it has nothing
+        # to answer (the damage was never read).
+        peer_answers = [v for v in recs
+                        if v.get("kind") == "peer_replica"
+                        and v.get("op") in ("reconstruct", "fallback")]
+        restarts = [v for v in recs
+                    if v.get("kind") in ("elastic_restart",
+                                         "elastic_expand")]
+        for r in inj:
+            if r["fault"] not in ("replica_corrupt", "replica_stale"):
+                continue
+            if not [v for v in restarts if v["t"] >= r["t"]]:
+                continue
+            if not [v for v in peer_answers if v["t"] >= r["t"]]:
+                return (f"fault_pairing: injected {r['fault']} followed "
+                        f"by an elastic restart but no peer_replica "
+                        f"reconstruct or disk-fallback record"), \
+                    injected, slowest
         return None, injected, slowest
 
     # -- one schedule -----------------------------------------------------
@@ -350,13 +388,14 @@ class ChaosHarness:
             return self._run_expand(events, spec, run_dir, cluster,
                                     ref, t0)
 
-        n = 2 if scenario == "cluster" else 1
+        n = 2 if scenario in TWO_SEAT_SCENARIOS else 1
         logs = [os.path.join(run_dir, f"logs_{t}") for t in range(n)]
         for d in logs:
             os.makedirs(d, exist_ok=True)
         specs = [spec] if n == 1 else [spec, backbone]
         procs = [self._spawn([t, n, self.data_dir, logs[t], cluster,
-                              specs[t], self.total_steps], planted=True)
+                              specs[t], self.total_steps], planted=True,
+                             peer=scenario == "peer_recovery")
                  for t in range(n)]
         outs, timed_out = [], False
         for p in procs:
@@ -376,7 +415,7 @@ class ChaosHarness:
                         f"{self.deadline_s:.0f}s")
         # The cluster backbone corpse is EXPECTED to die with the
         # abrupt-death code; everyone else must exit 0.
-        if scenario == "cluster" \
+        if scenario in TWO_SEAT_SCENARIOS \
                 and procs[1].returncode != faults_lib.EXIT_HOST_LOST:
             return fail(f"completed: backbone host exited "
                         f"{procs[1].returncode}, wanted "
@@ -568,7 +607,8 @@ def run_campaign(seeds: Sequence[int], scenario: str, workdir: str,
     logger = MetricsLogger(metrics_jsonl)
     vocab = {"train": faults_lib.CHAOS_VOCABULARY,
              "cluster": faults_lib.CHAOS_CLUSTER_VOCABULARY,
-             "expand": faults_lib.CHAOS_EXPAND_VOCABULARY}[scenario]
+             "expand": faults_lib.CHAOS_EXPAND_VOCABULARY,
+             "peer_recovery": faults_lib.CHAOS_PEER_VOCABULARY}[scenario]
     results = []
     faults_by_kind: Dict[str, int] = {}
     slowest = 0.0
@@ -636,11 +676,14 @@ def main(argv=None) -> int:
     p.add_argument("--seed_base", type=int, default=0,
                    help="first seed (seeds are seed_base..+N-1)")
     p.add_argument("--scenario", default="train",
-                   choices=["train", "cluster", "expand", "mixed"],
+                   choices=["train", "cluster", "expand",
+                            "peer_recovery", "mixed"],
                    help="which sim to fuzz: 1-process supervised "
                         "train, the 2-process cluster shrink drill, "
-                        "the 2→1→2 elastic-expand drill, or an "
-                        "alternating mix of all three")
+                        "the 2→1→2 elastic-expand drill, the 2-process "
+                        "diskless-recovery drill (peer redundancy on, "
+                        "replica faults in vocabulary), or an "
+                        "alternating mix of all of them")
     p.add_argument("--budget", type=int, default=3,
                    help="faults sampled per schedule")
     p.add_argument("--total_steps", type=int, default=40,
@@ -670,7 +713,9 @@ def main(argv=None) -> int:
         workdir = tempfile.mkdtemp(prefix="dml_chaos_")
     scenarios = {"train": ["train"], "cluster": ["cluster"],
                  "expand": ["expand"],
-                 "mixed": ["train", "cluster", "expand"]}[args.scenario]
+                 "peer_recovery": ["peer_recovery"],
+                 "mixed": ["train", "cluster", "expand",
+                           "peer_recovery"]}[args.scenario]
     seeds = list(range(args.seed_base, args.seed_base + args.seeds))
     if args.spec is not None:
         seeds = seeds[:1]

@@ -58,7 +58,7 @@ KIND_KEYS = {
     "fault": ("step", "fault", "injected"),
     "recovery": ("step", "fault", "action", "attempt"),
     "rollback": ("step", "restore_step", "attempt", "lr"),
-    "ckpt_fallback": ("step", "path", "error"),
+    "ckpt_fallback": ("step", "path", "error", "walk_ms"),
     "ckpt_prune_error": ("step", "path", "error"),
     # Cluster-resilience layer (parallel/cluster.py;
     # docs/RESILIENCE.md multi-host section). `heartbeat` is the
@@ -72,7 +72,7 @@ KIND_KEYS = {
     "straggler": ("step", "process_id", "behind_steps", "beat_age_s"),
     "peer_lost": ("step", "process_id", "reason"),
     "elastic_restart": ("step", "restore_step", "world_size", "epoch",
-                        "attempt"),
+                        "attempt", "source"),
     # Elastic scale-UP (--elastic_expand). `host_rejoin` is a rejoin
     # announcement — logged by the returning host when it starts
     # beating with phase "rejoin", and by the chief when its scan
@@ -81,7 +81,7 @@ KIND_KEYS = {
     # of `elastic_restart`.
     "host_rejoin": ("step", "process_id", "epoch"),
     "elastic_expand": ("step", "restore_step", "world_size", "epoch",
-                       "attempt"),
+                       "attempt", "source"),
     # A corrupt restart-decision file classified by the hardened
     # RestartCoordinator.read (undecodable payload or sha256-sidecar
     # mismatch): the decision reads as absent, the poll self-heals, and
@@ -102,8 +102,22 @@ KIND_KEYS = {
     # created) or read (`op: restore` — verify true/false/null, null =
     # pre-integrity shard without a sidecar); `op: legacy_glob` flags a
     # manifest without `shard_files` restored via filename glob (bytes/
-    # secs/verify null).
-    "shard_io": ("op", "shard", "bytes", "secs", "verify"),
+    # secs/verify null). `source` says where the bytes went/came from:
+    # "disk" (the checkpoint dir) or "peer" (the peer-replica store —
+    # a diskless restore shows ONLY source=peer records).
+    "shard_io": ("op", "shard", "bytes", "secs", "verify", "source"),
+    # Peer-redundancy layer (ckpt/peerstore.py; docs/RESILIENCE.md
+    # diskless-recovery section). One record per replica operation:
+    # `op` is push (a boundary payload committed to the ring-successor
+    # store), verify (a replica read's sidecar check), reconstruct (a
+    # lost host's shards rebuilt from its replica), decide (the chief's
+    # source choice — `staleness` is beat-vs-replica step lag), or
+    # fallback (a peer restore classified a miss and degraded to the
+    # disk walk). `owner` is the payload's owning process id (null for
+    # decide/fallback), `ok` the operation verdict, `error` the
+    # classified reason when not ok.
+    "peer_replica": ("op", "step", "owner", "bytes", "secs", "ok",
+                     "error", "staleness"),
     # Compilation cache (compilecache/; docs/COMPILECACHE.md). One
     # record per compile-seam lookup: `key` is the program fingerprint
     # (null when no cache is configured but the seam still reports its

@@ -184,6 +184,47 @@ def _hop_breakdown(records: List[dict]) -> Optional[dict]:
             "hops": hops, "slowest": slowest}
 
 
+def _peer_summary(records: List[dict]) -> Optional[dict]:
+    """Diskless-recovery rollup from ``peer_replica`` records plus the
+    ``source`` field on adopted elastic restart/expand decisions
+    (ckpt/peerstore.py). None when the stream carries neither — the
+    report stays byte-identical for pre-redundancy streams."""
+    peer_recs = [r for r in records if r.get("kind") == "peer_replica"]
+    transitions = [r for r in records
+                   if r.get("kind") in ("elastic_restart",
+                                        "elastic_expand")]
+    sourced = [r for r in transitions if r.get("source") is not None]
+    if not peer_recs and not sourced:
+        return None
+    recon = [r for r in peer_recs if r.get("op") == "reconstruct"
+             and r.get("secs") is not None]
+    recon_s = [float(r["secs"]) for r in recon]
+    decides = [r for r in peer_recs if r.get("op") == "decide"
+               and r.get("ok") and r.get("staleness") is not None]
+    out = {
+        "peer_restores": sum(1 for r in sourced
+                             if r.get("source") == "peer"),
+        "disk_restores": sum(1 for r in transitions
+                             if (r.get("source") or "disk") == "disk"),
+        "pushes": sum(1 for r in peer_recs
+                      if r.get("op") == "push" and r.get("ok")),
+        "push_failures": sum(1 for r in peer_recs
+                             if r.get("op") == "push"
+                             and r.get("ok") is False),
+        "fallbacks": sum(1 for r in peer_recs
+                         if r.get("op") == "fallback"),
+        "reconstructs": len(recon),
+        "reconstruct_mean_s": round(sum(recon_s) / len(recon_s), 6)
+        if recon_s else None,
+        "reconstruct_max_s": round(max(recon_s), 6) if recon_s else None,
+        # Staleness the chief saw at its LAST decide seam: how many
+        # steps the beats were ahead of the replica set it restored.
+        "decide_staleness": decides[-1].get("staleness")
+        if decides else None,
+    }
+    return out
+
+
 def _fmt_bytes(n: Optional[int]) -> str:
     if not n:
         return "-"
@@ -497,6 +538,26 @@ def summarize_records(records: List[dict], header: str) -> str:
             lines.append(
                 f"    [{len(prune_errs)} checkpoint prune failure(s) — "
                 f"old checkpoints may be accumulating]")
+    # Restore source (ckpt/peerstore.py, docs/RESILIENCE.md diskless-
+    # recovery section): which elastic restarts skipped checkpoint I/O
+    # entirely (source=peer), how long lost-shard reconstruction took,
+    # and how stale the replica set was at each decide seam.
+    peer = _peer_summary(records)
+    if peer:
+        lines.append(
+            f"  restore source: {peer['peer_restores']} peer / "
+            f"{peer['disk_restores']} disk elastic restore(s), "
+            f"{peer['pushes']} replica push(es), "
+            f"{peer['fallbacks']} peer->disk fallback(s)")
+        if peer.get("reconstructs"):
+            lines.append(
+                f"    lost-shard reconstructs: {peer['reconstructs']} "
+                f"(mean {peer.get('reconstruct_mean_s')}s, max "
+                f"{peer.get('reconstruct_max_s')}s)")
+        if peer.get("decide_staleness") is not None:
+            lines.append(
+                f"    replica staleness at decide: "
+                f"{peer['decide_staleness']} step(s) behind the beats")
     # Chaos campaign (tools/chaos.py; docs/RESILIENCE.md): schedules
     # run, the fault mix they injected, which invariants failed (with
     # the shrunk reproducer specs), and the slowest observed
@@ -748,6 +809,9 @@ def summarize_json(path: str) -> dict:
             "ckpt_fallbacks": sum(1 for r in records
                                   if r.get("kind") == "ckpt_fallback"),
         }
+    peer = _peer_summary(records)
+    if peer:
+        out.setdefault("resilience", {})["restore_source"] = peer
     beats = [r for r in records if r.get("kind") == "heartbeat"]
     losses = [r for r in records if r.get("kind") == "peer_lost"]
     transitions = [r for r in records
