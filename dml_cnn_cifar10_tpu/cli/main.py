@@ -83,7 +83,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "step cadence (the reference's MTS saved every "
                         "600 s by default)")
     p.add_argument("--mode", type=str, default="train",
-                   choices=["train", "eval", "export", "serve", "fleet"],
+                   choices=["train", "eval", "export", "serve", "fleet",
+                            "run"],
                    help="train; eval = restore latest checkpoint and sweep "
                         "the full test split; export = restore and write a "
                         "self-contained jax.export serving artifact; serve "
@@ -92,7 +93,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "HTTP endpoint; fleet = router + N replicated "
                         "serve workers with heartbeat liveness, "
                         "zero-downtime checkpoint hot-swap, and a "
-                        "closed-loop autoscaler (docs/SERVING.md)")
+                        "closed-loop autoscaler (docs/SERVING.md); run = "
+                        "the unified multi-job runtime: one process, one "
+                        "mesh, --jobs running concurrently, every "
+                        "committed checkpoint hot-swapped into the "
+                        "in-process serving head, alerts optionally "
+                        "triggering fine-tune jobs (docs/RUNTIME.md)")
     p.add_argument("--export_path", type=str, default=None,
                    help="output file for --mode export "
                         "(default <log_dir>/model.jaxexport)")
@@ -128,6 +134,37 @@ def build_parser() -> argparse.ArgumentParser:
                    help="p99 latency objective in ms; the fleet "
                         "autoscaler scales up while the replicas' p99 "
                         "sits above it (declarative elsewhere)")
+    # --- unified runtime flags (--mode run; docs/RUNTIME.md) ---
+    p.add_argument("--jobs", type=str, default="train,serve",
+                   help="--mode run job spec: comma-separated from "
+                        "{train, serve, eval}. train is a task job (the "
+                        "runtime exits when task jobs drain); serve/eval "
+                        "are service jobs stopped at drain. finetune "
+                        "jobs are never listed — they are born from "
+                        "alert triggers (--finetune_steps)")
+    p.add_argument("--runtime_eval_every_s", type=float, default=2.0,
+                   help="EvalJob cadence: seconds between accuracy "
+                        "evaluations of the latest published weights")
+    p.add_argument("--runtime_eval_batches", type=int, default=1,
+                   help="test batches per EvalJob tick (each one "
+                        "serving forward on the shared mesh)")
+    p.add_argument("--runtime_serve_warmup", type="bool", default=False,
+                   help="pre-compile the in-process serving head's "
+                        "bucket programs at first publish (off keeps "
+                        "the train path's fetch-parity invariant; the "
+                        "request path compiles lazily)")
+    p.add_argument("--finetune_steps", type=int, default=0,
+                   help="alert→job control loop: an emitted alert "
+                        "firing triggers a FineTuneJob continuing "
+                        "training this many extra steps from the last "
+                        "in-process train state. 0 = off")
+    p.add_argument("--finetune_rules", type=str, default=None,
+                   help="comma-separated alert rule names allowed to "
+                        "trigger FineTuneJobs (default: any emitted "
+                        "firing, --max_finetunes permitting)")
+    p.add_argument("--max_finetunes", type=int, default=1,
+                   help="lifetime budget of alert-triggered "
+                        "FineTuneJobs per runtime")
     p.add_argument("--trace_sample_rate", type=float, default=0.0,
                    help="distributed request tracing: head-sample this "
                         "fraction of serving requests at the trace root "
@@ -776,6 +813,21 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.serve.trace_sample_rate = args.trace_sample_rate
     cfg.postmortem_dir = args.postmortem_dir
     cfg.flightrec_size = args.flightrec_size
+    cfg.runtime.jobs = args.jobs
+    cfg.runtime.eval_every_s = args.runtime_eval_every_s
+    cfg.runtime.eval_batches = args.runtime_eval_batches
+    cfg.runtime.serve_warmup = args.runtime_serve_warmup
+    cfg.runtime.finetune_steps = args.finetune_steps
+    cfg.runtime.finetune_rules = args.finetune_rules
+    cfg.runtime.max_finetunes = args.max_finetunes
+    if args.mode == "run":
+        # Fail a typo'd job spec at flag-parse time, CLI-shaped — same
+        # policy as the --alert_rules pre-parse above.
+        from dml_cnn_cifar10_tpu.runtime.jobs import parse_jobs
+        try:
+            parse_jobs(args.jobs)
+        except ValueError as e:
+            raise SystemExit(f"--jobs: {e}")
     if args.fleet_min_replicas < 1 \
             or args.fleet_max_replicas < args.fleet_min_replicas:
         raise SystemExit(
@@ -898,6 +950,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.mode == "fleet":
         from dml_cnn_cifar10_tpu.fleet.controller import main_fleet
         return main_fleet(cfg)
+
+    if args.mode == "run":
+        from dml_cnn_cifar10_tpu.runtime import main_run
+        return main_run(cfg, task_index=args.task_index)
 
     if cfg.supervise:
         from dml_cnn_cifar10_tpu.train.supervisor import fit_supervised

@@ -496,6 +496,49 @@ class FleetConfig:
 
 
 @dataclasses.dataclass
+class RuntimeConfig:
+    """Unified multi-job runtime (``--mode run``, ``runtime/`` package).
+
+    One :class:`~runtime.core.Runtime` per process owns the mesh, the
+    telemetry stream/registry, the alert engine, the stats server, and
+    the serving compile cache exactly once; a job scheduler runs typed
+    jobs (train / eval / serve / finetune) concurrently on that shared
+    substrate — docs/RUNTIME.md.
+    """
+
+    # Comma-separated job spec: which jobs the runtime starts. "train"
+    # and any triggered "finetune" are task jobs (the runtime exits when
+    # they drain); "serve" and "eval" are service jobs (they run until
+    # the task jobs finish, then stop). FineTuneJobs are never listed —
+    # they are born from alert triggers (see finetune_steps).
+    jobs: str = "train,serve"
+    # EvalJob cadence: re-evaluate the latest published weights every
+    # this many seconds (service job; needs "eval" in jobs).
+    eval_every_s: float = 2.0
+    # Test batches per EvalJob tick (each is one serving forward).
+    eval_batches: int = 1
+    # Pre-compile the serving engine's bucket programs at first publish.
+    # Off by default: warmup fetches results (jax.device_get) and the
+    # runtime's train path must keep the fetch-parity invariant — the
+    # request path compiles lazily instead.
+    serve_warmup: bool = False
+    # Alert→job control loop: an EMITTED alert firing enqueues a
+    # FineTuneJob continuing training for this many extra steps from the
+    # last in-process train state (zero checkpoint reads when the
+    # TrainJob ran in this process). 0 disables triggering.
+    finetune_steps: int = 0
+    # Comma-separated alert rule names that may trigger a FineTuneJob.
+    # None = any emitted firing triggers (budget permitting).
+    finetune_rules: Optional[str] = None
+    # Lifetime budget of triggered FineTuneJobs per runtime.
+    max_finetunes: int = 1
+    # Where the runtime advertises its live state (bound serve port,
+    # last published version) for tools/loadgen.py --runtime discovery.
+    # None = <log_dir>/runtime.json.
+    state_path: Optional[str] = None
+
+
+@dataclasses.dataclass
 class TrainConfig:
     """Training driver. Reference: ``cifar10cnn.py:11-14,219-242``."""
 
@@ -691,13 +734,15 @@ class TrainConfig:
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    runtime: RuntimeConfig = dataclasses.field(default_factory=RuntimeConfig)
 
 
 #: TrainConfig's nested dataclass fields, the single list the JSON
 #: round-trip below and any future config tooling derive from.
 _SUBCONFIGS = {"data": DataConfig, "model": ModelConfig,
                "optim": OptimConfig, "parallel": ParallelConfig,
-               "serve": ServeConfig, "fleet": FleetConfig}
+               "serve": ServeConfig, "fleet": FleetConfig,
+               "runtime": RuntimeConfig}
 
 
 def config_to_dict(cfg: TrainConfig) -> dict:

@@ -1,0 +1,21 @@
+"""Unified multi-job runtime (``--mode run``): one process, one mesh,
+one telemetry substrate — train, eval, and serve as concurrent jobs.
+
+See docs/RUNTIME.md. :class:`~dml_cnn_cifar10_tpu.runtime.core.Runtime`
+owns the process-wide substrate exactly once (mesh, metrics stream,
+registry + stats server, alert engine, flight recorder, serving compile
+cache); :class:`~dml_cnn_cifar10_tpu.runtime.jobs.JobScheduler` runs
+typed jobs on it. The trainer publishes every committed checkpoint's
+weights straight into the in-process serving engine (a locked pointer
+swap — no checkpoint read), and an emitted alert can trigger a
+:class:`~dml_cnn_cifar10_tpu.runtime.jobs.FineTuneJob`, closing the
+train→serve→observe loop into online continual learning.
+"""
+
+from dml_cnn_cifar10_tpu.runtime.core import Runtime, main_run
+from dml_cnn_cifar10_tpu.runtime.jobs import (EvalJob, FineTuneJob, Job,
+                                              JobScheduler, ServeJob,
+                                              TrainJob, parse_jobs)
+
+__all__ = ["Runtime", "main_run", "Job", "JobScheduler", "TrainJob",
+           "EvalJob", "ServeJob", "FineTuneJob", "parse_jobs"]

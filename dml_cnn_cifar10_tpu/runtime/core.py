@@ -1,0 +1,246 @@
+"""The :class:`Runtime`: process-wide substrate for the unified
+multi-job runtime (``--mode run``), docs/RUNTIME.md.
+
+Ownership contract — each of these exists exactly ONCE per process and
+every job borrows it (never builds its own):
+
+- the **mesh** (``parallel/mesh.py``): trainers and the serving engine
+  attach to the same device mesh, so devices are shared instead of
+  partitioned per workload (the TF-Replicator / Mesh-TensorFlow
+  single-runtime-many-jobs shape the paper's cluster had);
+- the **metrics stream** (one :class:`MetricsLogger` on
+  ``--metrics_jsonl``) plus its observer chain: flight recorder FIRST,
+  alert engine second (attach order is run order);
+- the **metrics registry + stats server**: one
+  ``ensure_stats_server(--stats_port)`` bind; the serve job's HTTP
+  ``/metrics`` renders the SAME process registry, so both job families'
+  series appear on one endpoint, never split;
+- the **serving compile cache** handle (trainer seams keep their own
+  handle over the same ``--compile_cache_dir`` so their goodput
+  attribution hook stays wired — the DISK cache is shared either way).
+
+The publish protocol: the Trainer's in-process publish hook
+(``train/loop.py``) parks a device-side copy of the serving weights at
+each due save and hands it to :meth:`Runtime.publish` from the
+checkpoint manager's ``on_committed`` callback — so a publish happens
+iff the checkpoint COMMITTED, carries live device buffers (zero
+checkpoint reads, zero ``jax.device_get``), and installs via the
+engine's locked pointer swap. One ``publish`` JSONL record per commit
+pins it.
+
+The control loop: :meth:`Runtime._on_alert` rides the alert engine's
+trigger seam (``utils/alerts.py``) — an EMITTED firing whose rule is
+listed in ``--finetune_rules`` (or any rule, when unset) enqueues a
+:class:`~dml_cnn_cifar10_tpu.runtime.jobs.FineTuneJob`, budgeted by
+``--max_finetunes``, while the flight recorder's capture of the same
+firing preserves the evidence. Lineage is on the stream: ``alert``
+(rule) → ``job`` (trigger=rule) → ``publish`` (job=finetune-N).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from dml_cnn_cifar10_tpu.config import TrainConfig
+from dml_cnn_cifar10_tpu.models import get_model
+from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
+from dml_cnn_cifar10_tpu.utils import alerts as alerts_lib
+from dml_cnn_cifar10_tpu.utils import flightrec as flightrec_lib
+from dml_cnn_cifar10_tpu.utils import metrics_registry
+from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+
+
+class Runtime:
+    """One per process. Build, :meth:`start` the configured jobs,
+    :meth:`wait` for the task jobs (train + any triggered fine-tunes)
+    to drain — service jobs (serve, eval) are then stopped — and
+    :meth:`close`."""
+
+    def __init__(self, cfg: TrainConfig, task_index: int = 0):
+        import jax
+
+        from dml_cnn_cifar10_tpu.compilecache import CompileCache
+        from dml_cnn_cifar10_tpu.runtime.jobs import JobScheduler
+
+        self.cfg = cfg
+        self.task_index = task_index
+        self.model_def = get_model(cfg.model.name)
+        self.mesh = mesh_lib.build_mesh(cfg.parallel)
+        self.logger = MetricsLogger(
+            cfg.metrics_jsonl, task_index=task_index,
+            tensorboard_dir=(cfg.tensorboard_dir
+                             if jax.process_index() == 0 else None))
+        # Flight recorder BEFORE the alert observer (attach order is run
+        # order): the record that trips a rule reaches the ring before
+        # the engine's nested `alert` emission snapshots it.
+        self.flightrec = flightrec_lib.FlightRecorder.from_config(
+            cfg, context_fn=self._context, logger=self.logger)
+        if self.flightrec is not None:
+            self.logger.add_observer(self.flightrec.observer())
+        self.alerts = alerts_lib.AlertEngine.from_config(cfg)
+        if self.alerts is not None:
+            self.logger.add_observer(self.alerts.observer(self.logger))
+            self.alerts.add_trigger(self._on_alert)
+        # ONE registry, ONE stats bind for the whole process: every
+        # Trainer/job repeats this call and gets the same server back
+        # (ensure_stats_server is idempotent under its process lock).
+        self.registry = metrics_registry.default_registry()
+        metrics_registry.ensure_stats_server(cfg.stats_port)
+        self.compile_cache = CompileCache.from_config(cfg,
+                                                      logger=self.logger)
+        #: serializes the training seat: TrainJob and FineTuneJobs hold
+        #: it across their fit() — two concurrent trainers would fight
+        #: over the checkpoint dir and donated buffers.
+        self.train_seat = threading.Lock()
+        self.scheduler = JobScheduler(self)
+        #: the in-process serving engine; created at the FIRST publish
+        #: (before that, the serve job has nothing to serve and waits).
+        self.engine = None
+        self._engine_lock = threading.Lock()
+        #: final TrainState of the last train/fine-tune job — the
+        #: zero-checkpoint-read continuation seam for FineTuneJob.
+        self.last_train_state = None
+        #: name of the job currently holding the train seat (stamped
+        #: into `publish` records for the alert→job→publish lineage).
+        self.publisher_job = "train"
+        self.serve_port: Optional[int] = None
+        self._pub_seq = 0
+        self._finetunes = 0
+        self._ft_lock = threading.Lock()
+        self.state_path = cfg.runtime.state_path or os.path.join(
+            cfg.log_dir, "runtime.json")
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        from dml_cnn_cifar10_tpu.runtime.jobs import parse_jobs
+        for job in parse_jobs(self.cfg.runtime.jobs):
+            self.scheduler.add(job)
+
+    def wait(self) -> None:
+        self.scheduler.wait()
+
+    def close(self) -> None:
+        self.scheduler.stop()
+        self._write_state()
+        self.logger.flush()
+        self.logger.close()
+
+    # -- publish protocol ------------------------------------------------
+
+    def publish(self, step, path, params, model_state) -> bool:
+        """The Trainer's in-process publish hook target: install the
+        committed checkpoint's weights into the serving engine. Called
+        with device-resident copies (see ``train/loop.py``) — the first
+        commit CREATES the engine on the shared mesh, later commits
+        pointer-swap it. Emits one ``publish`` record either way."""
+        t0 = time.perf_counter()
+        cfg = self.cfg
+        version = str(int(step))
+        with self._engine_lock:
+            if self.engine is None:
+                from dml_cnn_cifar10_tpu.serve.engine import ServingEngine
+                self.engine = ServingEngine.from_params(
+                    self.model_def, cfg.model, cfg.data, params,
+                    model_state, compile_cache=self.compile_cache,
+                    logger=self.logger, version=version,
+                    replica_id=self.task_index, mesh=self.mesh)
+                if cfg.runtime.serve_warmup:
+                    self.engine.warmup(cfg.serve.buckets)
+                swapped, note = True, "installed"
+            else:
+                swapped, note = self.engine.try_swap(
+                    params, model_state, version=version)
+        self._pub_seq += 1
+        self.logger.log("publish", step=int(step), version=version,
+                        source="live_params", swapped=bool(swapped),
+                        latency_ms=round((time.perf_counter() - t0) * 1e3,
+                                         3),
+                        job=self.publisher_job, seq=self._pub_seq,
+                        note=note, path=path)
+        self._write_state()
+        return bool(swapped)
+
+    # -- alert → job control loop ----------------------------------------
+
+    def _on_alert(self, rule, value) -> None:
+        """Alert-engine trigger hook: an EMITTED firing may enqueue a
+        FineTuneJob (docs/RUNTIME.md alert-trigger table). Suppressed
+        re-fires and resolutions never reach this seam by the engine's
+        contract; the ``--max_finetunes`` budget bounds the rest."""
+        rtc = self.cfg.runtime
+        if rtc.finetune_steps <= 0:
+            return
+        if rtc.finetune_rules:
+            allowed = {n.strip() for n in rtc.finetune_rules.split(",")
+                       if n.strip()}
+            if rule.name not in allowed:
+                return
+        with self._ft_lock:
+            if self._finetunes >= rtc.max_finetunes:
+                return
+            self._finetunes += 1
+            n = self._finetunes
+        from dml_cnn_cifar10_tpu.runtime.jobs import FineTuneJob
+        job = FineTuneJob(rtc.finetune_steps, trigger=rule.name,
+                          name=f"finetune-{n}")
+        print(f"[runtime] alert {rule.name!r} (value {value}) triggered "
+              f"{job.name} (+{rtc.finetune_steps} steps, "
+              f"{n}/{rtc.max_finetunes})")
+        self.scheduler.submit(job)
+
+    # -- advertised state ------------------------------------------------
+
+    def note_serve_port(self, port: int) -> None:
+        self.serve_port = int(port)
+        self._write_state()
+
+    def _write_state(self) -> None:
+        """Atomic ``runtime.json`` advert (``tools/loadgen.py
+        --runtime`` discovery). Fail-open: a read-only log_dir must not
+        take down the jobs."""
+        try:
+            os.makedirs(os.path.dirname(self.state_path) or ".",
+                        exist_ok=True)
+            tmp = f"{self.state_path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"pid": os.getpid(),
+                           "serve_port": self.serve_port,
+                           "version": (self.engine.version
+                                       if self.engine is not None
+                                       else None),
+                           "publishes": self._pub_seq,
+                           "jobs": self.cfg.runtime.jobs}, f)
+            os.replace(tmp, self.state_path)
+        except OSError:
+            pass
+
+    def _context(self) -> dict:
+        """Flight-recorder live-context hook."""
+        return {"serving_version": (self.engine.version
+                                    if self.engine is not None else None),
+                "publishes": self._pub_seq,
+                "jobs": [f"{j.name}:{j.state}"
+                         for j in self.scheduler.jobs]}
+
+
+def main_run(cfg: TrainConfig, task_index: int = 0) -> int:
+    """``--mode run`` entry: build the runtime, run the configured jobs
+    to completion, stop the service jobs, exit 0. A failed TASK job
+    (train/fine-tune) exits 1 so drivers notice."""
+    rt = Runtime(cfg, task_index=task_index)
+    try:
+        rt.start()
+        rt.wait()
+    finally:
+        rt.close()
+    failed = [j.name for j in rt.scheduler.jobs
+              if not j.service and j.state == "failed"]
+    if failed:
+        print(f"[runtime] task job(s) failed: {', '.join(failed)}")
+        return 1
+    return 0

@@ -1,0 +1,356 @@
+"""Typed jobs + the scheduler that runs them on the shared runtime.
+
+Job taxonomy (docs/RUNTIME.md):
+
+- **task jobs** (``service = False``) do a bounded piece of work and
+  finish: :class:`TrainJob` (the whole configured training run, under
+  the run supervisor when ``--supervise``) and :class:`FineTuneJob`
+  (alert-triggered continuation for ``--finetune_steps`` more steps).
+  Task jobs that train serialize on the runtime's ``train_seat`` lock —
+  two concurrent trainers would fight over the checkpoint dir and each
+  other's donated buffers.
+- **service jobs** (``service = True``) run until the task jobs drain:
+  :class:`ServeJob` (the in-process HTTP serving head over the
+  runtime's engine) and :class:`EvalJob` (periodic accuracy of the
+  latest PUBLISHED weights — the eval never tears down the train step,
+  it is one more forward on the shared mesh).
+
+Every state transition writes a ``job`` JSONL record
+(``pending``/``running``/``done``/``failed``; alert-born jobs carry
+``trigger=<rule>``) and completion writes one ``job_done`` — the
+telemetry_report jobs section and the acceptance smoke read the
+lifecycle straight off the stream.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+
+
+class Job:
+    """Base job: subclasses set ``jtype``/``service`` and implement
+    :meth:`run`. ``stop`` is the scheduler's shutdown event — service
+    jobs poll it; task jobs usually finish on their own."""
+
+    jtype = "job"
+    service = False
+
+    def __init__(self, name=None):
+        self.name = name or self.jtype
+        self.state = "pending"
+        self.trigger = None
+        self.error = None
+        self.thread = None
+
+    def run(self, rt, stop: threading.Event) -> None:
+        raise NotImplementedError
+
+
+def parse_jobs(spec: str):
+    """``--jobs`` spec → job instances. Comma-separated names from
+    {train, serve, eval}; ``finetune`` is rejected — FineTuneJobs are
+    born from alert triggers (``--finetune_steps``), never listed."""
+    out, seen = [], set()
+    for name in (spec or "").split(","):
+        name = name.strip().lower()
+        if not name:
+            continue
+        if name in seen:
+            raise ValueError(f"--jobs lists {name!r} twice")
+        seen.add(name)
+        if name == "train":
+            out.append(TrainJob())
+        elif name == "serve":
+            out.append(ServeJob())
+        elif name == "eval":
+            out.append(EvalJob())
+        elif name == "finetune":
+            raise ValueError(
+                "--jobs cannot list 'finetune': FineTuneJobs are "
+                "triggered by alerts (--finetune_steps / "
+                "--finetune_rules), not scheduled up front")
+        else:
+            raise ValueError(f"unknown job {name!r} in --jobs "
+                             f"(known: train, serve, eval)")
+    if not out:
+        raise ValueError("--jobs resolved to no jobs")
+    return out
+
+
+class JobScheduler:
+    """Run jobs on threads over one runtime; journal their lifecycle."""
+
+    def __init__(self, rt):
+        self.rt = rt
+        self._lock = threading.Lock()
+        self._jobs = []
+        self._stop = threading.Event()
+
+    @property
+    def jobs(self):
+        with self._lock:
+            return list(self._jobs)
+
+    def add(self, job: Job) -> Job:
+        """Register + start ``job`` (also the mid-run submit seam the
+        alert trigger uses — ``submit`` is an alias)."""
+        with self._lock:
+            if any(j.name == job.name for j in self._jobs):
+                raise ValueError(f"duplicate job name {job.name!r}")
+            self._jobs.append(job)
+        self._log_state(job, "pending")
+        t = threading.Thread(target=self._run_job, args=(job,),
+                             name=f"job-{job.name}", daemon=True)
+        job.thread = t
+        t.start()
+        return job
+
+    submit = add
+
+    def _log_state(self, job: Job, state: str) -> None:
+        job.state = state
+        fields = dict(job=job.name, jtype=job.jtype, state=state)
+        if job.trigger:
+            fields["trigger"] = job.trigger
+        self.rt.logger.log("job", **fields)
+
+    def _run_job(self, job: Job) -> None:
+        t0 = time.perf_counter()
+        self._log_state(job, "running")
+        ok = True
+        try:
+            job.run(self.rt, self._stop)
+        except Exception as e:
+            ok = False
+            job.error = f"{type(e).__name__}: {e}"[:300]
+            traceback.print_exc()
+        self._log_state(job, "done" if ok else "failed")
+        rec = dict(job=job.name, jtype=job.jtype, ok=ok,
+                   secs=round(time.perf_counter() - t0, 4))
+        if job.error:
+            rec["error"] = job.error
+        self.rt.logger.log("job_done", **rec)
+
+    def wait(self) -> None:
+        """Join every TASK job — including ones submitted while waiting
+        (an alert trigger fires synchronously on the emitting thread, so
+        a FineTuneJob born during training is registered before its
+        TrainJob's thread exits and is picked up here) — then stop the
+        service jobs."""
+        while True:
+            with self._lock:
+                tasks = [j for j in self._jobs if not j.service]
+            for j in tasks:
+                if j.thread is not None:
+                    j.thread.join()
+            with self._lock:
+                settled = all(j.state in ("done", "failed")
+                              for j in self._jobs if not j.service)
+            if settled:
+                break
+        self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            services = [j for j in self._jobs if j.service]
+        for j in services:
+            if j.thread is not None:
+                j.thread.join(timeout=30)
+
+
+class TrainJob(Job):
+    """The configured training run as a job. Under ``--supervise`` the
+    run supervisor wraps it WITH the runtime's substrate injected (one
+    stream, one mesh, one alert engine across restart attempts);
+    otherwise a bare Trainer on the shared mesh. Either way the
+    in-process publish hook rides every committed checkpoint."""
+
+    jtype = "train"
+
+    def __init__(self, total_steps=None, name="train"):
+        super().__init__(name)
+        self.total_steps = total_steps
+        self.result = None
+
+    def run(self, rt, stop):
+        with rt.train_seat:
+            rt.publisher_job = self.name
+            if rt.cfg.supervise:
+                from dml_cnn_cifar10_tpu.train.supervisor import \
+                    fit_supervised
+                result = fit_supervised(
+                    rt.cfg, total_steps=self.total_steps,
+                    task_index=rt.task_index, logger=rt.logger,
+                    alert_engine=rt.alerts,
+                    flight_recorder=rt.flightrec, mesh=rt.mesh,
+                    publish_hook=rt.publish)
+            else:
+                from dml_cnn_cifar10_tpu.train.loop import Trainer
+                trainer = Trainer(rt.cfg, mesh=rt.mesh,
+                                  task_index=rt.task_index,
+                                  alert_engine=rt.alerts,
+                                  flight_recorder=rt.flightrec,
+                                  logger=rt.logger,
+                                  publish_hook=rt.publish)
+                result = trainer.fit(self.total_steps)
+            self.result = result
+            if result is not None:
+                rt.last_train_state = result.state
+
+
+class FineTuneJob(Job):
+    """Alert-triggered continuation: ``steps`` more training steps from
+    the last in-process train state (zero checkpoint reads when a
+    TrainJob ran here — the state hand-off is a device pytree; a
+    runtime with no prior trainer restores the newest checkpoint).
+    Publishes ride the same hook, stamped ``job=finetune-N`` so the
+    alert→job→publish lineage is one grep of the stream."""
+
+    jtype = "finetune"
+
+    def __init__(self, steps, trigger=None, name="finetune"):
+        super().__init__(name)
+        self.steps = int(steps)
+        self.trigger = trigger
+        self.result = None
+
+    def run(self, rt, stop):
+        import jax
+
+        from dml_cnn_cifar10_tpu.train.loop import Trainer
+        with rt.train_seat:
+            rt.publisher_job = self.name
+            trainer = Trainer(rt.cfg, mesh=rt.mesh,
+                              task_index=rt.task_index,
+                              alert_engine=rt.alerts,
+                              flight_recorder=rt.flightrec,
+                              logger=rt.logger, publish_hook=rt.publish)
+            state = rt.last_train_state
+            if state is None:
+                state = trainer.init_or_restore()
+            start = int(jax.device_get(state.step))
+            result = trainer.fit(total_steps=start + self.steps,
+                                 state=state)
+            self.result = result
+            rt.last_train_state = result.state
+
+
+class ServeJob(Job):
+    """The in-process serving head: the same HTTP surface as ``--mode
+    serve`` (POST /predict, GET /metrics//stats//healthz) over the
+    runtime's engine. Waits for the FIRST publish (nothing to serve
+    before a checkpoint commits), advertises its bound port in
+    ``runtime.json``, and keeps serving — hot-swapped by every later
+    publish — until the task jobs drain. No second stats bind, no
+    second registry: the handler renders the process default registry
+    the trainer's series already feed."""
+
+    jtype = "serve"
+    service = True
+
+    def __init__(self, name="serve"):
+        super().__init__(name)
+
+    def run(self, rt, stop):
+        from http.server import ThreadingHTTPServer
+
+        from dml_cnn_cifar10_tpu.serve.batcher import MicroBatcher
+        from dml_cnn_cifar10_tpu.serve.metrics import ServeMetrics
+        from dml_cnn_cifar10_tpu.serve.server import (_make_handler,
+                                                      _MetricsFlusher)
+        while rt.engine is None:
+            if stop.wait(0.02):
+                return  # stopped before the first publish
+        serve_cfg = rt.cfg.serve
+        metrics = ServeMetrics()
+        batcher = MicroBatcher(
+            rt.engine, buckets=serve_cfg.buckets,
+            max_queue_depth=serve_cfg.max_queue_depth,
+            batch_window_s=serve_cfg.batch_window_ms / 1e3,
+            default_deadline_s=(serve_cfg.deadline_ms / 1e3
+                                if serve_cfg.deadline_ms else None),
+            metrics=metrics, warmup=rt.cfg.runtime.serve_warmup,
+            logger=rt.logger)
+        server = ThreadingHTTPServer(
+            ("", serve_cfg.port),
+            _make_handler(batcher, metrics, replica_id=rt.task_index,
+                          hop="server", logger=rt.logger,
+                          sample_rate=serve_cfg.trace_sample_rate))
+        flusher = _MetricsFlusher(metrics, rt.logger,
+                                  serve_cfg.metrics_every_s,
+                                  alerts=rt.alerts)
+        flusher.start()
+        accept = threading.Thread(target=server.serve_forever,
+                                  name="runtime-serve-accept",
+                                  daemon=True)
+        drained = True
+        try:
+            accept.start()
+            rt.note_serve_port(server.server_address[1])
+            print(f"[runtime] serving version {rt.engine.version} on "
+                  f":{server.server_address[1]} (POST /predict)")
+            stop.wait()
+            server.shutdown()
+            accept.join()
+            drained = batcher.drain(timeout=serve_cfg.drain_deadline_s)
+        finally:
+            server.server_close()
+            flusher.stop()
+            if batcher._worker.is_alive():
+                batcher.close()
+            metrics.emit(rt.logger, final=True)
+        print(f"[runtime] serve job exiting "
+              f"({'drained' if drained else 'drain deadline hit'})")
+
+
+class EvalJob(Job):
+    """Periodic eval of the latest PUBLISHED weights, without touching
+    the train loop: every ``--runtime_eval_every_s`` it runs
+    ``--runtime_eval_batches`` test batches through the runtime's
+    serving engine (the same forward a request takes, on the same
+    mesh) and emits a normal ``eval`` record — which feeds the alert
+    rules, so an accuracy rule over these records is exactly the drift
+    signal that can trigger a FineTuneJob."""
+
+    jtype = "eval"
+    service = True
+
+    def __init__(self, name="eval"):
+        super().__init__(name)
+
+    def run(self, rt, stop):
+        cfg = rt.cfg
+        data = None
+        offset = 0
+        tick = max(0.05, float(cfg.runtime.eval_every_s))
+        while not stop.wait(tick):
+            eng = rt.engine
+            if eng is None:
+                continue  # nothing published yet
+            if data is None:
+                from dml_cnn_cifar10_tpu.data import download
+                from dml_cnn_cifar10_tpu.data.pipeline import _load_split
+                download.ensure_dataset(cfg.data)
+                data = _load_split(download.test_files(cfg.data),
+                                   cfg.data)
+            images, labels = data
+            bsz = min(int(max(cfg.serve.buckets)), len(images))
+            correct = total = 0
+            version = eng.version
+            for _ in range(max(1, int(cfg.runtime.eval_batches))):
+                if offset + bsz > len(images):
+                    offset = 0
+                img = images[offset:offset + bsz]
+                lab = labels[offset:offset + bsz]
+                offset += bsz
+                logits, _, version = eng.forward_timed_versioned(img)
+                correct += int((logits.argmax(axis=1) == lab).sum())
+                total += len(lab)
+            step = int(version) if str(version).isdigit() else -1
+            rt.logger.log("eval", step=step,
+                          test_accuracy=round(correct / max(1, total),
+                                              4),
+                          source="runtime_eval")

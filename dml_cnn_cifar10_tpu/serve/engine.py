@@ -102,6 +102,12 @@ class ServingEngine:
         self._jitted_v = None
         self._variables = None
         self._var_spec = None
+        # Replicated placement on an externally-owned mesh (the unified
+        # runtime's): set by from_params(mesh=...). Every install —
+        # initial and swapped — goes through the SAME sharding so the
+        # compiled program never sees a placement change. None keeps
+        # jax's default single-device placement.
+        self._put_sharding = None
         # bucket size -> AOT executable obtained through the cache;
         # forward_timed prefers these, falling back to the jitted fn
         # for sizes the warmup never saw. Swap-safe by construction:
@@ -142,10 +148,16 @@ class ServingEngine:
     def from_params(cls, model_def, model_cfg, data_cfg, params: Any,
                     model_state: Any = None, compile_cache=None,
                     logger=None, version: str = "0",
-                    replica_id: int = 0) -> "ServingEngine":
+                    replica_id: int = 0, mesh=None) -> "ServingEngine":
         """Engine over live params — the same eval forward export.py
         would serialize, with the weights as jit ARGUMENTS so
-        :meth:`try_swap` can replace them without a recompile."""
+        :meth:`try_swap` can replace them without a recompile.
+
+        ``mesh`` attaches the engine to an externally-owned mesh (the
+        unified runtime's): weights are placed replicated over it, and
+        every later :meth:`try_swap` re-places candidates onto the SAME
+        sharding — a device-to-device transfer, never a host round-trip
+        — so train-sharded publishes and the serving program agree."""
         import jax
 
         from dml_cnn_cifar10_tpu.export import make_variable_serving_fn
@@ -156,11 +168,23 @@ class ServingEngine:
                   version=version, replica_id=replica_id)
         eng._jitted_v = jax.jit(
             make_variable_serving_fn(model_def, model_cfg, data_cfg))
-        variables = jax.device_put((params, model_state
-                                    if model_def.has_state else None))
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            eng._put_sharding = NamedSharding(mesh, PartitionSpec())
+        variables = eng._place((params, model_state
+                                if model_def.has_state else None))
         eng._variables = variables
         eng._var_spec = _variable_spec(variables)
         return eng
+
+    def _place(self, tree):
+        """Device placement honoring the attached mesh (replicated) or
+        jax's default when the engine owns no mesh."""
+        import jax
+
+        if self._put_sharding is not None:
+            return jax.device_put(tree, self._put_sharding)
+        return jax.device_put(tree)
 
     # --- hot-swap seam ---
 
@@ -197,8 +221,11 @@ class ServingEngine:
             return False, self._reject(
                 version, _spec_mismatch(self._var_spec, spec))
         # Place on device BEFORE taking the lock: the transfer is the
-        # slow part and must not stall a concurrent forward.
-        candidate = jax.device_put(candidate)
+        # slow part and must not stall a concurrent forward. With an
+        # attached mesh this re-places onto the engine's replicated
+        # sharding, so a train-sharded publish never changes the
+        # compiled program's input placement.
+        candidate = self._place(candidate)
         with self._swap_lock:
             from_version = self.version
             self._variables = candidate

@@ -65,7 +65,7 @@ class TrainResult:
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None, task_index: int = 0,
                  fault_injector=None, cluster=None, alert_engine=None,
-                 flight_recorder=None):
+                 flight_recorder=None, logger=None, publish_hook=None):
         self.cfg = cfg
         self.task_index = task_index
         if cfg.on_nonfinite not in ("halt", "skip", "rollback"):
@@ -82,11 +82,22 @@ class Trainer:
         self.model_def = get_model(cfg.model.name)
         # Logger before the step builders: the compile cache logs a
         # `compile` JSONL event at every seam, including the ones armed
-        # below.
-        self.logger = MetricsLogger(
+        # below. The runtime (runtime/core.py) injects ITS logger so a
+        # whole process shares one stream; an injected logger is never
+        # closed here — its owner closes it.
+        self.logger = logger if logger is not None else MetricsLogger(
             cfg.metrics_jsonl, task_index=task_index,
             tensorboard_dir=(cfg.tensorboard_dir
                              if jax.process_index() == 0 else None))
+        # In-process publish hook (runtime/core.py): called as
+        # ``hook(step, path, params, model_state)`` after a checkpoint
+        # COMMITS, with an independent device-side copy of the weights a
+        # server would restore from that checkpoint (EMA when armed).
+        # Copies, never references: step buffers are donated, so handing
+        # out the live pytree would dangle at the next dispatch. The
+        # copy is device-to-device — zero jax.device_get, the
+        # fetch-parity invariant holds.
+        self._publish_hook = publish_hook
         # Live operational observability (docs/OBSERVABILITY.md): the
         # streaming alert engine watches every record this logger
         # writes (built-in SLO rules + --alert_rules), and --stats_port
@@ -590,6 +601,22 @@ class Trainer:
 
             def on_committed(step, path, _dir=pub_dir):
                 publish_checkpoint(_dir, path, step, logger=self.logger)
+        # In-process publish (runtime/core.py): guarded_save below parks
+        # a device-side copy of the serving weights for each due save;
+        # the commit callback hands it to the hook so the publish honors
+        # the same commit ordering the fleet publisher does (a failed or
+        # skipped save never publishes). Entries are pruned on commit
+        # and bounded, so at most a few snapshots are ever live.
+        publish_pending: dict = {}
+        if self._publish_hook is not None:
+            _chained = on_committed
+
+            def on_committed(step, path, _chained=_chained):
+                if _chained is not None:
+                    _chained(step, path)
+                parked = publish_pending.pop(step, None)
+                if parked is not None:
+                    self._publish_hook(step, path, parked[0], parked[1])
         ckpt_mgr = ckpt_lib.CheckpointManager(
             cfg.log_dir, cfg.checkpoint_every, keep=cfg.keep_checkpoints,
             async_save=cfg.async_checkpoint,
@@ -671,6 +698,22 @@ class Trainer:
                 "acc": base_counts["acc"] + consumed["acc"],
                 "test": base_counts["test"] + consumed["test"],
             } if exact_ok else None
+            if self._publish_hook is not None and ckpt_mgr.is_chief:
+                # Park the serving weights (EMA when armed — the same
+                # selection --mode serve/export restore) BEFORE the save:
+                # under async_save the commit callback runs on the writer
+                # thread after further steps may have donated the live
+                # buffers. jnp.copy is device-side — no fetch.
+                pub_params = save_state.opt.get("ema", save_state.params)
+                pub_mstate = save_state.opt.get(
+                    "ema_mstate", save_state.model_state) \
+                    if self.model_def.has_state else None
+                publish_pending[step] = (_copy_state(pub_params),
+                                         _copy_state(pub_mstate))
+                while len(publish_pending) > 4:
+                    # A skipped/failed save never commits: drop the
+                    # oldest parked snapshot instead of accreting them.
+                    publish_pending.pop(min(publish_pending))
             if self.cluster is not None:
                 self.cluster.set_phase("checkpoint")
             with tracer.span("checkpoint", cat="checkpoint"):

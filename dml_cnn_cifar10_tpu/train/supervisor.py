@@ -255,12 +255,21 @@ def _request_rejoin(cfg: TrainConfig, monitor, logger, attempt: int,
 
 
 def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
-                   task_index: int = 0):
+                   task_index: int = 0, logger=None, alert_engine=None,
+                   flight_recorder=None, mesh=None, publish_hook=None):
     """``Trainer.fit`` under the recovery supervisor; returns the final
     :class:`TrainResult`. Unrecoverable failures — and recoverable ones
     past the ``recovery_retries`` budget — re-raise unchanged. A
     process evicted by a restart decision returns ``None`` after a
-    clean notice: it was fenced, not failed."""
+    clean notice: it was fenced, not failed.
+
+    The unified runtime (``runtime/core.py``) supervises THROUGH this
+    entry by injecting its own substrate — ``logger``, ``alert_engine``,
+    ``flight_recorder``, ``mesh``, ``publish_hook`` — so the supervisor
+    supervises a job on the runtime's shared stream/mesh rather than
+    one standalone trainer. Injected resources are owned by the caller
+    (never closed here); a bare call builds and owns its own, exactly
+    as before."""
     from dml_cnn_cifar10_tpu.train.loop import Trainer
 
     # ONE injector across every attempt: fired faults stay fired, so a
@@ -268,23 +277,27 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
     # Same ownership rule for the cluster monitor: epoch/world state
     # (and the background beat publisher) must span restarts.
     injector = faults_lib.FaultInjector.from_spec(cfg.fault_spec)
-    logger = MetricsLogger(cfg.metrics_jsonl, task_index=task_index)
+    owns_logger = logger is None
+    if owns_logger:
+        logger = MetricsLogger(cfg.metrics_jsonl, task_index=task_index)
     monitor = cluster_lib.ClusterMonitor.from_config(cfg.parallel,
                                                      logger=logger)
     # ONE flight recorder across attempts (ring + per-rule capture
     # sequence survive restarts), attached BEFORE the alert engine's
     # observer so the record that trips a rule is ringed before the
     # nested `alert` emission snapshots the ring.
-    flightrec = flightrec_lib.FlightRecorder.from_config(cfg,
-                                                         logger=logger)
+    flightrec = flight_recorder if flight_recorder is not None \
+        else flightrec_lib.FlightRecorder.from_config(cfg, logger=logger)
     if flightrec is not None:
+        flightrec.logger = logger
         logger.add_observer(flightrec.observer())
     # ONE alert engine too: the fault/recovery records the supervisor
     # logs here must feed the same rule state as the Trainer's stream,
     # and an alert that fired in attempt N must be able to RESOLVE in
     # attempt N+1 (the nonfinite-burst alert resolves only after the
     # recovered run progresses a clean window past the fault).
-    alert_engine = alerts_lib.AlertEngine.from_config(cfg)
+    if alert_engine is None:
+        alert_engine = alerts_lib.AlertEngine.from_config(cfg)
     if alert_engine is not None:
         logger.add_observer(alert_engine.observer(logger))
     attempt = 0
@@ -296,10 +309,11 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
     budget_anchor = 0
     try:
         while True:
-            trainer = Trainer(cfg, task_index=task_index,
+            trainer = Trainer(cfg, mesh=mesh, task_index=task_index,
                               fault_injector=injector, cluster=monitor,
                               alert_engine=alert_engine,
-                              flight_recorder=flightrec)
+                              flight_recorder=flightrec, logger=logger,
+                              publish_hook=publish_hook)
             try:
                 result = trainer.fit(total_steps)
             except cluster_lib.EvictedError as e:
@@ -423,4 +437,5 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
     finally:
         if monitor is not None:
             monitor.close()
-        logger.close()
+        if owns_logger:
+            logger.close()

@@ -340,6 +340,15 @@ class AlertEngine:
         self._states = {r.name: _RuleState() for r in self.rules}
         self._derive_state: dict = {}
         self._max_step: Optional[float] = None
+        # Alert→action trigger hooks: each fires once per EMITTED alert
+        # firing (never for suppressed re-fires — they add nothing to
+        # the pending list — and never for resolutions). The runtime's
+        # alert→FineTuneJob control loop rides this seam.
+        self._triggers: List[Callable] = []
+        # observer() adapters keyed by the logger they wrap, so a shared
+        # logger re-attaching the engine gets the SAME callable back and
+        # MetricsLogger.add_observer's identity check keeps it single.
+        self._observer_cache: dict = {}
 
     # -- feeding ---------------------------------------------------------
 
@@ -465,11 +474,28 @@ class AlertEngine:
             pending.append(("alert_resolved", rule, value))
 
     def _emit_all(self, pending, emit) -> None:
-        if emit is None:
-            return
         for record_kind, rule, value in pending:
-            emit(record_kind, rule=rule.name, severity=rule.severity,
-                 window=rule.window_str(), value=value)
+            if emit is not None:
+                emit(record_kind, rule=rule.name, severity=rule.severity,
+                     window=rule.window_str(), value=value)
+            if record_kind != "alert":
+                continue  # resolutions never trigger actions
+            for fn in list(self._triggers):
+                try:
+                    fn(rule, value)
+                except Exception as e:  # fail-open like logger observers
+                    print(f"[alerts] trigger hook failed for "
+                          f"{rule.name!r}: {e!r}", flush=True)
+
+    def add_trigger(self, fn: Callable) -> None:
+        """Attach ``fn(rule, value)``, called once per EMITTED ``alert``
+        firing (outside the engine lock, after the record is emitted).
+        Suppressed re-fires inside the rate-limit window and
+        ``alert_resolved`` transitions never call it. Idempotent by
+        identity; exceptions are swallowed (an action hook must never
+        take down the metrics path)."""
+        if fn not in self._triggers:
+            self._triggers.append(fn)
 
     # -- consumers --------------------------------------------------------
 
@@ -488,9 +514,15 @@ class AlertEngine:
     def observer(self, logger) -> Callable:
         """The ``MetricsLogger.add_observer`` adapter: every record the
         logger writes feeds ``observe``, emissions go back out through
-        the same logger."""
-        return lambda kind, fields: self.observe(kind, fields,
-                                                 emit=logger.log)
+        the same logger. Cached per logger — when the runtime and a
+        Trainer share one logger, both attach the SAME callable and the
+        logger's identity check keeps the engine fed exactly once."""
+        fn = self._observer_cache.get(id(logger))
+        if fn is None:
+            fn = lambda kind, fields: self.observe(kind, fields,
+                                                   emit=logger.log)
+            self._observer_cache[id(logger)] = fn
+        return fn
 
     @classmethod
     def from_config(cls, cfg, extra_rules: Optional[str] = None

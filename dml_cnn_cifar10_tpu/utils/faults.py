@@ -395,6 +395,18 @@ CHAOS_PEER_VOCABULARY = CHAOS_CLUSTER_VOCABULARY + (
     "replica_corrupt@step", "replica_stale@step",
 )
 
+#: Vocabulary for the 1-process unified-runtime scenario (``--mode
+#: run``: supervised TrainJob + in-process ServeJob on one mesh,
+#: docs/RUNTIME.md). Every kind must be recoverable WITHOUT ending the
+#: process — the scenario's extra invariant is that the serving side
+#: keeps publishing across recoveries, so process-ending kinds
+#: (sigterm/host_lost) and the cluster-decision kinds (a 1-process
+#: runtime adopts no coordinated decisions) are out.
+CHAOS_RUNTIME_VOCABULARY = (
+    "nan@step", "ckpt_corrupt@step", "data_stall@step",
+    "ckpt_corrupt@restore", "data_stall@restore",
+)
+
 
 @dataclasses.dataclass
 class FaultSchedule:

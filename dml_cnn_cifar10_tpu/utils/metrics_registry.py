@@ -458,6 +458,35 @@ def _observe_record(kind: str, f: dict, reg: MetricsRegistry) -> None:
                   labelnames=("rule", "severity")
                   ).set(0, rule=str(f.get("rule")),
                         severity=str(f.get("severity")))
+    elif kind == "job":
+        reg.counter("dml_job_transitions_total",
+                    "Runtime job state transitions by type and state",
+                    labelnames=("jtype", "state")
+                    ).inc(1, jtype=str(f.get("jtype")),
+                          state=str(f.get("state")))
+    elif kind == "job_done":
+        reg.counter("dml_jobs_done_total",
+                    "Runtime jobs finished, by type and verdict",
+                    labelnames=("jtype", "ok")
+                    ).inc(1, jtype=str(f.get("jtype")),
+                          ok="true" if f.get("ok") else "false")
+        reg.gauge("dml_job_seconds",
+                  "Wall seconds of the last finished job of each type",
+                  labelnames=("jtype",)
+                  ).set(f.get("secs"), jtype=str(f.get("jtype")))
+    elif kind == "publish":
+        reg.counter("dml_publishes_total",
+                    "Checkpoint weights published into the in-process "
+                    "serving engine, by swap verdict",
+                    labelnames=("swapped",)
+                    ).inc(1, swapped="true" if f.get("swapped")
+                          else "false")
+        reg.gauge("dml_publish_latency_ms",
+                  "Latency of the last publish (copy-install swap)"
+                  ).set(f.get("latency_ms"))
+        reg.gauge("dml_published_step",
+                  "Training step of the last published version"
+                  ).set(f.get("step"))
 
 
 # ---------------------------------------------------------------------------

@@ -417,3 +417,65 @@ def test_logger_feeds_engine_and_registry(tmp_path):
     assert kinds == ["serve", "alert", "serve", "alert_resolved"]
     from tools import check_jsonl_schema
     assert check_jsonl_schema.check_file(path, strict=True) == []
+
+
+# ---------------------------------------------------------------------------
+# the alert→action trigger seam (runtime/core.py's control loop rides
+# it: one hook call per EMITTED firing, nothing else ever triggers)
+# ---------------------------------------------------------------------------
+
+def test_trigger_fires_once_per_emitted_firing():
+    eng = AlertEngine(parse_alert_rules("lossy=train.loss>10"),
+                      min_interval_s=0.0)
+    fired = []
+    eng.add_trigger(lambda rule, value: fired.append((rule.name, value)))
+    sink = _Sink()
+    eng.observe("train", {"step": 1, "loss": 50.0}, emit=sink, now=0.0)
+    assert fired == [("lossy", 50.0)]
+    # Still active while the condition holds: no re-fire, no re-trigger.
+    eng.observe("train", {"step": 2, "loss": 60.0}, emit=sink, now=1.0)
+    assert len(fired) == 1
+    # Recovery resolves — resolutions never trigger actions.
+    eng.observe("train", {"step": 3, "loss": 1.0}, emit=sink, now=2.0)
+    assert sink.kinds() == ["alert", "alert_resolved"]
+    assert len(fired) == 1
+    # A fresh firing after the resolution triggers again.
+    eng.observe("train", {"step": 4, "loss": 70.0}, emit=sink, now=3.0)
+    assert len(fired) == 2 and sink.kinds()[-1] == "alert"
+
+
+def test_trigger_suppressed_refire_never_triggers():
+    """A re-fire inside the rate-limit window is not emitted — and by
+    the seam's contract it must not reach the trigger either (a
+    flapping signal cannot burn the runtime's fine-tune budget)."""
+    eng = AlertEngine(parse_alert_rules("lossy=train.loss>10"),
+                      min_interval_s=60.0)
+    fired = []
+    eng.add_trigger(lambda rule, value: fired.append(rule.name))
+    sink = _Sink()
+    eng.observe("train", {"step": 1, "loss": 50.0}, emit=sink, now=0.0)
+    eng.observe("train", {"step": 2, "loss": 1.0}, emit=sink, now=1.0)
+    eng.observe("train", {"step": 3, "loss": 55.0}, emit=sink, now=2.0)
+    assert sink.kinds() == ["alert", "alert_resolved"]   # no 2nd record
+    assert fired == ["lossy"]
+
+
+def test_trigger_fail_open_and_identity_dedup():
+    """A raising hook must not take down the metrics path (same
+    fail-open contract as logger observers), and add_trigger is
+    idempotent by identity — re-attaching on a supervisor restart
+    cannot double the action."""
+    eng = AlertEngine(parse_alert_rules("lossy=train.loss>10"),
+                      min_interval_s=0.0)
+    calls = []
+
+    def boom(rule, value):
+        calls.append(rule.name)
+        raise RuntimeError("hook exploded")
+
+    eng.add_trigger(boom)
+    eng.add_trigger(boom)                     # identity dedup
+    sink = _Sink()
+    eng.observe("train", {"step": 1, "loss": 50.0}, emit=sink, now=0.0)
+    assert calls == ["lossy"]                 # once, not twice
+    assert sink.kinds() == ["alert"]          # record still emitted

@@ -1,0 +1,377 @@
+"""Unified multi-job runtime (runtime/ package, ``--mode run``,
+docs/RUNTIME.md): the ``--jobs`` grammar, the config dict round-trip
+every worker ships through, the single-registry ``/metrics`` endpoint,
+``tools/loadgen.py --runtime`` discovery, and the tier-1 acceptance
+smoke — one process trains while serving and evaluating on the shared
+mesh, every committed checkpoint hot-swaps the in-process engine from
+live device buffers (zero checkpoint reads), an injected accuracy
+alert triggers a FineTuneJob whose alert→job→publish lineage is on the
+stream, and the served outputs exactly equal the standalone ``--mode
+serve`` restore path. A separate run pins the fetch-parity invariant:
+publishing into the engine adds ZERO ``jax.device_get`` calls over a
+serve-less training run."""
+
+import dataclasses
+import json
+import os
+import socket
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tests.conftest import tiny_train_cfg
+
+
+# ---------------------------------------------------------------------------
+# --jobs grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_jobs_grammar():
+    from dml_cnn_cifar10_tpu.runtime import parse_jobs
+
+    jobs = parse_jobs("train,serve,eval")
+    assert [j.jtype for j in jobs] == ["train", "serve", "eval"]
+    # train is a task job; serve/eval are services that outlive it.
+    assert [j.service for j in jobs] == [False, True, True]
+    assert parse_jobs(" train , serve ")[1].jtype == "serve"
+    with pytest.raises(ValueError, match="twice"):
+        parse_jobs("train,train")
+    with pytest.raises(ValueError, match="finetune"):
+        parse_jobs("train,finetune")
+    with pytest.raises(ValueError, match="unknown job"):
+        parse_jobs("train,bogus")
+    with pytest.raises(ValueError, match="no jobs"):
+        parse_jobs(" , ")
+
+
+# ---------------------------------------------------------------------------
+# config round-trip (the dict every mode ships through)
+# ---------------------------------------------------------------------------
+
+def test_config_round_trip_covers_every_dataclass():
+    """config_to_dict → JSON → config_from_dict is the identity over
+    the FULL config tree — with a drift gate: every nested dataclass
+    field of TrainConfig must be registered in _SUBCONFIGS, so adding a
+    subsystem config without wiring its reconstruction fails here."""
+    from dml_cnn_cifar10_tpu import config as config_lib
+
+    cfg = config_lib.TrainConfig()
+    nested = {f.name for f in dataclasses.fields(config_lib.TrainConfig)
+              if dataclasses.is_dataclass(getattr(cfg, f.name))}
+    assert nested == set(config_lib._SUBCONFIGS), \
+        "new subconfig not registered for config_from_dict reconstruction"
+
+    # Perturb one JSON-representable field in EVERY subconfig plus some
+    # top-level scalars, so the equality below proves each subtree
+    # actually round-trips (not just defaults comparing to defaults).
+    def perturb(obj):
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if isinstance(v, bool):
+                setattr(obj, f.name, not v)
+            elif isinstance(v, int):
+                setattr(obj, f.name, v + 7)
+            elif isinstance(v, float):
+                setattr(obj, f.name, v + 0.25)
+            elif isinstance(v, str):
+                setattr(obj, f.name, v + "_x")
+            else:
+                continue
+            return f.name
+        raise AssertionError(f"no perturbable field on {obj}")
+
+    for name in config_lib._SUBCONFIGS:
+        assert perturb(getattr(cfg, name))
+    cfg.total_steps = 1234
+    cfg.metrics_jsonl = "/tmp/m.jsonl"
+    cfg.alert_rules = "x=eval.test_accuracy<0.5"
+    cfg.runtime.jobs = "train,serve,eval"
+    cfg.runtime.finetune_steps = 50
+
+    wire = json.loads(json.dumps(config_lib.config_to_dict(cfg)))
+    back = config_lib.config_from_dict(wire)
+    assert back == cfg
+    # JSON has no tuples; the typed field comes back as one.
+    assert isinstance(back.serve.buckets, tuple)
+
+    # Unknown keys fail loudly — top level and nested.
+    with pytest.raises(TypeError):
+        config_lib.config_from_dict({**wire, "bogus": 1})
+    bad = json.loads(json.dumps(wire))
+    bad["runtime"]["bogus"] = 1
+    with pytest.raises(TypeError):
+        config_lib.config_from_dict(bad)
+
+
+# ---------------------------------------------------------------------------
+# one /metrics endpoint, both job families, no double-bind
+# ---------------------------------------------------------------------------
+
+def test_metrics_registry_one_endpoint_both_families():
+    from dml_cnn_cifar10_tpu.utils.metrics_registry import (
+        MetricsRegistry, ensure_stats_server, observe_record,
+        parse_prometheus_text, stop_stats_server)
+
+    reg = MetricsRegistry()
+    observe_record("train", {"step": 10, "loss": 1.2,
+                             "images_per_sec": 100.0,
+                             "device_step_ms": 2.0,
+                             "drain_wait_ms": 0.5}, reg)
+    observe_record("serve", {"requests": 10, "completed": 10,
+                             "shed_queue": 0, "shed_deadline": 0,
+                             "qps": 5.0, "p50_ms": 4.0, "p95_ms": 6.0,
+                             "p99_ms": 8.0, "batch_fill": 0.9,
+                             "window_s": 5.0}, reg)
+    observe_record("job", {"job": "train", "jtype": "train",
+                           "state": "running"}, reg)
+    observe_record("job_done", {"job": "train", "jtype": "train",
+                                "ok": True, "secs": 1.5}, reg)
+    observe_record("publish", {"step": 20, "version": "20",
+                               "source": "live_params",
+                               "latency_ms": 3.0, "swapped": True}, reg)
+    doc = parse_prometheus_text(reg.render())
+    # Both families and the runtime series on ONE registry render.
+    assert doc["dml_train_step"]["samples"][()] == 10.0
+    assert doc["dml_serve_qps"]["samples"][()] == 5.0
+    assert doc["dml_job_transitions_total"]["samples"][
+        (("jtype", "train"), ("state", "running"))] == 1.0
+    assert doc["dml_jobs_done_total"]["samples"][
+        (("jtype", "train"), ("ok", "true"))] == 1.0
+    assert doc["dml_publishes_total"]["samples"][
+        (("swapped", "true"),)] == 1.0
+    assert doc["dml_publish_latency_ms"]["samples"][()] == 3.0
+    assert doc["dml_published_step"]["samples"][()] == 20.0
+
+    # ensure_stats_server is one bind per process: a second call (even
+    # with a different port) returns the SAME server — the runtime and
+    # every Trainer it hosts share the endpoint instead of fighting.
+    stop_stats_server()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    try:
+        s1 = ensure_stats_server(port)
+        assert s1 is not None and s1.port == port
+        assert ensure_stats_server(port) is s1
+        assert ensure_stats_server(port + 1) is s1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{s1.port}/metrics", timeout=5) as r:
+            parse_prometheus_text(r.read().decode())
+    finally:
+        stop_stats_server()
+
+
+# ---------------------------------------------------------------------------
+# loadgen --runtime discovery
+# ---------------------------------------------------------------------------
+
+def test_loadgen_runtime_discovery(tmp_path):
+    from tools import loadgen
+
+    with pytest.raises(SystemExit, match="cannot read"):
+        loadgen.main(["--runtime", str(tmp_path / "missing.json")])
+    with pytest.raises(SystemExit, match="exclusive"):
+        loadgen.main(["--runtime", str(tmp_path),
+                      "--target", "http://localhost:1"])
+    # A runtime that has not published yet advertises no port — the
+    # error says why instead of hammering a null target. Passing the
+    # log_dir (not the file) exercises the directory resolution.
+    (tmp_path / "runtime.json").write_text(json.dumps(
+        {"pid": 1, "serve_port": None, "version": None, "publishes": 0}))
+    with pytest.raises(SystemExit, match="serve_port"):
+        loadgen.main(["--runtime", str(tmp_path)])
+
+
+# ---------------------------------------------------------------------------
+# the acceptance smoke: train + serve + eval on one mesh, closed into
+# an alert-triggered fine-tune, zero checkpoint reads on the hot path
+# ---------------------------------------------------------------------------
+
+def test_runtime_unified_smoke(data_cfg, tmp_path, monkeypatch):
+    import jax
+
+    from dml_cnn_cifar10_tpu import ckpt as ckpt_lib
+    from dml_cnn_cifar10_tpu.data import download
+    from dml_cnn_cifar10_tpu.data.pipeline import _load_split
+    from dml_cnn_cifar10_tpu.runtime import Runtime
+
+    restores = {"n": 0}
+    real_restore = ckpt_lib.restore_checkpoint
+
+    def counting_restore(*a, **kw):
+        restores["n"] += 1
+        return real_restore(*a, **kw)
+
+    monkeypatch.setattr(ckpt_lib, "restore_checkpoint", counting_restore)
+
+    cfg = tiny_train_cfg(data_cfg, str(tmp_path / "run"), total_steps=20,
+                         output_every=5, eval_every=10,
+                         checkpoint_every=10)
+    cfg.metrics_jsonl = os.path.join(cfg.log_dir, "metrics.jsonl")
+    cfg.serve.port = 0                       # ephemeral: no collisions
+    cfg.runtime.jobs = "train,serve,eval"
+    cfg.runtime.eval_every_s = 0.2
+    # The injected drift signal: accuracy is always < 1.5, so the rule
+    # fires (once — it never resolves) on the first eval record and the
+    # control loop must turn it into exactly one FineTuneJob.
+    cfg.alert_rules = "acc_drop=eval.test_accuracy<1.5"
+    cfg.runtime.finetune_steps = 10
+    cfg.runtime.finetune_rules = "acc_drop"
+    cfg.runtime.max_finetunes = 1
+
+    rt = Runtime(cfg, task_index=0)
+    try:
+        rt.start()
+        # The serve job binds after the FIRST publish (step-10 commit);
+        # probe the live HTTP surface while training is still running.
+        deadline = time.time() + 600
+        while rt.serve_port is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert rt.serve_port, "serve job never bound (no publish?)"
+        base = f"http://127.0.0.1:{rt.serve_port}"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=60) as r:
+            health = json.load(r)
+        assert health["ok"] and health["version"] is not None
+        download.ensure_dataset(cfg.data)
+        images, _ = _load_split(download.test_files(cfg.data), cfg.data)
+        req = urllib.request.Request(f"{base}/predict",
+                                     data=images[0].tobytes(),
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=300) as r:
+            assert r.status == 200
+            body = json.load(r)
+        assert 0 <= body["class"] < 10 and len(body["logits"]) == 10
+        rt.wait()
+
+        # Train ran 20 steps, the fine-tune continued 20 → 30; the final
+        # commit's publish leaves the engine at version "30". The whole
+        # run made exactly ONE restore call — TrainJob's initial
+        # (empty-dir) restore; publishes and the fine-tune state
+        # hand-off read no checkpoints.
+        assert restores["n"] == 1
+        batch = images[:32]
+        live_logits, _, live_version = \
+            rt.engine.forward_timed_versioned(batch)
+        assert live_version == "30"
+    finally:
+        rt.close()
+
+    # --- the stream tells the whole story, and lints clean -------------
+    with open(cfg.metrics_jsonl) as f:
+        recs = [json.loads(line) for line in f]
+    by = {}
+    for r in recs:
+        by.setdefault(r["kind"], []).append(r)
+
+    pubs = by["publish"]
+    assert len(pubs) >= 3                    # steps 10, 20, 30
+    assert all(p["source"] == "live_params" and p["swapped"]
+               for p in pubs)
+    assert pubs[-1]["step"] == 30
+    assert any(p["job"] == "finetune-1" and p["step"] == 30
+               for p in pubs)
+
+    fired = [r for r in by["alert"] if r["rule"] == "acc_drop"]
+    assert len(fired) == 1                   # fires once, never resolves
+
+    names = {r["job"] for r in by["job"]}
+    assert names == {"train", "serve", "eval", "finetune-1"}
+    ft = [r for r in by["job"] if r["job"] == "finetune-1"]
+    assert ft and all(r["trigger"] == "acc_drop" for r in ft)
+    assert [r["state"] for r in ft] == ["pending", "running", "done"]
+    dones = by["job_done"]
+    assert {r["job"] for r in dones} == names
+    assert all(r["ok"] for r in dones)
+
+    # The eval job measured published weights on the shared engine.
+    rt_evals = [r for r in by["eval"]
+                if r.get("source") == "runtime_eval"]
+    assert rt_evals and all(0.0 <= r["test_accuracy"] <= 1.0
+                            for r in rt_evals)
+
+    from tools import check_jsonl_schema
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl,
+                                         strict=True) == []
+
+    # telemetry_report renders the lifecycle + lineage, text and JSON.
+    from tools import telemetry_report
+    js = telemetry_report.summarize_json(cfg.metrics_jsonl)
+    assert js["jobs"]["publish"]["publishes"] == len(pubs)
+    assert js["jobs"]["publish"]["last_version"] == "30"
+    assert any(ln["rule"] == "acc_drop" and ln["job"] == "finetune-1"
+               and "30" in ln["versions"]
+               for ln in js["jobs"]["lineage"])
+    txt = telemetry_report.summarize(cfg.metrics_jsonl)
+    assert "runtime jobs:" in txt and "finetune-1" in txt
+    assert "lineage" in txt
+
+    # runtime.json advertises what loadgen --runtime needs.
+    with open(os.path.join(cfg.log_dir, "runtime.json")) as f:
+        state = json.load(f)
+    assert state["serve_port"] and state["version"] == "30"
+    assert state["publishes"] == len(pubs)
+
+    # --- served outputs == the standalone --mode serve path ------------
+    # resolve_engine restores the newest checkpoint (step 30) from disk
+    # — the restore count proves it reads what the runtime never did —
+    # and must produce bitwise-identical logits for the same uint8
+    # batch.
+    from dml_cnn_cifar10_tpu.serve.server import resolve_engine
+    scfg = dataclasses.replace(cfg, metrics_jsonl=None)
+    eng2 = resolve_engine(scfg)
+    assert restores["n"] == 2
+    ref_logits, _, ref_version = eng2.forward_timed_versioned(batch)
+    assert ref_version == "30"
+    assert np.array_equal(live_logits, ref_logits)
+
+
+# ---------------------------------------------------------------------------
+# fetch parity: publishing into the in-process engine is free
+# ---------------------------------------------------------------------------
+
+def test_runtime_train_fetch_parity(data_cfg, tmp_path, monkeypatch):
+    """A --mode run process (train + serve, no traffic) must issue
+    EXACTLY the device fetches of a bare serve-less Trainer run: the
+    publish protocol parks device-side copies and pointer-swaps them —
+    any jax.device_get it added would stall the train step."""
+    import jax
+
+    from dml_cnn_cifar10_tpu.runtime import Runtime
+    from dml_cnn_cifar10_tpu.train.loop import Trainer
+
+    counts = {"n": 0}
+    real_get = jax.device_get
+
+    def counting_get(x):
+        counts["n"] += 1
+        return real_get(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+
+    def mk(sub):
+        cfg = tiny_train_cfg(data_cfg, str(tmp_path / sub),
+                             total_steps=20, output_every=5,
+                             eval_every=10, checkpoint_every=10)
+        cfg.metrics_jsonl = os.path.join(cfg.log_dir, "m.jsonl")
+        return cfg
+
+    cfg_bare = mk("bare")
+    counts["n"] = 0
+    assert Trainer(cfg_bare).fit().final_step == 20
+    bare_fetches = counts["n"]
+
+    cfg_run = mk("run")
+    cfg_run.serve.port = 0
+    cfg_run.runtime.jobs = "train,serve"
+    rt = Runtime(cfg_run)
+    counts["n"] = 0
+    try:
+        rt.start()
+        rt.wait()
+    finally:
+        rt.close()
+    assert rt.engine is not None and rt.engine.version == "20"
+    assert counts["n"] == bare_fetches, \
+        "publishing into the serving engine must add zero device fetches"

@@ -43,6 +43,7 @@ Usage::
     python tools/chaos.py --seeds 8 --scenario cluster  # 2-process shrink sims
     python tools/chaos.py --seeds 4 --scenario expand   # 2→1→2 scale-UP sims
     python tools/chaos.py --seeds 4 --scenario peer_recovery  # diskless-restore sims
+    python tools/chaos.py --seeds 4 --scenario runtime  # --mode run (train+serve) sims
 
 Exit 1 when any schedule violates an invariant. ``--plant
 no_decision_sidecar`` reverts the RestartCoordinator sidecar check
@@ -153,7 +154,25 @@ if cluster_dir:
     cfg.parallel.peer_dead_after_s = 2.5
     cfg.parallel.collective_timeout_s = 300.0
 
-res = fit_supervised(cfg, task_index=task)
+if os.environ.get("DML_CHAOS_RUNTIME"):
+    # Unified-runtime scenario: the same supervised training run, but
+    # as a TrainJob inside --mode run with the in-process serving head
+    # up — faults must recover AND the publish protocol must keep
+    # committing versions (the harness checks the stream for both).
+    cfg.supervise = True
+    cfg.runtime.jobs = "train,serve"
+    cfg.serve.port = 0          # ephemeral: campaign runs must not collide
+    from dml_cnn_cifar10_tpu.runtime import Runtime
+    rt = Runtime(cfg, task_index=task)
+    try:
+        rt.start()
+        rt.wait()
+    finally:
+        rt.close()
+    train_jobs = [j for j in rt.scheduler.jobs if j.name == "train"]
+    res = train_jobs[0].result if train_jobs else None
+else:
+    res = fit_supervised(cfg, task_index=task)
 if res is None:
     print("RESULT " + json.dumps({"task": task, "fenced": True}))
     sys.exit(0)
@@ -183,7 +202,8 @@ EXPAND_HOLD = "host_return@18"
 #: peer_recovery scenarios reuse the train oracle — a peer-sourced
 #: restore must be BIT-IDENTICAL to a disk restore, which the shared
 #: oracle pins for free.
-REF_ALIAS = {"expand": "train", "peer_recovery": "train"}
+REF_ALIAS = {"expand": "train", "peer_recovery": "train",
+             "runtime": "train"}
 
 #: Scenarios that run the 2-process shrink drill (task 1 carries the
 #: backbone ``host_lost`` and must exit with its abrupt-death code).
@@ -238,14 +258,18 @@ class ChaosHarness:
 
     # -- process plumbing -------------------------------------------------
 
-    def _spawn(self, args, planted: bool, peer: bool = False):
+    def _spawn(self, args, planted: bool, peer: bool = False,
+               runtime: bool = False):
         env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env.pop("DML_CHAOS_PLANT", None)
         env.pop("DML_CHAOS_PLANT_CODE", None)
         env.pop("DML_CHAOS_PEER", None)
+        env.pop("DML_CHAOS_RUNTIME", None)
         if peer:
             env["DML_CHAOS_PEER"] = "1"
+        if runtime:
+            env["DML_CHAOS_RUNTIME"] = "1"
         if planted and self.plant:
             env["DML_CHAOS_PLANT"] = self.plant
             env["DML_CHAOS_PLANT_CODE"] = PLANTS[self.plant]
@@ -395,7 +419,8 @@ class ChaosHarness:
         specs = [spec] if n == 1 else [spec, backbone]
         procs = [self._spawn([t, n, self.data_dir, logs[t], cluster,
                               specs[t], self.total_steps], planted=True,
-                             peer=scenario == "peer_recovery")
+                             peer=scenario == "peer_recovery",
+                             runtime=scenario == "runtime")
                  for t in range(n)]
         outs, timed_out = [], False
         for p in procs:
@@ -436,6 +461,24 @@ class ChaosHarness:
         if res["digest"] != ref:
             return fail("bit_identical: final params differ from the "
                         "fault-free reference")
+        if scenario == "runtime":
+            # Runtime invariants (docs/RUNTIME.md): the publish
+            # protocol must have committed at least one version into
+            # the in-process serving engine, and no job — task or
+            # service — may have failed.
+            stream = os.path.join(logs[0], "metrics.jsonl")
+            rrecs = []
+            if os.path.exists(stream):
+                with open(stream) as f:
+                    rrecs = [json.loads(ln) for ln in f if ln.strip()]
+            if not any(r.get("kind") == "publish" for r in rrecs):
+                return fail("publish: runtime run committed no publish "
+                            "record")
+            bad = [r for r in rrecs
+                   if r.get("kind") == "job_done" and not r.get("ok")]
+            if bad:
+                return fail(f"completed: job {bad[0].get('job')!r} "
+                            f"failed ({bad[0].get('error')})")
         injected: Dict[str, int] = {}
         slowest = 0.0
         for i, d in enumerate(logs):
@@ -608,7 +651,8 @@ def run_campaign(seeds: Sequence[int], scenario: str, workdir: str,
     vocab = {"train": faults_lib.CHAOS_VOCABULARY,
              "cluster": faults_lib.CHAOS_CLUSTER_VOCABULARY,
              "expand": faults_lib.CHAOS_EXPAND_VOCABULARY,
-             "peer_recovery": faults_lib.CHAOS_PEER_VOCABULARY}[scenario]
+             "peer_recovery": faults_lib.CHAOS_PEER_VOCABULARY,
+             "runtime": faults_lib.CHAOS_RUNTIME_VOCABULARY}[scenario]
     results = []
     faults_by_kind: Dict[str, int] = {}
     slowest = 0.0
@@ -677,13 +721,16 @@ def main(argv=None) -> int:
                    help="first seed (seeds are seed_base..+N-1)")
     p.add_argument("--scenario", default="train",
                    choices=["train", "cluster", "expand",
-                            "peer_recovery", "mixed"],
+                            "peer_recovery", "runtime", "mixed"],
                    help="which sim to fuzz: 1-process supervised "
                         "train, the 2-process cluster shrink drill, "
                         "the 2→1→2 elastic-expand drill, the 2-process "
                         "diskless-recovery drill (peer redundancy on, "
-                        "replica faults in vocabulary), or an "
-                        "alternating mix of all of them")
+                        "replica faults in vocabulary), the 1-process "
+                        "unified runtime (--mode run: supervised train "
+                        "+ in-process serving, publishes must survive "
+                        "recovery), or an alternating mix of all of "
+                        "them")
     p.add_argument("--budget", type=int, default=3,
                    help="faults sampled per schedule")
     p.add_argument("--total_steps", type=int, default=40,
@@ -714,8 +761,9 @@ def main(argv=None) -> int:
     scenarios = {"train": ["train"], "cluster": ["cluster"],
                  "expand": ["expand"],
                  "peer_recovery": ["peer_recovery"],
+                 "runtime": ["runtime"],
                  "mixed": ["train", "cluster", "expand",
-                           "peer_recovery"]}[args.scenario]
+                           "peer_recovery", "runtime"]}[args.scenario]
     seeds = list(range(args.seed_base, args.seed_base + args.seeds))
     if args.spec is not None:
         seeds = seeds[:1]

@@ -187,6 +187,18 @@ KIND_KEYS = {
     # bundle captured on an alert firing: the rule that fired, the
     # bundle directory, and how many ring records it snapshotted.
     "postmortem": ("rule", "dir", "records"),
+    # Unified multi-job runtime (runtime/; docs/RUNTIME.md). `job` is a
+    # job lifecycle transition (state: pending / running / done /
+    # failed; alert-born jobs also carry `trigger=<rule>`); `job_done`
+    # the completion summary (`ok` + wall seconds, `error` when not
+    # ok); `publish` one committed checkpoint's weights installed into
+    # the in-process serving engine via the locked pointer swap —
+    # `source` is "live_params" (device buffers, zero checkpoint
+    # reads), `swapped` whether the engine accepted the candidate, and
+    # the extra `job`/`seq` keys stamp the alert→job→publish lineage.
+    "job": ("job", "jtype", "state"),
+    "job_done": ("job", "jtype", "ok", "secs"),
+    "publish": ("step", "version", "source", "latency_ms", "swapped"),
 }
 
 

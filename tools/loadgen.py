@@ -287,6 +287,11 @@ def main(argv=None) -> int:
     ap.add_argument("--target", type=str, default=None,
                     help="drive a running --mode serve/fleet HTTP "
                          "endpoint instead of an in-process engine")
+    ap.add_argument("--runtime", type=str, default=None,
+                    help="drive the serving head of a live --mode run "
+                         "process: a runtime.json path, or the log_dir "
+                         "that contains one (the runtime advertises its "
+                         "bound serve port there); sets --target")
     ap.add_argument("--model", type=str, default="cnn")
     ap.add_argument("--image_size", type=int, default=32)
     ap.add_argument("--crop_size", type=int, default=24)
@@ -305,6 +310,31 @@ def main(argv=None) -> int:
     ap.add_argument("--report", type=str, default="loadgen_report.json")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+
+    if args.runtime:
+        # Discover the in-process serving head of a --mode run process
+        # from its advertised runtime.json (runtime/core.py writes it
+        # atomically; serve_port is null until the serve job binds).
+        if args.target:
+            raise SystemExit("--runtime and --target are exclusive")
+        state_path = args.runtime
+        if os.path.isdir(state_path):
+            state_path = os.path.join(state_path, "runtime.json")
+        try:
+            with open(state_path) as f:
+                state = json.load(f)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"--runtime: cannot read {state_path}: {e}")
+        port = state.get("serve_port")
+        if not port:
+            raise SystemExit(
+                f"--runtime: {state_path} advertises no serve_port yet "
+                f"(is the runtime's serve job up? it binds after the "
+                f"first checkpoint publish)")
+        args.target = f"http://127.0.0.1:{int(port)}"
+        print(f"[loadgen] runtime target {args.target} (version "
+              f"{state.get('version')}, {state.get('publishes')} "
+              f"publish(es))", flush=True)
 
     import numpy as np
 

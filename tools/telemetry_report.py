@@ -225,6 +225,66 @@ def _peer_summary(records: List[dict]) -> Optional[dict]:
     return out
 
 
+def _jobs_summary(records: List[dict]) -> Optional[dict]:
+    """Unified-runtime rollup (``--mode run``; runtime/, docs/RUNTIME.md)
+    from the ``job`` / ``job_done`` / ``publish`` records: per-job state
+    timeline, completion verdicts, publish latency, and the
+    alert→job→publish lineage for trigger-born jobs. None when the
+    stream carries none of the three kinds — the report stays
+    byte-identical for pre-runtime streams."""
+    job_recs = [r for r in records if r.get("kind") == "job"]
+    dones = [r for r in records if r.get("kind") == "job_done"]
+    pubs = [r for r in records if r.get("kind") == "publish"]
+    if not job_recs and not dones and not pubs:
+        return None
+    by_job: dict = {}
+
+    def ent(name):
+        return by_job.setdefault(str(name), {
+            "jtype": None, "timeline": [], "trigger": None,
+            "ok": None, "secs": None, "error": None, "publishes": 0,
+            "versions": []})
+
+    for r in job_recs:
+        e = ent(r.get("job"))
+        e["jtype"] = r.get("jtype") or e["jtype"]
+        e["timeline"].append({"state": r.get("state"), "t": r.get("t")})
+        if r.get("trigger"):
+            e["trigger"] = r["trigger"]
+    for r in dones:
+        e = ent(r.get("job"))
+        e["jtype"] = r.get("jtype") or e["jtype"]
+        e["ok"], e["secs"] = r.get("ok"), r.get("secs")
+        if r.get("error"):
+            e["error"] = r["error"]
+    for r in pubs:
+        if r.get("job") is not None and str(r["job"]) in by_job:
+            e = by_job[str(r["job"])]
+            e["publishes"] += 1
+            e["versions"].append(r.get("version"))
+    latencies = [r["latency_ms"] for r in pubs
+                 if isinstance(r.get("latency_ms"), (int, float))]
+    publish = None
+    if pubs:
+        publish = {
+            "publishes": len(pubs),
+            "swapped": sum(1 for r in pubs if r.get("swapped")),
+            "latency_ms_mean": round(sum(latencies) / len(latencies), 3)
+            if latencies else None,
+            "latency_ms_max": round(max(latencies), 3)
+            if latencies else None,
+            "last_version": pubs[-1].get("version"),
+            "last_step": pubs[-1].get("step"),
+        }
+    # Trigger lineage: an alert-born job carries trigger=<rule> on its
+    # `job` records and stamps job=<name> on the publishes it commits —
+    # the full alert → job → publish arc, read straight off the stream.
+    lineage = [{"rule": e["trigger"], "job": name,
+                "versions": e["versions"]}
+               for name, e in sorted(by_job.items()) if e["trigger"]]
+    return {"jobs": by_job, "publish": publish, "lineage": lineage}
+
+
 def _fmt_bytes(n: Optional[int]) -> str:
     if not n:
         return "-"
@@ -512,6 +572,39 @@ def summarize_records(records: List[dict], header: str) -> str:
                 f"    [{r.get('severity')}] {r.get('rule')} fired at "
                 f"t={r.get('t')}s (value {r.get('value')}, window "
                 f"{r.get('window')}) — {state}")
+    # Unified runtime (--mode run; runtime/, docs/RUNTIME.md): the job
+    # lifecycle timeline, the in-process publish latency, and the
+    # alert→job→publish lineage for any trigger-born fine-tunes.
+    jobs = _jobs_summary(records)
+    if jobs:
+        lines.append("  runtime jobs:")
+        for name, e in sorted(jobs["jobs"].items()):
+            arc = " -> ".join(t["state"] for t in e["timeline"]) \
+                or "(no transitions)"
+            tail = ""
+            if e["secs"] is not None:
+                verdict = "ok" if e["ok"] else "FAILED"
+                tail = f" ({verdict} in {e['secs']} s)"
+            trig = f" [trigger: {e['trigger']}]" if e["trigger"] else ""
+            npub = (f", {e['publishes']} publish(es)"
+                    if e["publishes"] else "")
+            lines.append(f"    {name} ({e['jtype']}): {arc}"
+                         f"{tail}{trig}{npub}")
+            if e["error"]:
+                lines.append(f"      error: {e['error']}")
+        pub = jobs["publish"]
+        if pub:
+            lines.append(
+                f"    publishes: {pub['publishes']} "
+                f"({pub['swapped']} swapped), latency mean "
+                f"{pub['latency_ms_mean']} / max {pub['latency_ms_max']} "
+                f"ms, last version {pub['last_version']} "
+                f"(step {pub['last_step']})")
+        for arc in jobs["lineage"]:
+            vers = ", ".join(str(v) for v in arc["versions"]) or "none"
+            lines.append(
+                f"    lineage: alert {arc['rule']!r} -> {arc['job']} -> "
+                f"published version(s) {vers}")
     # Resilience events (docs/RESILIENCE.md): how many faults the run
     # absorbed, and what the recovery path did about them.
     faults = [r for r in records if r.get("kind") == "fault"]
@@ -799,6 +892,9 @@ def summarize_json(path: str) -> dict:
                  "value": r.get("value"), "window": r.get("window")}
                 for r in still_active.values()],
         }
+    jobs = _jobs_summary(records)
+    if jobs:
+        out["jobs"] = jobs
     faults = [r for r in records if r.get("kind") == "fault"]
     recoveries = [r for r in records if r.get("kind") == "recovery"]
     if faults or recoveries:
