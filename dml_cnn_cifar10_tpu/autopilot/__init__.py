@@ -1,0 +1,12 @@
+"""Autopilot: alert-driven remediation policy engine.
+
+Closes the loop between the alert engine (utils/alerts.py) and the
+remediation seams the rest of the repo already exposes — supervisor
+restart decisions, RuntimeConfig/JobScheduler, the fleet controller,
+the compile cache. See docs/AUTOPILOT.md.
+"""
+
+from dml_cnn_cifar10_tpu.autopilot.engine import (  # noqa: F401
+    ACTIONS, AutopilotEngine, RemediationBudget, RemediationPolicy,
+    RemediationRestartError, default_policies, parse_policies,
+    required_extra_rules)

@@ -1,0 +1,447 @@
+"""The remediation policy engine behind ``--autopilot``.
+
+Deterministic closed-loop remediation: the engine attaches to the
+alert trigger seam (:meth:`AlertEngine.add_trigger`) and maps alert
+patterns to remediation actions through the seams the repo already
+has — config mutation picked up by the supervisor's restart path, a
+restart request the Trainer's dispatch loop polls, and bound hooks
+into the serving/fleet layers. Every qualifying alert firing is
+answered by exactly one ``remediation`` JSONL record per matching
+policy — including explicit ``suppressed_cooldown`` /
+``suppressed_budget`` records, so a chaos campaign can assert the
+loop considered every firing. Records link back to the firing alert's
+``id`` and to the flight-recorder postmortem bundle captured at the
+moment it fired.
+
+Policy table (defaults; ``--autopilot_policies`` replaces it):
+
+====================  ==================================  =================
+alert pattern         action                              gate
+====================  ==================================  =================
+nonfinite_burst       rollback (LR × --rollback_lr_scale) 50-step cooldown
+hbm_headroom          shrink_memory (halve resident K,     100-step cooldown
+                      recompile through the compile cache)
+serve_p99_slo /       scale_up_shed (fleet scale-up +     60 s cooldown
+serve_shed/fleet_shed tier-by-tenant shed)
+peer_churn            raise_replica_keep (+1, max 4)      300-step cooldown
+====================  ==================================  =================
+
+All actions share one :class:`RemediationBudget` (the
+``--max_finetunes`` pattern generalized): when it is spent, every
+further firing is answered by a ``suppressed_budget`` record and the
+plain alert stands — the engine fails open, never closed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from dml_cnn_cifar10_tpu.utils.alerts import AlertRule
+
+#: action name -> one-line description (the validation set for
+#: ``--autopilot_policies`` and the docs table).
+ACTIONS = {
+    "rollback": "restore + scale LR by rollback_lr_scale (params: "
+                "lr_scale)",
+    "shrink_memory": "halve resident steps_per_dispatch (bit-identical) "
+                     "or batch (params: shrink_batch=1) and recompile "
+                     "through the compile cache",
+    "scale_up_shed": "fleet scale-up + tier-by-tenant shed (params: "
+                     "tier)",
+    "raise_replica_keep": "raise --replica_keep by one (params: max)",
+}
+
+
+class RemediationRestartError(RuntimeError):
+    """Raised by the Trainer's autopilot seam when a policy requested a
+    restart (config already mutated): the supervisor classifies it as
+    the recoverable ``remediation`` fault, restores the newest
+    checkpoint, and rebuilds the step through the compile cache with
+    the new geometry."""
+
+
+@dataclasses.dataclass
+class RemediationPolicy:
+    """One alert-pattern → action mapping with its cooldown gate."""
+
+    name: str
+    rules: Tuple[str, ...]             # fnmatch patterns on rule names
+    action: str
+    cooldown: float = 0.0
+    cooldown_unit: str = "steps"       # steps | seconds
+    params: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.action not in ACTIONS:
+            raise ValueError(
+                f"autopilot policy {self.name!r}: unknown action "
+                f"{self.action!r} (known: {sorted(ACTIONS)})")
+        if self.cooldown_unit not in ("steps", "seconds"):
+            raise ValueError(
+                f"autopilot policy {self.name!r}: cooldown unit must "
+                f"be steps or seconds")
+
+    def matches(self, rule_name: str) -> bool:
+        return any(fnmatch.fnmatchcase(rule_name, p)
+                   for p in self.rules)
+
+    def cooldown_str(self) -> str:
+        w = int(self.cooldown) if float(self.cooldown).is_integer() \
+            else self.cooldown
+        return f"{w}s" if self.cooldown_unit == "seconds" \
+            else f"{w} steps"
+
+
+def default_policies() -> List[RemediationPolicy]:
+    """The built-in table (module docstring)."""
+    return [
+        RemediationPolicy("rollback_nonfinite", ("nonfinite_burst",),
+                          "rollback", cooldown=50,
+                          cooldown_unit="steps"),
+        RemediationPolicy("shrink_memory", ("hbm_headroom",),
+                          "shrink_memory", cooldown=100,
+                          cooldown_unit="steps"),
+        RemediationPolicy("scale_up_shed",
+                          ("serve_p99_slo", "serve_shed", "fleet_shed"),
+                          "scale_up_shed", cooldown=60,
+                          cooldown_unit="seconds"),
+        RemediationPolicy("raise_replica_keep", ("peer_churn",),
+                          "raise_replica_keep", cooldown=300,
+                          cooldown_unit="steps"),
+    ]
+
+
+_PARAM_RE = re.compile(r"^\w+=-?[\d.]+$")
+
+
+def parse_policies(spec: Optional[str]) -> List[RemediationPolicy]:
+    """Parse the ``--autopilot_policies`` grammar.
+
+    ``;``-separated entries, each
+    ``name=pattern[|pattern...]->action[:k=v,...][@cooldown]``:
+
+    - ``roll=nonfinite_burst->rollback@50`` — 50-STEP cooldown
+      (``@30s`` = 30 seconds; default 0 = no cooldown),
+    - ``shed=serve_*|fleet_shed->scale_up_shed:tier=2@60s`` — fnmatch
+      patterns, numeric action params.
+
+    A non-empty spec REPLACES the default table. Raises ``ValueError``
+    at flag-parse time on any mismatch — a typo'd policy must fail the
+    run, not silently never remediate.
+    """
+    out: List[RemediationPolicy] = []
+    if not spec:
+        return out
+    for raw in spec.split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        name, eq, rest = entry.partition("=")
+        name = name.strip()
+        if not eq or not re.fullmatch(r"\w+", name):
+            raise ValueError(
+                f"bad autopilot policy {entry!r}: want "
+                f"name=pattern->action[:params][@cooldown]")
+        cooldown, unit = 0.0, "steps"
+        if "@" in rest:
+            rest, _, cd = rest.rpartition("@")
+            cd = cd.strip()
+            if cd.endswith("s") and cd[:-1]:
+                cooldown, unit = float(cd[:-1]), "seconds"
+            else:
+                cooldown = float(cd)
+        pats, arrow, action = rest.partition("->")
+        if not arrow:
+            raise ValueError(
+                f"bad autopilot policy {entry!r}: missing '->action'")
+        patterns = tuple(p.strip() for p in pats.split("|") if p.strip())
+        if not patterns:
+            raise ValueError(
+                f"bad autopilot policy {entry!r}: empty rule pattern")
+        action = action.strip()
+        params: Dict[str, float] = {}
+        if ":" in action:
+            action, _, plist = action.partition(":")
+            action = action.strip()
+            for kv in plist.split(","):
+                kv = kv.strip()
+                if not _PARAM_RE.match(kv):
+                    raise ValueError(
+                        f"bad autopilot policy {entry!r}: param "
+                        f"{kv!r} is not key=number")
+                k, _, v = kv.partition("=")
+                params[k] = float(v)
+        out.append(RemediationPolicy(name, patterns, action,
+                                     cooldown=cooldown,
+                                     cooldown_unit=unit, params=params))
+    names = [p.name for p in out]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise ValueError(
+            f"duplicate autopilot policy name(s): {sorted(dupes)}")
+    return out
+
+
+def required_extra_rules(policies) -> List[AlertRule]:
+    """Alert rules a policy set needs that have no built-in: today the
+    ``peer_churn`` rate rule (repeated ``peer_lost``-classified faults
+    inside a trailing step window) behind ``raise_replica_keep``."""
+    wants_churn = any(p.matches("peer_churn") for p in policies)
+    if not wants_churn:
+        return []
+    return [AlertRule("peer_churn", "rate", "fault", op=">=", value=2,
+                      window=300, window_unit="steps", severity="page",
+                      match={"fault": "peer_lost"})]
+
+
+class RemediationBudget:
+    """Global action budget — the ``--max_finetunes`` counter pattern
+    generalized. ``try_charge`` reserves a unit; ``refund`` returns it
+    when the action turned out to be a noop or failed (a no-change
+    firing must not eat the budget). Thread-safe."""
+
+    def __init__(self, total: int):
+        self.total = int(total)
+        self._lock = threading.Lock()
+        self._spent = 0
+        self.per_policy: Dict[str, int] = {}
+
+    def try_charge(self, name: str) -> bool:
+        with self._lock:
+            if self._spent >= self.total:
+                return False
+            self._spent += 1
+            self.per_policy[name] = self.per_policy.get(name, 0) + 1
+            return True
+
+    def refund(self, name: str) -> None:
+        with self._lock:
+            if self._spent > 0:
+                self._spent -= 1
+            if self.per_policy.get(name, 0) > 0:
+                self.per_policy[name] -= 1
+
+    @property
+    def spent(self) -> int:
+        with self._lock:
+            return self._spent
+
+    def remaining(self) -> int:
+        with self._lock:
+            return max(0, self.total - self._spent)
+
+
+class AutopilotEngine:
+    """Map emitted alert firings to remediation actions.
+
+    Attach with :meth:`attach` (adds any missing pattern rules and the
+    3-arg trigger hook). Actions act through ``cfg`` mutation (the
+    supervisor's rebuild-per-attempt picks them up), a pending-restart
+    flag the Trainer polls (:meth:`poll_restart`), and hooks bound by
+    the hosting layer (:meth:`bind`): ``scale_up`` (fleet controller)
+    and ``shed_tier`` (micro-batcher / router admission).
+
+    Every qualifying firing emits exactly one ``remediation`` record
+    per matching policy with status ``applied`` / ``noop`` /
+    ``failed`` / ``suppressed_cooldown`` / ``suppressed_budget``.
+    Failures are fail-open: the record says so and the plain alert
+    stands — remediation must never make an incident worse.
+    """
+
+    def __init__(self, cfg, policies: Optional[List[RemediationPolicy]]
+                 = None, budget=8, logger=None, flightrec=None):
+        self.cfg = cfg
+        self.policies = (list(policies) if policies is not None
+                         else default_policies())
+        self.budget = (budget if isinstance(budget, RemediationBudget)
+                       else RemediationBudget(budget))
+        self.logger = logger
+        self.flightrec = flightrec
+        self._lock = threading.Lock()
+        self._last_applied: Dict[str, float] = {}   # policy -> mark
+        self._restart_pending: Optional[str] = None
+        self._hooks: Dict[str, Callable] = {}
+        self.history: List[dict] = []               # emitted records
+        # ONE bound-method object for the trigger hook: ``self.on_alert``
+        # evaluates to a fresh bound method every access, which would
+        # defeat ``AlertEngine.add_trigger``'s idempotent-by-identity
+        # check and double every remediation (Runtime attaches the
+        # engine, then injects it into fit_supervised, which attaches
+        # again).
+        self._trigger = self.on_alert
+
+    # -- wiring ----------------------------------------------------------
+
+    def bind(self, name: str, fn: Callable) -> None:
+        """Bind an action seam (``scale_up`` / ``shed_tier``)."""
+        self._hooks[name] = fn
+
+    def attach(self, alerts) -> None:
+        """Register on an :class:`AlertEngine`: inject the pattern
+        rules the policy set needs but the engine lacks, then the
+        trigger hook. Idempotent."""
+        have = {r.name for r in alerts.rules}
+        missing = [r for r in required_extra_rules(self.policies)
+                   if r.name not in have]
+        if missing:
+            alerts.add_rules(missing)
+        alerts.add_trigger(self._trigger)
+
+    def handles(self, rule_name: str,
+                action: Optional[str] = None) -> bool:
+        """True when some policy maps ``rule_name`` (optionally to a
+        specific action) — the supervisor consults this so the LR
+        scale is applied exactly once."""
+        return any(p.matches(rule_name)
+                   and (action is None or p.action == action)
+                   for p in self.policies)
+
+    def poll_restart(self) -> Optional[str]:
+        """Return-and-clear the pending restart reason (the Trainer's
+        dispatch-loop seam)."""
+        with self._lock:
+            reason, self._restart_pending = self._restart_pending, None
+            return reason
+
+    # -- the trigger hook ------------------------------------------------
+
+    def on_alert(self, rule, value, meta=None) -> None:
+        """AlertEngine trigger (3-arg form). Called once per EMITTED
+        firing — never for rate-limit-suppressed re-fires or
+        resolutions (the engine's trigger contract)."""
+        meta = meta or {}
+        alert_id = meta.get("id")
+        step = meta.get("step")
+        for policy in self.policies:
+            if not policy.matches(rule.name):
+                continue
+            with self._lock:
+                status, detail = self._consider(policy, rule, value,
+                                                step)
+            self._emit(policy, rule, alert_id, status, detail, step)
+
+    # -- decision + actions (lock held) ----------------------------------
+
+    def _mark(self, policy, step) -> float:
+        if policy.cooldown_unit == "steps" \
+                and isinstance(step, (int, float)):
+            return float(step)
+        return time.time()
+
+    def _consider(self, policy, rule, value, step):
+        mark = self._mark(policy, step)
+        last = self._last_applied.get(policy.name)
+        if policy.cooldown > 0 and last is not None \
+                and mark - last < policy.cooldown:
+            remaining = policy.cooldown - (mark - last)
+            return "suppressed_cooldown", (
+                f"cooldown {policy.cooldown_str()}: "
+                f"{remaining:g} remaining")
+        if not self.budget.try_charge(policy.name):
+            return "suppressed_budget", (
+                f"budget {self.budget.total} spent")
+        try:
+            status, detail = getattr(self, "_act_" + policy.action)(
+                policy, rule, value, step)
+        except Exception as e:   # fail-open: the plain alert stands
+            status, detail = "failed", f"{type(e).__name__}: {e}"[:200]
+        if status == "applied":
+            self._last_applied[policy.name] = mark
+        else:
+            self.budget.refund(policy.name)
+        return status, detail
+
+    def _act_rollback(self, policy, rule, value, step):
+        cfg = self.cfg
+        scale = float(policy.params.get("lr_scale",
+                                        cfg.rollback_lr_scale))
+        cfg.on_nonfinite = "rollback"
+        if scale != 1.0:
+            cfg.optim.learning_rate *= scale
+        return "applied", (f"lr_scale={scale:g} "
+                           f"lr={cfg.optim.learning_rate:.6g}")
+
+    def _act_shrink_memory(self, policy, rule, value, step):
+        cfg = self.cfg
+        k = int(getattr(cfg, "steps_per_dispatch", 1) or 1)
+        if k > 1:
+            new_k = k // 2 if k % 2 == 0 else 1
+            cfg.steps_per_dispatch = new_k
+            self._restart_pending = (
+                f"shrink_memory: steps_per_dispatch {k}->{new_k}")
+            return "applied", (f"steps_per_dispatch {k}->{new_k} "
+                               f"(restart+recompile)")
+        if policy.params.get("shrink_batch"):
+            bs = int(cfg.batch_size)
+            if bs >= 2:
+                cfg.batch_size = bs // 2
+                self._restart_pending = (
+                    f"shrink_memory: batch_size {bs}->{bs // 2}")
+                return "applied", (f"batch_size {bs}->{bs // 2} "
+                                   f"(restart+recompile, NOT "
+                                   f"bit-identical)")
+        return "noop", "nothing left to shrink"
+
+    def _act_scale_up_shed(self, policy, rule, value, step):
+        tier = int(policy.params.get("tier", 1))
+        did = []
+        up = self._hooks.get("scale_up")
+        if up is not None:
+            up(rule.name)
+            did.append("scale_up")
+        shed = self._hooks.get("shed_tier")
+        if shed is not None:
+            shed(tier)
+            did.append(f"shed_tier={tier}")
+        if not did:
+            return "noop", "no serve/fleet seam bound"
+        return "applied", " ".join(did)
+
+    def _act_raise_replica_keep(self, policy, rule, value, step):
+        cfg = self.cfg
+        cap = int(policy.params.get("max", 4))
+        cur = int(cfg.parallel.replica_keep)
+        if cur >= cap:
+            return "noop", f"replica_keep already {cur} (max {cap})"
+        cfg.parallel.replica_keep = cur + 1
+        return "applied", f"replica_keep {cur}->{cur + 1}"
+
+    # -- the record ------------------------------------------------------
+
+    def _emit(self, policy, rule, alert_id, status, detail, step):
+        bundle = None
+        if self.flightrec is not None \
+                and getattr(self.flightrec, "bundles", None):
+            # The flight recorder observes records BEFORE triggers run,
+            # so the newest bundle is this firing's capture.
+            bundle = self.flightrec.bundles[-1]
+        rec = dict(policy=policy.name, rule=rule.name,
+                   alert_id=alert_id, action=policy.action,
+                   status=status, postmortem=bundle, detail=detail,
+                   step=step)
+        self.history.append(rec)
+        if self.logger is not None:
+            try:
+                self.logger.log("remediation", **rec)
+            except Exception as e:   # never take down the alert path
+                print(f"[autopilot] remediation record failed: {e!r}",
+                      flush=True)
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def from_config(cls, cfg, logger=None, flightrec=None
+                    ) -> Optional["AutopilotEngine"]:
+        """Engine for a TrainConfig when ``--autopilot`` is armed;
+        None otherwise (the disarmed path costs nothing)."""
+        ap = getattr(cfg, "autopilot", None)
+        if ap is None or not ap.enabled:
+            return None
+        policies = parse_policies(ap.policies) or None
+        return cls(cfg, policies=policies, budget=ap.budget,
+                   logger=logger, flightrec=flightrec)

@@ -585,6 +585,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "(@Ns). Firing emits rate-limited alert/"
                         "alert_resolved JSONL records "
                         "(docs/OBSERVABILITY.md)")
+    p.add_argument("--autopilot", type="bool", default=False,
+                   help="alert-driven remediation: attach the autopilot "
+                        "policy engine to the alert trigger seam and "
+                        "answer qualifying alert firings with gated "
+                        "remediation actions (rollback with "
+                        "--rollback_lr_scale, memory shrink + recompile "
+                        "through the compile cache, fleet scale-up + "
+                        "tier shed, raising --replica_keep), each "
+                        "emitting a `remediation` JSONL record linked "
+                        "to the firing alert's id and postmortem "
+                        "bundle (docs/AUTOPILOT.md)")
+    p.add_argument("--autopilot_policies", type=str, default=None,
+                   help="replace the built-in autopilot policy table: "
+                        "';'-separated 'name=pattern[|pattern...]"
+                        "->action[:k=v,...][@cooldown[s]]' where "
+                        "pattern fnmatches alert rule names, action is "
+                        "rollback | shrink_memory | scale_up_shed | "
+                        "raise_replica_keep, and @N is a step cooldown "
+                        "(@Ns seconds). Default: nonfinite_burst->"
+                        "rollback, hbm_headroom->shrink_memory, "
+                        "serve/fleet SLO+shed->scale_up_shed, "
+                        "peer_churn->raise_replica_keep "
+                        "(docs/AUTOPILOT.md)")
+    p.add_argument("--autopilot_budget", type=int, default=8,
+                   help="global remediation budget shared by all "
+                        "autopilot policies (the --max_finetunes "
+                        "pattern generalized): once spent, further "
+                        "qualifying firings get explicit "
+                        "suppressed_budget records and the plain alert "
+                        "stands")
     p.add_argument("--postmortem_dir", type=str, default=None,
                    help="arm the alert-triggered flight recorder: keep "
                         "a bounded in-memory ring of the last "
@@ -813,6 +843,18 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.serve.trace_sample_rate = args.trace_sample_rate
     cfg.postmortem_dir = args.postmortem_dir
     cfg.flightrec_size = args.flightrec_size
+    cfg.autopilot.enabled = args.autopilot
+    cfg.autopilot.policies = args.autopilot_policies
+    cfg.autopilot.budget = args.autopilot_budget
+    if args.autopilot_policies:
+        # Same policy as the --alert_rules pre-parse above: a typo'd
+        # policy that silently never remediates must fail the run at
+        # flag-parse time.
+        from dml_cnn_cifar10_tpu.autopilot import parse_policies
+        try:
+            parse_policies(args.autopilot_policies)
+        except ValueError as e:
+            raise SystemExit(f"--autopilot_policies: {e}")
     cfg.runtime.jobs = args.jobs
     cfg.runtime.eval_every_s = args.runtime_eval_every_s
     cfg.runtime.eval_batches = args.runtime_eval_batches

@@ -539,6 +539,36 @@ class RuntimeConfig:
 
 
 @dataclasses.dataclass
+class AutopilotConfig:
+    """Alert-driven remediation (``--autopilot``, ``autopilot/``
+    package; docs/AUTOPILOT.md).
+
+    When enabled, an :class:`~autopilot.engine.AutopilotEngine`
+    attaches to the alert engine's trigger seam and answers every
+    emitted alert firing that matches a policy with a remediation
+    action — rollback with LR scaling, memory shrink + recompile
+    through the compile cache, fleet scale-up + tier shed, raising
+    replica_keep — each gated by a per-policy cooldown and one global
+    budget, and each recorded as a ``remediation`` JSONL record linked
+    to the firing alert's id and its postmortem bundle.
+    """
+
+    enabled: bool = False
+    # Policy table override (autopilot/engine.py grammar):
+    # ";"-separated "name=pattern[|pattern...]->action[:k=v,...]
+    # [@cooldown[s]]" where pattern fnmatches alert rule names,
+    # action is one of rollback | shrink_memory | scale_up_shed |
+    # raise_replica_keep, and @N is a step cooldown (@Ns = seconds).
+    # None/empty = the built-in default table.
+    policies: Optional[str] = None
+    # Global remediation budget shared by all policies (the
+    # --max_finetunes counter pattern generalized): once spent, every
+    # further qualifying firing is answered by an explicit
+    # suppressed_budget record and the plain alert stands.
+    budget: int = 8
+
+
+@dataclasses.dataclass
 class TrainConfig:
     """Training driver. Reference: ``cifar10cnn.py:11-14,219-242``."""
 
@@ -735,6 +765,8 @@ class TrainConfig:
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     runtime: RuntimeConfig = dataclasses.field(default_factory=RuntimeConfig)
+    autopilot: AutopilotConfig = dataclasses.field(
+        default_factory=AutopilotConfig)
 
 
 #: TrainConfig's nested dataclass fields, the single list the JSON
@@ -742,7 +774,7 @@ class TrainConfig:
 _SUBCONFIGS = {"data": DataConfig, "model": ModelConfig,
                "optim": OptimConfig, "parallel": ParallelConfig,
                "serve": ServeConfig, "fleet": FleetConfig,
-               "runtime": RuntimeConfig}
+               "runtime": RuntimeConfig, "autopilot": AutopilotConfig}
 
 
 def config_to_dict(cfg: TrainConfig) -> dict:

@@ -155,6 +155,20 @@ class FleetController:
         self.alerts = alerts_lib.AlertEngine.from_config(cfg)
         if self.alerts is not None and logger is not None:
             logger.add_observer(self.alerts.observer(logger))
+        # Alert-driven remediation (--autopilot; autopilot/engine.py):
+        # a qualifying SLO/shed alert requests an immediate scale-up —
+        # served at the NEXT tick ahead of the autoscaler's own cadence
+        # and cooldown (the autoscaler would get there too, one
+        # autoscale_every_s later; the autopilot buys back that lag and
+        # leaves the remediation lineage in the JSONL stream).
+        from dml_cnn_cifar10_tpu.autopilot.engine import AutopilotEngine
+        self._scale_up_requested: Optional[str] = None
+        self.autopilot = AutopilotEngine.from_config(
+            cfg, logger=logger)
+        if self.autopilot is not None:
+            self.autopilot.bind("scale_up", self._request_scale_up)
+            if self.alerts is not None:
+                self.autopilot.attach(self.alerts)
         self.router = Router(
             self.fleet_dir,
             dead_after_s=cfg.fleet.replica_dead_after_s,
@@ -184,6 +198,11 @@ class FleetController:
         self._last_decide = 0.0
         self._last_fleet_emit = time.time()
 
+    def _request_scale_up(self, rule_name: str) -> None:
+        """Autopilot scale_up seam: remember the request; :meth:`tick`
+        serves it ahead of the autoscaler cadence."""
+        self._scale_up_requested = rule_name
+
     # -- the control loop body (one tick, also driven by tests) --------
 
     def signals(self) -> autoscaler_lib.FleetSignals:
@@ -210,6 +229,23 @@ class FleetController:
                 self.alerts.evaluate(
                     emit=self.logger.log if self.logger is not None
                     else None)
+        requested, self._scale_up_requested = \
+            self._scale_up_requested, None
+        if requested is not None \
+                and len(self.pool.active_ids()) \
+                < self.cfg.fleet.max_replicas:
+            # Autopilot remediation: spawn now, ahead of the decide
+            # cadence; the scale record keeps the autoscaler's shape
+            # with an autopilot-attributed reason.
+            self.pool.spawn()
+            self._cooldown_until = now + self.cfg.fleet.scale_cooldown_s
+            if self.logger is not None:
+                self.logger.log("scale", action="up",
+                                reason=f"autopilot:{requested}",
+                                replicas=len(self.pool.active_ids()))
+            print(f"[fleet] scale up (autopilot:{requested}): "
+                  f"{len(self.pool.active_ids())} worker(s)")
+            return
         if now < self._cooldown_until \
                 or now - self._last_decide < self.cfg.fleet.autoscale_every_s:
             return

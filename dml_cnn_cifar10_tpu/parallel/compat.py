@@ -1,0 +1,46 @@
+"""Version-compat shims for the parallel package.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a
+top-level ``jax.shard_map`` (renaming ``check_rep`` → ``check_vma``
+along the way) across the JAX versions this repo runs under. Every
+per-device SPMD entry point (explicit-collectives data parallel, ring
+attention, Ulysses, pipeline stages) imports the one wrapper below so
+call sites use the modern spelling unconditionally and tier-1 collects
+clean on either API.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+_IMPL = getattr(jax, "shard_map", None)
+if _IMPL is None:  # pre-graduation JAX: the experimental module
+    from jax.experimental.shard_map import shard_map as _IMPL
+
+_PARAMS = inspect.signature(_IMPL).parameters
+_ACCEPTS_CHECK_VMA = "check_vma" in _PARAMS
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+    """``jax.shard_map`` where it exists; otherwise the experimental
+    one with ``check_vma`` translated back to ``check_rep``."""
+    if _ACCEPTS_CHECK_VMA:
+        kwargs["check_vma"] = check_vma
+    elif "check_rep" in _PARAMS:
+        kwargs["check_rep"] = check_vma
+    return _IMPL(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                 **kwargs)
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` where it exists; otherwise the static size from
+    the tracing axis env (an int — constant-folds, no collective)."""
+    from jax import lax
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    import jax.core as core
+    frame = core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size

@@ -51,6 +51,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dml_cnn_cifar10_tpu.parallel import compat
+from dml_cnn_cifar10_tpu.parallel.compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -185,10 +188,9 @@ def _window_switch(src, my, causal, diag, left, right, skip):
 # ---------------------------------------------------------------------------
 
 
-def _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas, causal,
+def _ring_fwd_scan(q, k, v, seg, my, axis_name, scale, use_pallas, causal,
                    window=None):
-    nsteps = lax.axis_size(axis_name)
-    my = lax.axis_index(axis_name)
+    nsteps = compat.axis_size(axis_name)
     b, sq, h, d = q.shape
     stats = _block_stats_pallas if use_pallas else _block_stats
     perm = _ring_perm(nsteps)
@@ -253,26 +255,30 @@ def _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas, causal,
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _ring_core(q, k, v, seg, axis_name, scale, use_pallas, causal, window):
-    out, _ = _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas,
+# ``my`` (this device's ring position, ``lax.axis_index``) is computed by
+# the caller and passed through as a traced argument: a partition-id op
+# inside the custom_vjp closed-call body lands outside the SPMD manual
+# section on older JAX and fails to partition.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _ring_core(q, k, v, seg, my, axis_name, scale, use_pallas, causal,
+               window):
+    out, _ = _ring_fwd_scan(q, k, v, seg, my, axis_name, scale, use_pallas,
                             causal, window=window)
     return out
 
 
-def _ring_core_fwd(q, k, v, seg, axis_name, scale, use_pallas, causal,
+def _ring_core_fwd(q, k, v, seg, my, axis_name, scale, use_pallas, causal,
                    window):
-    out, lse = _ring_fwd_scan(q, k, v, seg, axis_name, scale, use_pallas,
-                              causal, window=window)
-    return out, (q, k, v, seg, out, lse)
+    out, lse = _ring_fwd_scan(q, k, v, seg, my, axis_name, scale,
+                              use_pallas, causal, window=window)
+    return out, (q, k, v, seg, my, out, lse)
 
 
 def _ring_core_bwd(axis_name, scale, use_pallas, causal, window, res, do):
     from dml_cnn_cifar10_tpu.ops import flash_attention as fa
 
-    q, k, v, seg, out, lse = res
-    nsteps = lax.axis_size(axis_name)
-    my = lax.axis_index(axis_name)
+    q, k, v, seg, my, out, lse = res
+    nsteps = compat.axis_size(axis_name)
     delta = fa.attention_delta(out, do)               # [B,Sq,H] f32
     perm = _ring_perm(nsteps)
 
@@ -336,8 +342,9 @@ def _ring_core_bwd(axis_name, scale, use_pallas, causal, window, res, do):
         body, (k, v, seg, dk0, dv0, dq0), jnp.arange(nsteps))
     dseg = jax.tree.map(
         lambda s: np.zeros(s.shape, jax.dtypes.float0), seg)
+    dmy = np.zeros((), jax.dtypes.float0)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            dseg)
+            dseg, dmy)
 
 
 _ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
@@ -348,7 +355,8 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                          use_pallas: bool = False,
                          causal: bool = False,
                          segment_ids: Optional[jax.Array] = None,
-                         window: Optional[int] = None
+                         window: Optional[int] = None,
+                         my: Optional[jax.Array] = None
                          ) -> jax.Array:
     """Per-device body: runs under ``shard_map`` with Q/K/V sequence-sharded
     on ``axis_name``. Shapes [B, S_local, H, D] → [B, S_local, H, D].
@@ -372,7 +380,10 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
             f"{q.shape[1]}; the ring dispatch only visits adjacent "
             f"shards. Use fewer seq-axis devices (longer shards) or a "
             f"smaller window.")
-    return _ring_core(q, k, v, segment_ids, axis_name, float(scale),
+    if my is None:
+        my = lax.axis_index(axis_name)
+    return _ring_core(q, k, v, segment_ids, my,
+                      axis_name, float(scale),
                       bool(use_pallas and q.shape[1] >= 128), bool(causal),
                       None if window is None else int(window))
 
@@ -401,16 +412,19 @@ def sp_partition_spec(mesh: Mesh, axis_name: str, seq_len: int,
 
 
 def sp_shard_map(local_fn, mesh: Mesh, axis_name: str, seq_len: int,
-                 num_heads: int, with_segments: bool = False):
+                 num_heads: int, with_segments: bool = False,
+                 extra_in_specs=()):
     """Wrap an SP-local attention body in the standard shard_map: one
     ``(q, k, v[, segment_ids]) -> out`` callable with all tensors laid
     out per :func:`sp_partition_spec` (segment ids, when present, shard
-    ``[B, S]`` as ``(data, axis_name)`` — the same sequence split)."""
+    ``[B, S]`` as ``(data, axis_name)`` — the same sequence split).
+    ``extra_in_specs`` appends specs for trailing positional inputs."""
     spec, _ = sp_partition_spec(mesh, axis_name, seq_len, num_heads)
     in_specs = (spec, spec, spec)
     if with_segments:
         in_specs += (P("data", axis_name),)
-    return jax.shard_map(
+    in_specs += tuple(extra_in_specs)
+    return shard_map(
         local_fn,
         mesh=mesh,
         in_specs=in_specs,
@@ -439,15 +453,24 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     """
     kw = dict(axis_name=axis_name, scale=scale, use_pallas=use_pallas,
               causal=causal, window=window)
+    # The ring position rides in as a sequence-sharded iota (each
+    # device's shard IS its index) instead of ``lax.axis_index``: a
+    # partition-id op inside the body fails SPMD partitioning under an
+    # outer jit on older JAX (it lands in a non-inlined called
+    # computation).
+    pos = jnp.arange(mesh.shape[axis_name], dtype=jnp.int32)
     if segment_ids is None:
-        local = functools.partial(ring_attention_local, **kw)
-        args = (q, k, v)
+        def local(q, k, v, pos):
+            return ring_attention_local(q, k, v, my=pos[0], **kw)
+        args = (q, k, v, pos)
     else:
-        def local(q, k, v, seg):
-            return ring_attention_local(q, k, v, segment_ids=seg, **kw)
-        args = (q, k, v, segment_ids.astype(jnp.int32))
+        def local(q, k, v, seg, pos):
+            return ring_attention_local(q, k, v, segment_ids=seg,
+                                        my=pos[0], **kw)
+        args = (q, k, v, segment_ids.astype(jnp.int32), pos)
     fn = sp_shard_map(local, mesh, axis_name, q.shape[1], q.shape[2],
-                      with_segments=segment_ids is not None)
+                      with_segments=segment_ids is not None,
+                      extra_in_specs=(P(axis_name),))
     return fn(*args)
 
 

@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dml_cnn_cifar10_tpu.parallel.compat import shard_map
+
 from dml_cnn_cifar10_tpu.compilecache import mesh_context
 from dml_cnn_cifar10_tpu.compilecache import wrap as _cc_wrap
 from dml_cnn_cifar10_tpu.config import DataConfig, ModelConfig, OptimConfig
@@ -1029,7 +1031,7 @@ def _make_explicit_train_step(model_def, model_cfg, optim_cfg, mesh: Mesh,
         return (TrainState(new_params, new_opt, new_model_state),
                 {"loss": loss, "accuracy": acc, **stats})
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P("data"), P("data")),

@@ -45,6 +45,8 @@ import threading
 import time
 from typing import Optional
 
+from dml_cnn_cifar10_tpu.autopilot.engine import (AutopilotEngine,
+                                                  RemediationBudget)
 from dml_cnn_cifar10_tpu.config import TrainConfig
 from dml_cnn_cifar10_tpu.models import get_model
 from dml_cnn_cifar10_tpu.parallel import mesh as mesh_lib
@@ -85,6 +87,19 @@ class Runtime:
         if self.alerts is not None:
             self.logger.add_observer(self.alerts.observer(self.logger))
             self.alerts.add_trigger(self._on_alert)
+        #: the live ServeJob's MicroBatcher, while one runs — the
+        #: autopilot's shed_tier action reaches tier-by-tenant shedding
+        #: through it (runtime/jobs.py sets/clears it).
+        self.batcher = None
+        # Alert-driven remediation (--autopilot; autopilot/engine.py):
+        # one engine for the whole runtime, shared with every
+        # supervised TrainJob attempt, with the serve shed seam bound.
+        self.autopilot = AutopilotEngine.from_config(
+            cfg, logger=self.logger, flightrec=self.flightrec)
+        if self.autopilot is not None:
+            self.autopilot.bind("shed_tier", self._shed_tier)
+            if self.alerts is not None:
+                self.autopilot.attach(self.alerts)
         # ONE registry, ONE stats bind for the whole process: every
         # Trainer/job repeats this call and gets the same server back
         # (ensure_stats_server is idempotent under its process lock).
@@ -109,8 +124,11 @@ class Runtime:
         self.publisher_job = "train"
         self.serve_port: Optional[int] = None
         self._pub_seq = 0
-        self._finetunes = 0
-        self._ft_lock = threading.Lock()
+        # The --max_finetunes counter, generalized: one RemediationBudget
+        # (autopilot/engine.py) gates the alert->FineTuneJob loop —
+        # same thread-safe charge/spent semantics the autopilot's
+        # action budget uses.
+        self.ft_budget = RemediationBudget(cfg.runtime.max_finetunes)
         self.state_path = cfg.runtime.state_path or os.path.join(
             cfg.log_dir, "runtime.json")
 
@@ -180,11 +198,9 @@ class Runtime:
                        if n.strip()}
             if rule.name not in allowed:
                 return
-        with self._ft_lock:
-            if self._finetunes >= rtc.max_finetunes:
-                return
-            self._finetunes += 1
-            n = self._finetunes
+        if not self.ft_budget.try_charge("finetune"):
+            return
+        n = self.ft_budget.spent
         from dml_cnn_cifar10_tpu.runtime.jobs import FineTuneJob
         job = FineTuneJob(rtc.finetune_steps, trigger=rule.name,
                           name=f"finetune-{n}")
@@ -192,6 +208,16 @@ class Runtime:
               f"{job.name} (+{rtc.finetune_steps} steps, "
               f"{n}/{rtc.max_finetunes})")
         self.scheduler.submit(job)
+
+    def _shed_tier(self, tier: int) -> None:
+        """Autopilot shed seam: turn on tier-by-tenant admission
+        shedding on the live serve batcher. No serve job running means
+        there is nothing to shed — raising lets the engine record the
+        action as ``failed`` (fail-open: the plain alert stands)."""
+        b = self.batcher
+        if b is None:
+            raise RuntimeError("no live serve batcher to shed")
+        b.set_shed_tier(int(tier))
 
     # -- advertised state ------------------------------------------------
 

@@ -186,7 +186,7 @@ class TrainJob(Job):
                     task_index=rt.task_index, logger=rt.logger,
                     alert_engine=rt.alerts,
                     flight_recorder=rt.flightrec, mesh=rt.mesh,
-                    publish_hook=rt.publish)
+                    publish_hook=rt.publish, autopilot=rt.autopilot)
             else:
                 from dml_cnn_cifar10_tpu.train.loop import Trainer
                 trainer = Trainer(rt.cfg, mesh=rt.mesh,
@@ -274,6 +274,9 @@ class ServeJob(Job):
                                 if serve_cfg.deadline_ms else None),
             metrics=metrics, warmup=rt.cfg.runtime.serve_warmup,
             logger=rt.logger)
+        # Advertise the live batcher on the runtime: the autopilot's
+        # shed_tier action reaches tier-by-tenant shedding through it.
+        rt.batcher = batcher
         server = ThreadingHTTPServer(
             ("", serve_cfg.port),
             _make_handler(batcher, metrics, replica_id=rt.task_index,
@@ -297,6 +300,7 @@ class ServeJob(Job):
             accept.join()
             drained = batcher.drain(timeout=serve_cfg.drain_deadline_s)
         finally:
+            rt.batcher = None
             server.server_close()
             flusher.stop()
             if batcher._worker.is_alive():

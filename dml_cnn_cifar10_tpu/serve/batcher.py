@@ -73,14 +73,17 @@ def _versioned_row(row, version) -> VersionedLogits:
 
 
 class _Request:
-    __slots__ = ("image", "future", "t_enqueue", "deadline", "trace")
+    __slots__ = ("image", "future", "t_enqueue", "deadline", "trace",
+                 "tier")
 
-    def __init__(self, image, future, t_enqueue, deadline, trace=None):
+    def __init__(self, image, future, t_enqueue, deadline, trace=None,
+                 tier=0):
         self.image = image
         self.future = future
         self.t_enqueue = t_enqueue
         self.deadline = deadline
         self.trace = trace
+        self.tier = tier
 
 
 class MicroBatcher:
@@ -115,6 +118,9 @@ class MicroBatcher:
         self._q: "queue.Queue[_Request]" = queue.Queue(
             maxsize=int(max_queue_depth))
         self._stop = threading.Event()
+        # Tier-by-tenant load shedding (the autopilot's scale_up_shed
+        # action flips this): None = admit every tier.
+        self._shed_tier: Optional[int] = None
         if warmup:
             self.compile_secs = engine.warmup(self.buckets)
         else:
@@ -127,12 +133,16 @@ class MicroBatcher:
 
     def submit(self, image: np.ndarray,
                deadline_s: Optional[float] = None,
-               trace: Optional[reqtrace.TraceContext] = None) -> Future:
+               trace: Optional[reqtrace.TraceContext] = None,
+               tier: int = 0) -> Future:
         """Enqueue one ``uint8 [H, W, C]`` image; returns a Future of
         its ``[K]`` logits row. Raises :class:`ShedError` immediately
-        when the queue is at depth (admission control) or the server is
-        stopping. ``trace`` is the request's trace context; sheds force
-        it so the interesting requests appear even at sample rate 0."""
+        when the queue is at depth (admission control), the request's
+        ``tier`` is being shed (:meth:`set_shed_tier`), or the server
+        is stopping. ``trace`` is the request's trace context; sheds
+        force it so the interesting requests appear even at sample
+        rate 0. ``tier`` 0 is the premium tenant class; higher tiers
+        are more sheddable."""
         image = np.asarray(image)
         if image.shape != self.engine.image_shape \
                 or image.dtype != np.uint8:
@@ -142,9 +152,19 @@ class MicroBatcher:
         if self._stop.is_set():
             raise ShedError("shutdown")
         now = time.perf_counter()
+        shed_at = self._shed_tier
+        if shed_at is not None and int(tier) >= shed_at:
+            self.metrics.record_shed("tier")
+            if trace is not None:
+                trace.force()
+                reqtrace.emit_span(self.logger, trace, "batcher", 0.0,
+                                   reqtrace.wallclock_at(now),
+                                   shed="tier")
+            raise ShedError("tier")
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
         req = _Request(image, Future(), now,
-                       None if dl is None else now + dl, trace)
+                       None if dl is None else now + dl, trace,
+                       tier=int(tier))
         try:
             self._q.put_nowait(req)
         except queue.Full:
@@ -157,6 +177,18 @@ class MicroBatcher:
             raise ShedError("queue_full") from None
         self.metrics.record_submit()
         return req.future
+
+    def set_shed_tier(self, tier: Optional[int]) -> None:
+        """Tier-by-tenant load shedding: admission-reject every request
+        whose ``tier`` is >= ``tier`` (so ``1`` sheds all best-effort
+        traffic while tier-0 premium requests keep flowing). ``None``
+        disables. The autopilot's ``scale_up_shed`` action is the
+        canonical caller; thread-safe (a single attribute write)."""
+        self._shed_tier = None if tier is None else int(tier)
+
+    def shed_tier(self) -> Optional[int]:
+        """The active shed threshold, or None when every tier admits."""
+        return self._shed_tier
 
     def queue_depth(self) -> int:
         """Requests currently waiting (approximate — the queue is live).

@@ -111,9 +111,17 @@ def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics,
             # a deadline shed there forces this hop's span too.
             ctx = reqtrace.parse(self.headers.get(reqtrace.TRACE_HEADER),
                                  sample_rate)
+            # Tenant tier (X-Tier header; 0 = premium, higher = more
+            # sheddable): under autopilot tier-shedding, best-effort
+            # tiers get an immediate 503 while tier-0 keeps flowing.
+            try:
+                tier = int(self.headers.get("X-Tier", 0))
+            except ValueError:
+                tier = 0
             t0 = time.perf_counter()
             try:
-                logits = batcher.submit(image, trace=ctx).result()
+                logits = batcher.submit(image, trace=ctx,
+                                        tier=tier).result()
             except ShedError as e:
                 reqtrace.emit_span(logger, ctx, hop,
                                    time.perf_counter() - t0,

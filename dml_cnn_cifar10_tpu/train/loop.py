@@ -65,9 +65,15 @@ class TrainResult:
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None, task_index: int = 0,
                  fault_injector=None, cluster=None, alert_engine=None,
-                 flight_recorder=None, logger=None, publish_hook=None):
+                 flight_recorder=None, logger=None, publish_hook=None,
+                 autopilot=None):
         self.cfg = cfg
         self.task_index = task_index
+        # Alert-driven remediation (autopilot/engine.py): injected by
+        # the supervisor/runtime only — a restart request needs a
+        # supervisor above this Trainer to catch it, so a bare Trainer
+        # never builds its own engine.
+        self.autopilot = autopilot
         if cfg.on_nonfinite not in ("halt", "skip", "rollback"):
             raise ValueError(
                 f"on_nonfinite={cfg.on_nonfinite!r} must be one of "
@@ -782,6 +788,18 @@ class Trainer:
                         flight_win = devwin is not None
                     if devwin is not None:
                         devwin.maybe_start(global_step)
+                    if self.autopilot is not None:
+                        # Autopilot restart seam: a remediation action
+                        # that changed the step geometry (shrink) asks
+                        # for a restart here, BEFORE the cluster beat
+                        # and the data draw — the supervisor restores
+                        # the newest checkpoint and rebuilds the step
+                        # through the compile cache with the new config.
+                        reason = self.autopilot.poll_restart()
+                        if reason is not None:
+                            from dml_cnn_cifar10_tpu.autopilot.engine \
+                                import RemediationRestartError
+                            raise RemediationRestartError(reason)
                     if self.cluster is not None:
                         # Dispatch-seam liveness (parallel/cluster.py):
                         # publish a beat, check for eviction, arm the

@@ -69,6 +69,8 @@ from __future__ import annotations
 import time
 from typing import Optional
 
+from dml_cnn_cifar10_tpu.autopilot.engine import (AutopilotEngine,
+                                                  RemediationRestartError)
 from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
 from dml_cnn_cifar10_tpu.config import TrainConfig
 from dml_cnn_cifar10_tpu.data.pipeline import DataPipelineError
@@ -79,9 +81,11 @@ from dml_cnn_cifar10_tpu.utils import faults as faults_lib
 from dml_cnn_cifar10_tpu.utils import flightrec as flightrec_lib
 from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
 
-#: Failure classes the supervisor may retry.
+#: Failure classes the supervisor may retry. "remediation" is not a
+#: failure at all: an autopilot action changed the step geometry and
+#: requested a restore+rebuild — it never charges the retry budget.
 RECOVERABLE_FAULTS = ("nonfinite", "data", "ckpt_restore", "peer_lost",
-                      "peer_rejoin")
+                      "peer_rejoin", "remediation")
 
 
 def classify_failure(exc: BaseException) -> Optional[str]:
@@ -96,7 +100,12 @@ def classify_failure(exc: BaseException) -> Optional[str]:
       (recoverable by coordinated world-shrink, not by plain retry)
     - a returning host announced rejoin → ``"peer_rejoin"``
       (recoverable by coordinated world-expand — chief seat only)
+    - an autopilot remediation restart request → ``"remediation"``
+      (deliberate restore+rebuild after a config change; never charges
+      the retry budget)
     """
+    if isinstance(exc, RemediationRestartError):
+        return "remediation"
     if isinstance(exc, cluster_lib.PeerRejoinError):
         return "peer_rejoin"
     if isinstance(exc, cluster_lib.PeerLostError):
@@ -256,7 +265,8 @@ def _request_rejoin(cfg: TrainConfig, monitor, logger, attempt: int,
 
 def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                    task_index: int = 0, logger=None, alert_engine=None,
-                   flight_recorder=None, mesh=None, publish_hook=None):
+                   flight_recorder=None, mesh=None, publish_hook=None,
+                   autopilot=None):
     """``Trainer.fit`` under the recovery supervisor; returns the final
     :class:`TrainResult`. Unrecoverable failures — and recoverable ones
     past the ``recovery_retries`` budget — re-raise unchanged. A
@@ -300,6 +310,16 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
         alert_engine = alerts_lib.AlertEngine.from_config(cfg)
     if alert_engine is not None:
         logger.add_observer(alert_engine.observer(logger))
+    # ONE autopilot engine across attempts too (cooldown marks, the
+    # remediation budget, and pending-restart state span restarts).
+    # The runtime injects its own (with serve/fleet hooks bound); a
+    # bare supervised run builds one from --autopilot. attach() is
+    # idempotent, so an injected pre-attached engine is fine.
+    if autopilot is None:
+        autopilot = AutopilotEngine.from_config(cfg, logger=logger,
+                                                flightrec=flightrec)
+    if autopilot is not None and alert_engine is not None:
+        autopilot.attach(alert_engine)
     attempt = 0
     # Progress-based retry-budget reset (--retry_budget_window): the
     # newest checkpoint step at the time the budget was last charged.
@@ -313,7 +333,8 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                               fault_injector=injector, cluster=monitor,
                               alert_engine=alert_engine,
                               flight_recorder=flightrec, logger=logger,
-                              publish_hook=publish_hook)
+                              publish_hook=publish_hook,
+                              autopilot=autopilot)
             try:
                 result = trainer.fit(total_steps)
             except cluster_lib.EvictedError as e:
@@ -366,6 +387,19 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                           f"{cfg.retry_budget_window}): retry budget "
                           f"reset")
                     attempt = 0
+                if fault == "remediation":
+                    # Deliberate autopilot restore+rebuild, not a
+                    # failure: no retry-budget charge, no backoff, no
+                    # recovery-phase injection arming — restore the
+                    # newest checkpoint and re-enter with the mutated
+                    # config (the compile cache absorbs the rebuild).
+                    restore_step = _newest_restore_step(cfg)
+                    logger.log("recovery", step=restore_step,
+                               fault=fault, action="restart",
+                               attempt=attempt, backoff_s=0.0)
+                    print(f"[supervisor] remediation restart: {e}; "
+                          f"restoring from step {restore_step}")
+                    continue
                 if attempt >= cfg.recovery_retries:
                     raise
                 attempt += 1
@@ -410,7 +444,14 @@ def fit_supervised(cfg: TrainConfig, total_steps: Optional[int] = None,
                                             attempt)
                 logger.log("fault", step=restore_step, fault=fault,
                            injected=False, error=str(e)[:300])
-                if fault == "nonfinite" and cfg.rollback_lr_scale != 1.0:
+                if fault == "nonfinite" and cfg.rollback_lr_scale != 1.0 \
+                        and not (autopilot is not None and autopilot
+                                 .handles("nonfinite_burst", "rollback")):
+                    # When an autopilot rollback policy owns
+                    # nonfinite_burst, the LR scale is applied by its
+                    # action (inside the `fault` emission above, at
+                    # alert-firing pace) — scaling here too would
+                    # double-apply it.
                     cfg.optim.learning_rate *= cfg.rollback_lr_scale
                 if fault == "nonfinite":
                     logger.log("rollback", step=restore_step,

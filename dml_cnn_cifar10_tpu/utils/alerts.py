@@ -25,8 +25,10 @@ Three rule shapes cover the SLO vocabulary:
   after the first one: a run that never heartbeats is not stale, it is
   simply not clustered).
 
-Firing emits an ``alert`` JSONL record (rule, severity, window, value)
-and recovery a paired ``alert_resolved`` — rate-limited per rule
+Firing emits an ``alert`` JSONL record (rule, severity, window, value,
+id — a monotonic ``rule#N`` stamped on the firing, its resolution, and
+any remediation it triggers) and recovery a paired ``alert_resolved``
+carrying the same id — rate-limited per rule
 (``min_interval_s``) so a flapping signal cannot flood the stream: a
 suppressed re-fire also suppresses its resolution, keeping the emitted
 records strictly paired. Active state is exported live as the
@@ -300,7 +302,8 @@ def _derive(kind: str, fields: dict, state: dict) -> dict:
 
 class _RuleState:
     __slots__ = ("active", "emitted", "consecutive", "events",
-                 "last_seen", "last_emit_t", "value", "since_t")
+                 "last_seen", "last_emit_t", "value", "since_t",
+                 "alert_id")
 
     def __init__(self):
         self.active = False
@@ -311,6 +314,7 @@ class _RuleState:
         self.last_emit_t: Optional[float] = None
         self.value: Optional[float] = None
         self.since_t: Optional[float] = None
+        self.alert_id: Optional[str] = None      # last EMITTED firing
 
 
 class AlertEngine:
@@ -340,11 +344,18 @@ class AlertEngine:
         self._states = {r.name: _RuleState() for r in self.rules}
         self._derive_state: dict = {}
         self._max_step: Optional[float] = None
+        # Monotonic id sequence: every EMITTED firing gets a unique
+        # ``rule#N`` id, stamped on the alert record, its paired
+        # resolution, and everything downstream (remediation records,
+        # postmortem lineage). Deterministic under replay.
+        self._emit_seq = 0
         # Alert→action trigger hooks: each fires once per EMITTED alert
         # firing (never for suppressed re-fires — they add nothing to
         # the pending list — and never for resolutions). The runtime's
-        # alert→FineTuneJob control loop rides this seam.
-        self._triggers: List[Callable] = []
+        # alert→FineTuneJob control loop and the autopilot policy
+        # engine ride this seam. Stored as (fn, wants_meta) — a 3-arg
+        # hook also receives {"id", "step", "severity"}.
+        self._triggers: List[tuple] = []
         # observer() adapters keyed by the logger they wrap, so a shared
         # logger re-attaching the engine gets the SAME callable back and
         # MetricsLogger.add_observer's identity check keeps it single.
@@ -464,38 +475,75 @@ class AlertEngine:
             return
         st.emitted = True
         st.last_emit_t = now
-        pending.append(("alert", rule, value))
+        self._emit_seq += 1
+        st.alert_id = f"{rule.name}#{self._emit_seq}"
+        pending.append(("alert", rule, value, st.alert_id,
+                        self._max_step))
 
     def _resolve(self, rule, st, value, now, pending) -> None:
         st.active = False
         st.consecutive = 0
         if st.emitted:
             st.emitted = False
-            pending.append(("alert_resolved", rule, value))
+            pending.append(("alert_resolved", rule, value, st.alert_id,
+                            self._max_step))
 
     def _emit_all(self, pending, emit) -> None:
-        for record_kind, rule, value in pending:
+        for record_kind, rule, value, alert_id, step in pending:
             if emit is not None:
                 emit(record_kind, rule=rule.name, severity=rule.severity,
-                     window=rule.window_str(), value=value)
+                     window=rule.window_str(), value=value, id=alert_id)
             if record_kind != "alert":
                 continue  # resolutions never trigger actions
-            for fn in list(self._triggers):
+            meta = {"id": alert_id, "step": step,
+                    "severity": rule.severity}
+            for fn, wants_meta in list(self._triggers):
                 try:
-                    fn(rule, value)
+                    if wants_meta:
+                        fn(rule, value, meta)
+                    else:
+                        fn(rule, value)
                 except Exception as e:  # fail-open like logger observers
                     print(f"[alerts] trigger hook failed for "
                           f"{rule.name!r}: {e!r}", flush=True)
 
     def add_trigger(self, fn: Callable) -> None:
-        """Attach ``fn(rule, value)``, called once per EMITTED ``alert``
+        """Attach ``fn(rule, value)`` — or ``fn(rule, value, meta)``,
+        detected by signature, where ``meta`` carries the firing's
+        ``id``/``step``/``severity`` — called once per EMITTED ``alert``
         firing (outside the engine lock, after the record is emitted).
         Suppressed re-fires inside the rate-limit window and
         ``alert_resolved`` transitions never call it. Idempotent by
         identity; exceptions are swallowed (an action hook must never
         take down the metrics path)."""
-        if fn not in self._triggers:
-            self._triggers.append(fn)
+        import inspect
+        if any(fn is f for f, _ in self._triggers):
+            return
+        try:
+            params = inspect.signature(fn).parameters.values()
+            npos = sum(p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD)
+                       for p in params)
+            wants_meta = npos >= 3 or any(
+                p.kind == p.VAR_POSITIONAL for p in params)
+        except (TypeError, ValueError):
+            wants_meta = False
+        self._triggers.append((fn, wants_meta))
+
+    def add_rules(self, rules: List[AlertRule]) -> None:
+        """Register additional rules on a live engine (the autopilot
+        injects pattern rules its policies need — e.g. a peer-churn
+        rate rule with no built-in). Name collisions raise, same as the
+        constructor."""
+        with self._lock:
+            existing = {r.name for r in self.rules}
+            for rule in rules:
+                if rule.name in existing:
+                    raise ValueError(
+                        f"alert rule {rule.name!r} already defined")
+                existing.add(rule.name)
+                self.rules.append(rule)
+                self._states[rule.name] = _RuleState()
 
     # -- consumers --------------------------------------------------------
 
@@ -505,7 +553,8 @@ class AlertEngine:
         with self._lock:
             return [{"rule": r.name, "severity": r.severity,
                      "value": self._states[r.name].value,
-                     "since_t": self._states[r.name].since_t}
+                     "since_t": self._states[r.name].since_t,
+                     "id": self._states[r.name].alert_id}
                     for r in self.rules if self._states[r.name].active]
 
     def active_names(self) -> List[str]:

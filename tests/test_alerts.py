@@ -131,7 +131,8 @@ def test_builtin_rule_decision_table(rule, unhealthy, healthy,
     assert fired == [rule], (rule, sink.records)
     assert eng.active_names() == [rule]
     rec = sink.last()[1]
-    assert set(rec) == {"rule", "severity", "window", "value"}
+    assert set(rec) == {"rule", "severity", "window", "value", "id"}
+    assert rec["id"] == f"{rule}#1"
 
     # Healthy stream: silence.
     sink2 = _Sink()
@@ -479,3 +480,23 @@ def test_trigger_fail_open_and_identity_dedup():
     eng.observe("train", {"step": 1, "loss": 50.0}, emit=sink, now=0.0)
     assert calls == ["lossy"]                 # once, not twice
     assert sink.kinds() == ["alert"]          # record still emitted
+
+
+def test_trigger_meta_carries_id_step_severity():
+    """A 3-arg hook (the autopilot engine's shape) additionally gets
+    the firing's meta — the same monotonic ``rule#N`` id stamped on
+    the emitted record, the newest step, and the rule severity — so
+    downstream remediation records can link back to the alert."""
+    eng = AlertEngine(parse_alert_rules("lossy=train.loss>10!page"),
+                      min_interval_s=0.0)
+    seen = []
+    eng.add_trigger(lambda rule, value, meta: seen.append(meta))
+    sink = _Sink()
+    eng.observe("train", {"step": 7, "loss": 50.0}, emit=sink, now=0.0)
+    (meta,) = seen
+    assert meta["id"] == sink.records[0][1]["id"] == "lossy#1"
+    assert meta["step"] == 7 and meta["severity"] == "page"
+    # A fresh firing gets a fresh id in both places.
+    eng.observe("train", {"step": 8, "loss": 1.0}, emit=sink, now=1.0)
+    eng.observe("train", {"step": 9, "loss": 60.0}, emit=sink, now=2.0)
+    assert seen[1]["id"] == "lossy#2" == sink.records[-1][1]["id"]

@@ -1,0 +1,414 @@
+"""The --autopilot remediation policy engine (autopilot/engine.py):
+the --autopilot_policies grammar, the RemediationBudget, step-based
+cooldown determinism, fail-open action failures, the trigger-seam
+contract (one remediation record per matching policy per EMITTED
+firing — never for suppressed re-fires or resolutions), idempotent
+attach — and the tier-1 acceptance smoke: a supervised sim with
+``nan@15`` plus an HBM-shaped custom rule, where every qualifying
+firing is answered by exactly ONE ``remediation`` record linked to the
+alert's id and its postmortem bundle, the run completes bit-identical
+to the fault-free reference, and the stream passes strict lint."""
+
+import hashlib
+import json
+import os
+
+import pytest
+
+from dml_cnn_cifar10_tpu.autopilot import (
+    ACTIONS,
+    AutopilotEngine,
+    RemediationBudget,
+    RemediationPolicy,
+    default_policies,
+    parse_policies,
+    required_extra_rules,
+)
+from dml_cnn_cifar10_tpu.utils.alerts import (
+    AlertEngine,
+    parse_alert_rules,
+)
+
+
+class _Sink:
+    def __init__(self):
+        self.records = []
+
+    def __call__(self, kind, **fields):
+        self.records.append((kind, fields))
+
+    def kinds(self):
+        return [k for k, _ in self.records]
+
+
+class _Ns:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class _Cfg:
+    """The cfg surface the engine's actions mutate."""
+
+    def __init__(self):
+        self.rollback_lr_scale = 0.5
+        self.on_nonfinite = "halt"
+        self.steps_per_dispatch = 4
+        self.batch_size = 32
+        self.optim = _Ns(learning_rate=0.05)
+        self.parallel = _Ns(replica_keep=2)
+
+
+class _Rule:
+    def __init__(self, name):
+        self.name = name
+
+
+# ---------------------------------------------------------------------------
+# the --autopilot_policies grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_policies_full_grammar():
+    got = parse_policies(
+        "roll=nonfinite_burst->rollback:lr_scale=0.25@50;"
+        "shed=serve_*|fleet_shed->scale_up_shed:tier=2@60s")
+    assert [p.name for p in got] == ["roll", "shed"]
+    assert got[0].rules == ("nonfinite_burst",)
+    assert got[0].action == "rollback"
+    assert got[0].params == {"lr_scale": 0.25}
+    assert (got[0].cooldown, got[0].cooldown_unit) == (50.0, "steps")
+    assert got[1].rules == ("serve_*", "fleet_shed")
+    assert (got[1].cooldown, got[1].cooldown_unit) == (60.0, "seconds")
+    assert got[1].matches("serve_p99_slo") and got[1].matches("fleet_shed")
+    assert not got[1].matches("nonfinite_burst")
+
+
+def test_parse_policies_empty_and_defaults():
+    assert parse_policies(None) == []
+    assert parse_policies("") == []
+    # Every default maps to a known action and carries a cooldown.
+    for p in default_policies():
+        assert p.action in ACTIONS and p.cooldown > 0
+
+
+@pytest.mark.parametrize("bad", [
+    "noarrow=nonfinite_burst@50",
+    "x=->rollback",
+    "x=a->not_an_action",
+    "x=a->rollback:lr_scale=fast",
+    "=a->rollback",
+    "x=a->rollback;x=b->rollback",           # duplicate names
+])
+def test_parse_policies_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_policies(bad)
+
+
+def test_required_extra_rules_only_when_matched():
+    assert required_extra_rules(
+        parse_policies("r=nonfinite_burst->rollback")) == []
+    (rule,) = required_extra_rules(default_policies())
+    assert rule.name == "peer_churn" and rule.match == {
+        "fault": "peer_lost"}
+
+
+# ---------------------------------------------------------------------------
+# budget
+# ---------------------------------------------------------------------------
+
+def test_budget_charge_refund_per_policy():
+    b = RemediationBudget(2)
+    assert b.try_charge("a") and b.try_charge("b")
+    assert not b.try_charge("a")             # spent
+    assert (b.spent, b.remaining()) == (2, 0)
+    b.refund("a")
+    assert b.per_policy == {"a": 0, "b": 1}
+    assert b.try_charge("c") and not b.try_charge("c")
+
+
+# ---------------------------------------------------------------------------
+# engine decisions: cooldown, budget, fail-open, actions
+# ---------------------------------------------------------------------------
+
+def _fire(engine, rule_name, step, value=1.0, alert_id=None):
+    engine.on_alert(_Rule(rule_name), value,
+                    {"id": alert_id or f"{rule_name}#{step}",
+                     "step": step, "severity": "page"})
+
+
+def test_rollback_applies_lr_scale_then_step_cooldown():
+    cfg = _Cfg()
+    eng = AutopilotEngine(cfg, policies=parse_policies(
+        "roll=nonfinite_burst->rollback@50"), budget=8)
+    _fire(eng, "nonfinite_burst", step=20)
+    assert cfg.on_nonfinite == "rollback"
+    assert cfg.optim.learning_rate == pytest.approx(0.025)
+    # A second firing 30 steps later is inside the 50-step cooldown:
+    # explicit suppression record, NO second LR scale.
+    _fire(eng, "nonfinite_burst", step=50)
+    assert cfg.optim.learning_rate == pytest.approx(0.025)
+    # Past the cooldown the policy acts again.
+    _fire(eng, "nonfinite_burst", step=80)
+    assert cfg.optim.learning_rate == pytest.approx(0.0125)
+    assert [r["status"] for r in eng.history] == [
+        "applied", "suppressed_cooldown", "applied"]
+    assert "remaining" in eng.history[1]["detail"]
+
+
+def test_budget_exhaustion_emits_explicit_suppression():
+    eng = AutopilotEngine(_Cfg(), policies=parse_policies(
+        "roll=nonfinite_burst->rollback"), budget=1)
+    _fire(eng, "nonfinite_burst", step=10)
+    _fire(eng, "nonfinite_burst", step=20)   # no cooldown configured
+    assert [r["status"] for r in eng.history] == [
+        "applied", "suppressed_budget"]
+
+
+def test_failed_hook_is_fail_open_and_refunds_budget():
+    eng = AutopilotEngine(_Cfg(), policies=parse_policies(
+        "shed=serve_shed->scale_up_shed"), budget=1)
+
+    def boom(tier):
+        raise RuntimeError("no live batcher")
+
+    eng.bind("shed_tier", boom)
+    _fire(eng, "serve_shed", step=5)         # must not raise
+    (rec,) = eng.history
+    assert rec["status"] == "failed" and "no live batcher" in rec["detail"]
+    # The failure refunded the unit: the next firing can still act.
+    eng.bind("shed_tier", lambda tier: None)
+    _fire(eng, "serve_shed", step=6)
+    assert eng.history[-1]["status"] == "applied"
+
+
+def test_scale_up_shed_uses_bound_seams_or_noops():
+    cfg = _Cfg()
+    calls = []
+    eng = AutopilotEngine(cfg, policies=parse_policies(
+        "shed=serve_*->scale_up_shed:tier=2"), budget=8)
+    _fire(eng, "serve_p99_slo", step=1)
+    assert eng.history[-1]["status"] == "noop"       # nothing bound
+    eng.bind("scale_up", lambda rule: calls.append(("up", rule)))
+    eng.bind("shed_tier", lambda tier: calls.append(("shed", tier)))
+    _fire(eng, "serve_p99_slo", step=2)
+    assert eng.history[-1]["status"] == "applied"
+    assert calls == [("up", "serve_p99_slo"), ("shed", 2)]
+
+
+def test_shrink_memory_halves_dispatch_then_batch_then_noops():
+    cfg = _Cfg()
+    eng = AutopilotEngine(cfg, policies=parse_policies(
+        "mem=hbm_headroom->shrink_memory:shrink_batch=1"), budget=8)
+    _fire(eng, "hbm_headroom", step=10)
+    assert cfg.steps_per_dispatch == 2
+    assert eng.poll_restart().startswith("shrink_memory")
+    assert eng.poll_restart() is None                # one-shot
+    _fire(eng, "hbm_headroom", step=20)
+    assert cfg.steps_per_dispatch == 1
+    _fire(eng, "hbm_headroom", step=30)              # K exhausted: batch
+    assert cfg.batch_size == 16
+    assert "NOT bit-identical" in eng.history[-1]["detail"]
+    cfg.batch_size = 1
+    _fire(eng, "hbm_headroom", step=40)
+    assert eng.history[-1]["status"] == "noop"
+
+
+def test_raise_replica_keep_bounded():
+    cfg = _Cfg()
+    eng = AutopilotEngine(cfg, policies=parse_policies(
+        "rk=peer_churn->raise_replica_keep:max=3"), budget=8)
+    _fire(eng, "peer_churn", step=10)
+    _fire(eng, "peer_churn", step=20)
+    assert cfg.parallel.replica_keep == 3
+    _fire(eng, "peer_churn", step=30)
+    assert cfg.parallel.replica_keep == 3            # capped
+    assert eng.history[-1]["status"] == "noop"
+
+
+def test_handles_by_rule_and_action():
+    eng = AutopilotEngine(_Cfg(), budget=8)
+    assert eng.handles("nonfinite_burst")
+    assert eng.handles("nonfinite_burst", "rollback")
+    assert not eng.handles("nonfinite_burst", "shrink_memory")
+    assert not eng.handles("no_such_rule")
+
+
+def test_decisions_deterministic_under_replay():
+    """Identical firing sequences (step-based cooldowns) produce
+    identical remediation histories — the chaos campaign's replay
+    determinism in miniature."""
+    def run():
+        eng = AutopilotEngine(_Cfg(), budget=2)
+        for step in (20, 40, 75, 130, 200):
+            _fire(eng, "nonfinite_burst", step=step)
+        return [(r["status"], r["step"], r["alert_id"])
+                for r in eng.history]
+
+    assert run() == run()
+
+
+# ---------------------------------------------------------------------------
+# the trigger seam: suppressed re-fires / resolutions never remediate
+# ---------------------------------------------------------------------------
+
+def _attached(policies_spec="lossy_pol=lossy->rollback",
+              rules_spec="lossy=train.loss>10", min_interval_s=60.0):
+    alerts = AlertEngine(parse_alert_rules(rules_spec),
+                         min_interval_s=min_interval_s)
+    eng = AutopilotEngine(_Cfg(), policies=parse_policies(policies_spec),
+                          budget=8)
+    eng.attach(alerts)
+    return alerts, eng
+
+
+def test_no_remediation_for_suppressed_refire_or_resolution():
+    alerts, eng = _attached()
+    sink = _Sink()
+    alerts.observe("train", {"step": 1, "loss": 50.0}, emit=sink, now=0.0)
+    assert len(eng.history) == 1
+    # Resolution, then a re-fire inside the rate-limit window: the
+    # engine emits nothing, so the autopilot must see nothing.
+    alerts.observe("train", {"step": 2, "loss": 1.0}, emit=sink, now=1.0)
+    alerts.observe("train", {"step": 3, "loss": 60.0}, emit=sink, now=2.0)
+    assert sink.kinds() == ["alert", "alert_resolved"]
+    assert len(eng.history) == 1
+    # The one record carries the emitted firing's id.
+    assert eng.history[0]["alert_id"] == sink.records[0][1]["id"]
+
+
+def test_attach_is_idempotent_one_record_per_firing():
+    """Re-attaching (the Runtime attaches, then injects the engine
+    into fit_supervised, which attaches again) must not double the
+    remediations."""
+    alerts, eng = _attached(min_interval_s=0.0)
+    eng.attach(alerts)
+    eng.attach(alerts)
+    sink = _Sink()
+    alerts.observe("train", {"step": 1, "loss": 50.0}, emit=sink, now=0.0)
+    assert len(eng.history) == 1
+
+
+def test_attach_injects_required_rules_once():
+    alerts = AlertEngine(parse_alert_rules("lossy=train.loss>10"))
+    eng = AutopilotEngine(_Cfg(), budget=8)   # defaults want peer_churn
+    eng.attach(alerts)
+    eng.attach(alerts)
+    assert [r.name for r in alerts.rules].count("peer_churn") == 1
+
+
+def test_from_config_gated_on_flag():
+    class AP:
+        enabled = False
+        policies = None
+        budget = 8
+
+    class Cfg:
+        autopilot = AP()
+
+    assert AutopilotEngine.from_config(Cfg()) is None
+    Cfg.autopilot.enabled = True
+    Cfg.autopilot.policies = "r=nonfinite_burst->rollback@50"
+    Cfg.autopilot.budget = 3
+    eng = AutopilotEngine.from_config(Cfg())
+    assert [p.name for p in eng.policies] == ["r"]
+    assert eng.budget.total == 3
+
+
+# ---------------------------------------------------------------------------
+# tier-1 acceptance smoke: supervised nan@15 + HBM-shaped rule
+# ---------------------------------------------------------------------------
+
+def _params_digest(result):
+    import jax
+    import numpy as np
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(result.state.params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def test_autopilot_acceptance_supervised_nan(data_cfg, tmp_path,
+                                             monkeypatch):
+    from dml_cnn_cifar10_tpu.train.supervisor import fit_supervised
+    from dml_cnn_cifar10_tpu.utils import flightrec as flightrec_lib
+    from tests.conftest import tiny_train_cfg
+    from tools import check_jsonl_schema
+
+    # Two alert rules fire here and each capture arms a profiled
+    # devprof dispatch — minutes on a starved CPU box. The remediation
+    # linkage under test is the BUNDLE path, not its devprof payload
+    # (test_flightrec.py owns that); skip the profiler.
+    monkeypatch.setattr(flightrec_lib.FlightRecorder,
+                        "pop_devprof_window",
+                        lambda self, step, logger=None: None)
+
+    def run(sub, fault_spec):
+        cfg = tiny_train_cfg(data_cfg, str(tmp_path / sub),
+                             total_steps=30)
+        cfg.checkpoint_every = 10
+        cfg.output_every = 10
+        cfg.eval_every = 30
+        cfg.check_numerics = True
+        cfg.on_nonfinite = "rollback"
+        cfg.recovery_backoff_s = 0.01
+        cfg.fault_spec = fault_spec
+        cfg.metrics_jsonl = os.path.join(str(tmp_path / sub), "m.jsonl")
+        if fault_spec:
+            cfg.postmortem_dir = os.path.join(str(tmp_path / sub), "pm")
+        cfg.autopilot.enabled = True
+        # rollback_lr_scale stays 1.0: the applied remediation keeps
+        # the exact-resume contract, so the faulted run must end
+        # bit-identical to the reference. The custom HBM-shaped rule
+        # (always-true threshold) exercises a second policy arc; with
+        # steps_per_dispatch=1 its shrink degrades to an explicit noop.
+        cfg.autopilot.policies = (
+            "rollback_nonfinite=nonfinite_burst->rollback@50;"
+            "hbm=hbm_tight->shrink_memory@100")
+        cfg.alert_rules = "hbm_tight=train.loss>0@2!warn"
+        result = fit_supervised(cfg)
+        assert result.final_step == 30
+        return cfg, result
+
+    cfg, result = run("faulted", "nan@15")
+    with open(cfg.metrics_jsonl) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+
+    # Strict lint: the stream (with its remediation records) is schema
+    # clean.
+    assert check_jsonl_schema.check_file(cfg.metrics_jsonl,
+                                         strict=True) == []
+
+    # Exactly one remediation per firing alert, linked by id.
+    alerts = [r for r in recs if r["kind"] == "alert"]
+    rems = [r for r in recs if r["kind"] == "remediation"]
+    policies = parse_policies(cfg.autopilot.policies)
+    for a in alerts:
+        matching = [r for r in rems if r["alert_id"] == a["id"]]
+        if any(p.matches(a["rule"]) for p in policies):
+            assert len(matching) == 1, (a, rems)
+        else:
+            assert matching == []
+
+    # The nonfinite arc: applied rollback, linked to the firing AND to
+    # the flight-recorder bundle captured at that moment.
+    (roll,) = [r for r in rems if r["rule"] == "nonfinite_burst"]
+    assert roll["status"] == "applied"
+    assert roll["policy"] == "rollback_nonfinite"
+    pm = [r for r in recs if r["kind"] == "postmortem"
+          and r["rule"] == "nonfinite_burst"]
+    assert roll["postmortem"] == pm[0]["dir"]
+    assert os.path.isdir(roll["postmortem"])
+
+    # The HBM-shaped arc answered explicitly (noop: nothing to shrink
+    # at steps_per_dispatch=1 without shrink_batch opt-in).
+    (hbm,) = [r for r in rems if r["rule"] == "hbm_tight"]
+    assert hbm["status"] == "noop"
+
+    # The supervisor's own LR scale stayed off (the autopilot handles
+    # nonfinite_burst): LR is unscaled with lr_scale=1.
+    rollbacks = [r for r in recs if r["kind"] == "rollback"]
+    assert rollbacks and rollbacks[0]["lr"] == pytest.approx(0.05)
+
+    # Return-to-SLO, bit-identical: the recovered run's final params
+    # match the fault-free reference exactly.
+    _, ref = run("reference", None)
+    assert _params_digest(result) == _params_digest(ref)

@@ -44,11 +44,25 @@ Usage::
     python tools/chaos.py --seeds 4 --scenario expand   # 2→1→2 scale-UP sims
     python tools/chaos.py --seeds 4 --scenario peer_recovery  # diskless-restore sims
     python tools/chaos.py --seeds 4 --scenario runtime  # --mode run (train+serve) sims
+    python tools/chaos.py --seeds 4 --scenario autopilot  # alert->remediation sims
 
 Exit 1 when any schedule violates an invariant. ``--plant
 no_decision_sidecar`` reverts the RestartCoordinator sidecar check
 inside the workers (a named regression drill: the campaign must catch
-it and shrink the failure to its ``decision_corrupt`` core).
+it and shrink the failure to its ``decision_corrupt`` core);
+``--plant no_autopilot_policy`` disarms the autopilot's rollback
+policy (the autopilot campaign must catch the un-remediated alert).
+
+The ``autopilot`` scenario is the ``runtime`` sim with the autopilot
+armed (``--autopilot``) and a guaranteed ``nan@12`` backbone fault:
+every qualifying alert firing must be answered by a ``remediation``
+record citing its alert id, no remediation may fail, and every applied
+remediation's alert must return to healthy (``alert_resolved``) before
+run end — return-to-SLO with zero operator actions. The run gets a
+60-step tail past the fuzz window so the ``nonfinite_burst`` rate
+window (50 steps) can clear. (The flight recorder stays disarmed in
+the sim — see the worker comment; the postmortem linkage is pinned by
+the tier-1 acceptance smoke.)
 """
 
 from __future__ import annotations
@@ -94,6 +108,18 @@ def _legacy_read(self):
     except (OSError, ValueError, TypeError):
         return None
 _cl.RestartCoordinator.read = _legacy_read
+""",
+    # Disarm the autopilot's rollback policy: nonfinite_burst firings
+    # match nothing, so no remediation record answers them — the
+    # autopilot scenario's alert-answered invariant must catch the
+    # regression and shrink it to its nan core.
+    "no_autopilot_policy": """
+from dml_cnn_cifar10_tpu.autopilot import engine as _ap
+_orig_default_policies = _ap.default_policies
+def _no_rollback():
+    return [p for p in _orig_default_policies()
+            if p.action != "rollback"]
+_ap.default_policies = _no_rollback
 """,
 }
 
@@ -154,7 +180,8 @@ if cluster_dir:
     cfg.parallel.peer_dead_after_s = 2.5
     cfg.parallel.collective_timeout_s = 300.0
 
-if os.environ.get("DML_CHAOS_RUNTIME"):
+if os.environ.get("DML_CHAOS_RUNTIME") \
+        or os.environ.get("DML_CHAOS_AUTOPILOT"):
     # Unified-runtime scenario: the same supervised training run, but
     # as a TrainJob inside --mode run with the in-process serving head
     # up — faults must recover AND the publish protocol must keep
@@ -162,6 +189,18 @@ if os.environ.get("DML_CHAOS_RUNTIME"):
     cfg.supervise = True
     cfg.runtime.jobs = "train,serve"
     cfg.serve.port = 0          # ephemeral: campaign runs must not collide
+    if os.environ.get("DML_CHAOS_AUTOPILOT"):
+        # Autopilot scenario: the runtime sim with the policy engine
+        # armed. rollback_lr_scale stays 1.0 so the applied rollback
+        # remediation leaves the exact-resume contract intact (the
+        # bit_identical oracle still holds). The flight recorder stays
+        # DISARMED here: each capture arms a one-shot devprof window,
+        # and on a starved CPU box the profiled dispatch outlives the
+        # heartbeat_stale threshold, whose firing captures again — a
+        # self-sustaining stall loop. The tier-1 acceptance smoke
+        # (tests/test_autopilot.py) pins the postmortem linkage on a
+        # short supervised run instead.
+        cfg.autopilot.enabled = True
     from dml_cnn_cifar10_tpu.runtime import Runtime
     rt = Runtime(cfg, task_index=task)
     try:
@@ -195,6 +234,18 @@ CLUSTER_BACKBONE = "host_lost@15"
 #: — every schedule then fuzzes faults across shrink AND expand.
 EXPAND_BACKBONE = "host_lost@15"
 EXPAND_HOLD = "host_return@18"
+
+#: The autopilot scenario's guaranteed fault: every schedule carries a
+#: nan so the nonfinite_burst alert fires and the remediation loop is
+#: exercised on every run (a sampled schedule with no alert-provoking
+#: fault would pass the autopilot invariants vacuously).
+AUTOPILOT_BACKBONE = "nan@12"
+
+#: Extra steps the autopilot sim runs past the fuzz window: the
+#: nonfinite_burst rate window is 50 steps, so the run must outlive
+#: the last detection by >50 steps for the alert to RESOLVE — the
+#: return-to-healthy invariant needs the resolution on the stream.
+AUTOPILOT_TAIL_STEPS = 60
 
 #: Which reference digest oracles a scenario: all sims are numerically
 #: identical replicas of the 1-process run (per-seat data seeds
@@ -259,17 +310,20 @@ class ChaosHarness:
     # -- process plumbing -------------------------------------------------
 
     def _spawn(self, args, planted: bool, peer: bool = False,
-               runtime: bool = False):
+               runtime: bool = False, autopilot: bool = False):
         env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env.pop("DML_CHAOS_PLANT", None)
         env.pop("DML_CHAOS_PLANT_CODE", None)
         env.pop("DML_CHAOS_PEER", None)
         env.pop("DML_CHAOS_RUNTIME", None)
+        env.pop("DML_CHAOS_AUTOPILOT", None)
         if peer:
             env["DML_CHAOS_PEER"] = "1"
         if runtime:
             env["DML_CHAOS_RUNTIME"] = "1"
+        if autopilot:
+            env["DML_CHAOS_AUTOPILOT"] = "1"
         if planted and self.plant:
             env["DML_CHAOS_PLANT"] = self.plant
             env["DML_CHAOS_PLANT_CODE"] = PLANTS[self.plant]
@@ -288,6 +342,14 @@ class ChaosHarness:
 
     # -- reference digests ------------------------------------------------
 
+    def _steps_for(self, scenario: str) -> int:
+        """Per-scenario run length: the autopilot sim outlives the fuzz
+        window by the alert-resolution tail, everyone else runs the
+        campaign's ``total_steps``."""
+        if scenario == "autopilot":
+            return self.total_steps + AUTOPILOT_TAIL_STEPS
+        return self.total_steps
+
     def reference_digest(self, scenario: str) -> str:
         """Digest of the fault-free run of ``scenario``'s fuzzed seat
         (task 0), computed once per campaign. The exact-resume contract
@@ -299,18 +361,20 @@ class ChaosHarness:
         scenario = REF_ALIAS.get(scenario, scenario)
         if scenario in self._refs:
             return self._refs[scenario]
+        steps = self._steps_for(scenario)
         run_dir = os.path.join(self.workdir, f"ref_{scenario}")
         logs = os.path.join(run_dir, "logs_0")
         os.makedirs(logs, exist_ok=True)
         cluster = os.path.join(run_dir, "cluster")
         proc = self._spawn([0, 1, self.data_dir, logs, cluster, "",
-                            self.total_steps], planted=False)
+                            steps], planted=False,
+                           autopilot=scenario == "autopilot")
         out = proc.communicate(timeout=self.deadline_s)[0]
         if proc.returncode != 0:
             raise RuntimeError(f"fault-free reference run failed:\n{out}")
         res = self._read_result(out)
         if res is None or res.get("fenced") \
-                or res["final_step"] != self.total_steps:
+                or res["final_step"] != steps:
             raise RuntimeError(f"fault-free reference run did not "
                                f"complete:\n{out}")
         self._refs[scenario] = res["digest"]
@@ -392,6 +456,48 @@ class ChaosHarness:
                     injected, slowest
         return None, injected, slowest
 
+    @staticmethod
+    def _check_autopilot(recs) -> Optional[str]:
+        """Autopilot invariants over the fuzzed seat's stream
+        (docs/AUTOPILOT.md): every firing of a policy-matched rule is
+        answered by a ``remediation`` record citing its alert id (a
+        cooldown/budget suppression IS an explicit answer), every
+        remediation's lineage resolves to a real firing, no remediation
+        fails outright, and every *applied* remediation's alert returns
+        to healthy (``alert_resolved``) before run end — return-to-SLO
+        with zero operator actions. Judged against the UNPLANTED
+        default policies: a plant that disarms one inside the worker
+        is exactly the regression this must catch."""
+        from dml_cnn_cifar10_tpu.autopilot.engine import default_policies
+        policies = default_policies()
+        fired = [r for r in recs if r.get("kind") == "alert"]
+        rems = [r for r in recs if r.get("kind") == "remediation"]
+        resolved = {r.get("id") for r in recs
+                    if r.get("kind") == "alert_resolved"}
+        answered = {r.get("alert_id") for r in rems}
+        alert_ids = {r.get("id") for r in fired}
+        for r in fired:
+            if not any(p.matches(r.get("rule") or "")
+                       for p in policies):
+                continue
+            if r.get("id") not in answered:
+                return (f"autopilot: alert {r.get('id')} "
+                        f"[{r.get('rule')}] has no remediation record")
+        for r in rems:
+            if r.get("alert_id") not in alert_ids:
+                return (f"autopilot: remediation {r.get('policy')} "
+                        f"cites unknown alert id {r.get('alert_id')!r}")
+            if r.get("status") == "failed":
+                return (f"autopilot: remediation {r.get('policy')} for "
+                        f"{r.get('alert_id')} failed "
+                        f"({r.get('detail')})")
+            if r.get("status") == "applied" \
+                    and r.get("alert_id") not in resolved:
+                return (f"autopilot: remediated alert "
+                        f"{r.get('alert_id')} never returned to "
+                        f"healthy")
+        return None
+
     # -- one schedule -----------------------------------------------------
 
     def run_schedule(self, events: Sequence[faults_lib.FaultEvent],
@@ -411,16 +517,27 @@ class ChaosHarness:
         if scenario == "expand":
             return self._run_expand(events, spec, run_dir, cluster,
                                     ref, t0)
+        if scenario == "autopilot":
+            # Merge the guaranteed alert-provoking backbone into the
+            # sampled schedule (skipping exact duplicates so the
+            # fault-pairing count stays honest).
+            have = {(e.kind, e.step, e.phase) for e in events}
+            events = list(events) + [
+                e for e in faults_lib.parse_fault_spec(AUTOPILOT_BACKBONE)
+                if (e.kind, e.step, e.phase) not in have]
+            spec = faults_lib.format_fault_spec(events)
 
+        steps = self._steps_for(scenario)
         n = 2 if scenario in TWO_SEAT_SCENARIOS else 1
         logs = [os.path.join(run_dir, f"logs_{t}") for t in range(n)]
         for d in logs:
             os.makedirs(d, exist_ok=True)
         specs = [spec] if n == 1 else [spec, backbone]
         procs = [self._spawn([t, n, self.data_dir, logs[t], cluster,
-                              specs[t], self.total_steps], planted=True,
+                              specs[t], steps], planted=True,
                              peer=scenario == "peer_recovery",
-                             runtime=scenario == "runtime")
+                             runtime=scenario == "runtime",
+                             autopilot=scenario == "autopilot")
                  for t in range(n)]
         outs, timed_out = [], False
         for p in procs:
@@ -455,13 +572,13 @@ class ChaosHarness:
             return fail("completed: no RESULT line")
         if res.get("fenced"):
             return fail("completed: run fenced itself")
-        if res["final_step"] != self.total_steps:
+        if res["final_step"] != steps:
             return fail(f"completed: final step {res['final_step']} != "
-                        f"{self.total_steps}")
+                        f"{steps}")
         if res["digest"] != ref:
             return fail("bit_identical: final params differ from the "
                         "fault-free reference")
-        if scenario == "runtime":
+        if scenario in ("runtime", "autopilot"):
             # Runtime invariants (docs/RUNTIME.md): the publish
             # protocol must have committed at least one version into
             # the in-process serving engine, and no job — task or
@@ -479,6 +596,10 @@ class ChaosHarness:
             if bad:
                 return fail(f"completed: job {bad[0].get('job')!r} "
                             f"failed ({bad[0].get('error')})")
+            if scenario == "autopilot":
+                inv = self._check_autopilot(rrecs)
+                if inv is not None:
+                    return fail(inv)
         injected: Dict[str, int] = {}
         slowest = 0.0
         for i, d in enumerate(logs):
@@ -652,7 +773,11 @@ def run_campaign(seeds: Sequence[int], scenario: str, workdir: str,
              "cluster": faults_lib.CHAOS_CLUSTER_VOCABULARY,
              "expand": faults_lib.CHAOS_EXPAND_VOCABULARY,
              "peer_recovery": faults_lib.CHAOS_PEER_VOCABULARY,
-             "runtime": faults_lib.CHAOS_RUNTIME_VOCABULARY}[scenario]
+             "runtime": faults_lib.CHAOS_RUNTIME_VOCABULARY,
+             # The autopilot sim is the runtime sim with the policy
+             # engine armed; the guaranteed nan backbone rides on top
+             # of the sampled schedule (run_schedule merges it).
+             "autopilot": faults_lib.CHAOS_RUNTIME_VOCABULARY}[scenario]
     results = []
     faults_by_kind: Dict[str, int] = {}
     slowest = 0.0
@@ -721,7 +846,8 @@ def main(argv=None) -> int:
                    help="first seed (seeds are seed_base..+N-1)")
     p.add_argument("--scenario", default="train",
                    choices=["train", "cluster", "expand",
-                            "peer_recovery", "runtime", "mixed"],
+                            "peer_recovery", "runtime", "autopilot",
+                            "mixed"],
                    help="which sim to fuzz: 1-process supervised "
                         "train, the 2-process cluster shrink drill, "
                         "the 2→1→2 elastic-expand drill, the 2-process "
@@ -729,8 +855,10 @@ def main(argv=None) -> int:
                         "replica faults in vocabulary), the 1-process "
                         "unified runtime (--mode run: supervised train "
                         "+ in-process serving, publishes must survive "
-                        "recovery), or an alternating mix of all of "
-                        "them")
+                        "recovery), the runtime sim with the autopilot "
+                        "armed (alerts must be answered by remediation "
+                        "records and return to healthy), or an "
+                        "alternating mix of all of them")
     p.add_argument("--budget", type=int, default=3,
                    help="faults sampled per schedule")
     p.add_argument("--total_steps", type=int, default=40,
@@ -762,8 +890,10 @@ def main(argv=None) -> int:
                  "expand": ["expand"],
                  "peer_recovery": ["peer_recovery"],
                  "runtime": ["runtime"],
+                 "autopilot": ["autopilot"],
                  "mixed": ["train", "cluster", "expand",
-                           "peer_recovery", "runtime"]}[args.scenario]
+                           "peer_recovery", "runtime",
+                           "autopilot"]}[args.scenario]
     seeds = list(range(args.seed_base, args.seed_base + args.seeds))
     if args.spec is not None:
         seeds = seeds[:1]

@@ -147,8 +147,21 @@ KIND_KEYS = {
     # emitted records are strictly paired. `window` is the rule's
     # window descriptor ("2 consecutive" / "50 steps" / "15s"),
     # `value` the reading that crossed (or recovered past) the line.
-    "alert": ("rule", "severity", "window", "value"),
-    "alert_resolved": ("rule", "severity", "window", "value"),
+    # `id` is the firing's identity ("<rule>#<N>", monotonic per
+    # engine): stamped on both records of an emitted pair, and the join
+    # key remediation records point back at.
+    "alert": ("rule", "severity", "window", "value", "id"),
+    "alert_resolved": ("rule", "severity", "window", "value", "id"),
+    # Autopilot remediation (autopilot/engine.py; docs/AUTOPILOT.md).
+    # One record per qualifying alert firing per matching policy:
+    # `alert_id` joins the firing `alert` record, `action` is the
+    # policy's remediation, `status` one of applied | noop | failed |
+    # suppressed_cooldown | suppressed_budget, `postmortem` the
+    # flight-recorder bundle captured for the same firing (null when
+    # the recorder is unarmed), `detail` the action's own summary,
+    # `step` the global step snapshot at firing time.
+    "remediation": ("policy", "rule", "alert_id", "action", "status",
+                    "postmortem", "detail", "step"),
     # Serving runtime (serve/metrics.py; docs/SERVING.md). Percentile
     # values are null until the window has completions.
     "serve": ("requests", "completed", "shed_queue", "shed_deadline",

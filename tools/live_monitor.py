@@ -129,6 +129,22 @@ def active_alerts(records: List[dict]) -> List[dict]:
     return list(active.values())
 
 
+def remediation_state(records: List[dict],
+                      alert_id: Optional[str]) -> Optional[dict]:
+    """The newest ``remediation`` record answering ``alert_id`` —
+    the in-flight autopilot state for a still-active alert (policy,
+    action, status; a cooldown suppression's ``detail`` carries the
+    steps/seconds remaining). None when the autopilot has not
+    answered (or is not armed)."""
+    if not alert_id:
+        return None
+    for r in reversed(records):
+        if r.get("kind") == "remediation" \
+                and r.get("alert_id") == alert_id:
+            return r
+    return None
+
+
 def stream_finished(records: List[dict]) -> bool:
     return any(r.get("kind") in FINAL_KINDS for r in records)
 
@@ -189,10 +205,17 @@ def build_state(streams: Dict[str, List[dict]],
                 epoch = d.get("epoch")
                 world_size = d.get("world_size")
         for a in active_alerts(records):
-            alerts.append({"path": path, "rule": a.get("rule"),
-                           "severity": a.get("severity"),
-                           "value": a.get("value"),
-                           "window": a.get("window")})
+            alert = {"path": path, "rule": a.get("rule"),
+                     "severity": a.get("severity"),
+                     "value": a.get("value"),
+                     "window": a.get("window"),
+                     "id": a.get("id")}
+            rem = remediation_state(records, a.get("id"))
+            if rem is not None:
+                alert["remediation"] = {
+                    k: rem.get(k)
+                    for k in ("policy", "action", "status", "detail")}
+            alerts.append(alert)
     if world_size is None and tasks:
         # No restart decisions yet: approximate the world as the
         # distinct task indices observed across the streams.
@@ -322,6 +345,13 @@ def render_view(state: dict) -> str:
                 f"    [{a.get('severity')}] {a.get('rule')} "
                 f"value={_fmt(a.get('value'), 4)} "
                 f"window={a.get('window')} ({a.get('path')})")
+            rem = a.get("remediation")
+            if rem:
+                detail = rem.get("detail")
+                lines.append(
+                    f"      autopilot: {rem.get('policy')}/"
+                    f"{rem.get('action')} {rem.get('status')}"
+                    + (f" ({detail})" if detail else ""))
     else:
         lines.append("  no active alerts")
     return "\n".join(lines)

@@ -225,6 +225,43 @@ def _peer_summary(records: List[dict]) -> Optional[dict]:
     return out
 
 
+def _autopilot_summary(records: List[dict]) -> Optional[dict]:
+    """Alert → remediation → outcome lineage from the ``remediation``
+    records (autopilot/engine.py; docs/AUTOPILOT.md): per-policy action
+    counts split by status (applied / noop / failed and the explicit
+    cooldown/budget suppressions), plus each firing's full arc — the
+    alert id it answered, the action taken, and whether that alert
+    later resolved. None when the stream carries no remediation
+    records — the report stays byte-identical for pre-autopilot
+    streams."""
+    rems = [r for r in records if r.get("kind") == "remediation"]
+    if not rems:
+        return None
+    resolved_ids = {r.get("id") for r in records
+                    if r.get("kind") == "alert_resolved"
+                    and r.get("id")}
+    by_policy: dict = {}
+    counts: dict = {}
+    for r in rems:
+        st = r.get("status") or "?"
+        counts[st] = counts.get(st, 0) + 1
+        e = by_policy.setdefault(str(r.get("policy")),
+                                 {"action": r.get("action"),
+                                  "statuses": {}})
+        e["statuses"][st] = e["statuses"].get(st, 0) + 1
+    lineage = [{
+        "alert_id": r.get("alert_id"), "rule": r.get("rule"),
+        "step": r.get("step"), "policy": r.get("policy"),
+        "action": r.get("action"), "status": r.get("status"),
+        "detail": r.get("detail"), "postmortem": r.get("postmortem"),
+        "outcome": (("resolved" if r.get("alert_id") in resolved_ids
+                     else "unresolved at stream end")
+                    if r.get("alert_id") else None),
+    } for r in rems]
+    return {"remediations": len(rems), "statuses": counts,
+            "by_policy": by_policy, "lineage": lineage}
+
+
 def _jobs_summary(records: List[dict]) -> Optional[dict]:
     """Unified-runtime rollup (``--mode run``; runtime/, docs/RUNTIME.md)
     from the ``job`` / ``job_done`` / ``publish`` records: per-job state
@@ -572,6 +609,31 @@ def summarize_records(records: List[dict], header: str) -> str:
                 f"    [{r.get('severity')}] {r.get('rule')} fired at "
                 f"t={r.get('t')}s (value {r.get('value')}, window "
                 f"{r.get('window')}) — {state}")
+    # Autopilot (--autopilot; autopilot/engine.py, docs/AUTOPILOT.md):
+    # the alert → remediation → outcome lineage — which policy answered
+    # each firing, what it did, whether the alert then resolved, and
+    # how many firings the cooldown/budget gates suppressed.
+    ap = _autopilot_summary(records)
+    if ap:
+        st = ap["statuses"]
+        lines.append(
+            f"  autopilot: {ap['remediations']} remediation(s) — "
+            f"{st.get('applied', 0)} applied, "
+            f"{st.get('noop', 0)} noop, {st.get('failed', 0)} failed, "
+            f"{st.get('suppressed_cooldown', 0)} cooldown-suppressed, "
+            f"{st.get('suppressed_budget', 0)} budget-suppressed")
+        for name, e in sorted(ap["by_policy"].items()):
+            per = ", ".join(f"{s}: {n}"
+                            for s, n in sorted(e["statuses"].items()))
+            lines.append(f"    policy {name} ({e['action']}): {per}")
+        for arc in ap["lineage"]:
+            pm = f", postmortem {arc['postmortem']}" \
+                if arc.get("postmortem") else ""
+            det = f" ({arc['detail']})" if arc.get("detail") else ""
+            lines.append(
+                f"    {arc['alert_id']} [{arc['rule']}] -> "
+                f"{arc['policy']}/{arc['action']}: {arc['status']}"
+                f"{det} — alert {arc['outcome']}{pm}")
     # Unified runtime (--mode run; runtime/, docs/RUNTIME.md): the job
     # lifecycle timeline, the in-process publish latency, and the
     # alert→job→publish lineage for any trigger-born fine-tunes.
@@ -892,6 +954,9 @@ def summarize_json(path: str) -> dict:
                  "value": r.get("value"), "window": r.get("window")}
                 for r in still_active.values()],
         }
+    ap = _autopilot_summary(records)
+    if ap:
+        out["autopilot"] = ap
     jobs = _jobs_summary(records)
     if jobs:
         out["jobs"] = jobs
