@@ -52,6 +52,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import tempfile
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -121,7 +122,15 @@ class PeerReplicaStore:
     def __init__(self, cluster_dir: str, process_id: int,
                  world: Sequence[int], keep: int = 2,
                  log_fn: Optional[Callable[..., None]] = None,
-                 threads: int = 1):
+                 threads: int = 1, client=None):
+        # Optional network transport (parallel/net.py CoordClient):
+        # pushes stage locally then travel to the coordination service
+        # host, committed by a server-side atomic rename — the same
+        # tmp→rename protocol, one hop further away. None = the
+        # file-backed store (shared directory) as before. TransportError
+        # subclasses OSError, so every retry/abandon path below handles
+        # a network failure exactly like a filesystem one.
+        self._client = client
         self.root = os.path.join(cluster_dir, REPLICAS_DIRNAME)
         self.process_id = process_id
         self.world = sorted(world) if world else [process_id]
@@ -174,10 +183,35 @@ class PeerReplicaStore:
     def _step_dir(self, owner: int, step: int) -> str:
         return os.path.join(self._host_dir(owner), f"step_{step:08d}")
 
+    def _host_rel(self, owner: int) -> str:
+        """Server-relative path of an owner's replica dir (net mode)."""
+        return f"{REPLICAS_DIRNAME}/host_{owner}"
+
+    def _step_rel(self, owner: int, step: int) -> str:
+        return f"{self._host_rel(owner)}/step_{step:08d}"
+
     def committed_steps(self, owner: int) -> List[int]:
         """Sorted committed replica steps for ``owner`` (commit marker
-        present; half-renamed tmp dirs are invisible)."""
-        out = []
+        present; half-renamed tmp dirs are invisible). Over the network
+        transport an unreachable coordinator reads as no commits — the
+        decide seam then falls back to disk, which is the right
+        degradation."""
+        out: List[int] = []
+        if self._client is not None:
+            try:
+                names = self._client.list_dir(self._host_rel(owner))
+            except OSError:
+                return out
+            # Visibility == committed: the server publishes a step dir
+            # only by the atomic rename that ends a push.
+            for name in names:
+                if not name.startswith("step_") or ".tmp" in name:
+                    continue
+                try:
+                    out.append(int(name[len("step_"):]))
+                except ValueError:
+                    continue
+            return sorted(out)
         try:
             names = os.listdir(self._host_dir(owner))
         except OSError:
@@ -261,6 +295,8 @@ class PeerReplicaStore:
                    error=str(err)[:300])
 
     def _push(self, step: int, payload: Dict[str, list]) -> None:
+        if self._client is not None:
+            return self._push_net(step, payload)
         t0 = time.perf_counter()
         final = self._step_dir(self.process_id, step)
         if os.path.isfile(os.path.join(final, INDEX)):
@@ -290,10 +326,56 @@ class PeerReplicaStore:
                    secs=round(time.perf_counter() - t0, 6), ok=True)
         self._prune()
 
+    def _push_net(self, step: int, payload: Dict[str, list]) -> None:
+        """Network push: stage the split + sidecar-bearing part files
+        in a local scratch dir (the same codec writes them), upload
+        each under a ``.tmpnet`` step dir, then commit with ONE
+        server-side atomic rename — visibility still equals commit."""
+        t0 = time.perf_counter()
+        if step in self.committed_steps(self.process_id):
+            return  # already committed (a replayed boundary)
+        rel_final = self._step_rel(self.process_id, step)
+        rel_tmp = rel_final + f".tmpnet{os.getpid()}"
+        scratch = tempfile.mkdtemp(prefix="dml_peer_push_")
+        try:
+            parts = sharded._split_payload(payload, self.threads)
+            names = [f"part_{j}.msgpack" for j in range(len(parts))]
+            total = 0
+            for name, part in zip(names, parts):
+                _, nbytes, _ = sharded._write_one_shard(
+                    scratch, name, part, on_event=None, source="peer")
+                total += nbytes
+            # Upload parts AND their .sha256 sidecars; INDEX last so a
+            # server-side listing of the tmp dir is never mistaken for
+            # complete (belt — the rename commit is the suspenders).
+            for fname in sorted(os.listdir(scratch)):
+                with open(os.path.join(scratch, fname), "rb") as f:
+                    self._client.put(f"{rel_tmp}/{fname}", f.read())
+            index = {"owner": self.process_id, "dest": self.successor(),
+                     "step": int(step), "files": names}
+            self._client.put(f"{rel_tmp}/{INDEX}",
+                             json.dumps(index).encode())
+            self._client.rename(rel_tmp, rel_final)
+        finally:
+            shutil.rmtree(scratch, ignore_errors=True)
+        self._replica_step = max(self._replica_step, int(step))
+        self.pushes += 1
+        self._emit("push", step=step, owner=self.process_id,
+                   nbytes=total,
+                   secs=round(time.perf_counter() - t0, 6), ok=True)
+        self._prune()
+
     def _prune(self) -> None:
         for step in self.committed_steps(self.process_id)[:-self.keep]:
-            shutil.rmtree(self._step_dir(self.process_id, step),
-                          ignore_errors=True)
+            if self._client is not None:
+                try:
+                    self._client.delete_tree(
+                        self._step_rel(self.process_id, step))
+                except OSError:
+                    pass  # the next boundary's prune retries
+            else:
+                shutil.rmtree(self._step_dir(self.process_id, step),
+                              ignore_errors=True)
 
     def flush(self, timeout_s: float = 10.0) -> None:
         """Drain pending pushes (tests; never on the step path)."""
@@ -305,6 +387,50 @@ class PeerReplicaStore:
 
     # -- read side --------------------------------------------------------
 
+    def _fetch_replica(self, owner: int, step: int) -> str:
+        """Net mode: download one committed replica (commit marker,
+        parts, sidecars) into a scratch dir shaped like the on-disk
+        layout, so the verify path below runs unchanged. Unreachable or
+        uncommitted reads raise :class:`ReplicaMiss` — the caller falls
+        back to the disk walk."""
+        rel = self._step_rel(owner, step)
+        try:
+            idx_payload = self._client.get(f"{rel}/{INDEX}")
+        except OSError as e:
+            raise ReplicaMiss(
+                f"replica of host {owner} at step {step} unreachable "
+                f"over the net transport: {e}")
+        if idx_payload is None:
+            raise ReplicaMiss(
+                f"replica of host {owner} at step {step} is missing or "
+                f"stale (committed steps: "
+                f"{self.committed_steps(owner) or 'none'})")
+        try:
+            files = json.loads(idx_payload)["files"]
+        except (ValueError, TypeError, KeyError) as e:
+            raise ReplicaMiss(
+                f"replica of host {owner} at step {step} has an "
+                f"undecodable commit marker: {e}")
+        scratch = os.path.join(
+            tempfile.mkdtemp(prefix="dml_peer_read_"),
+            f"step_{step:08d}")
+        os.makedirs(scratch)
+        with open(os.path.join(scratch, INDEX), "wb") as f:
+            f.write(idx_payload)
+        for fname in files:
+            for name in (fname, sharded.shard_checksum_path(fname)):
+                try:
+                    payload = self._client.get(f"{rel}/{name}")
+                except OSError as e:
+                    raise ReplicaMiss(
+                        f"replica of host {owner} at step {step} "
+                        f"unreachable mid-read: {e}")
+                if payload is None:
+                    continue  # sidecar-less legacy replica decodes
+                with open(os.path.join(scratch, name), "wb") as f:
+                    f.write(payload)
+        return scratch
+
     def read_replica(self, owner: int, step: int,
                      on_event=None) -> Dict[str, list]:
         """Read + sidecar-verify one committed replica. Every failure —
@@ -312,7 +438,17 @@ class PeerReplicaStore:
         mismatch — raises the classified :class:`ReplicaMiss`, never an
         unclassified crash. A sidecar-less legacy replica decodes (the
         sharded codec's own back-compat rule)."""
-        d = self._step_dir(owner, step)
+        if self._client is not None:
+            d = self._fetch_replica(owner, step)
+            try:
+                return self._read_replica_dir(d, owner, step, on_event)
+            finally:
+                shutil.rmtree(os.path.dirname(d), ignore_errors=True)
+        return self._read_replica_dir(self._step_dir(owner, step),
+                                      owner, step, on_event)
+
+    def _read_replica_dir(self, d: str, owner: int, step: int,
+                          on_event=None) -> Dict[str, list]:
         idx = os.path.join(d, INDEX)
         if not os.path.isfile(idx):
             newest = self.committed_steps(owner)

@@ -204,6 +204,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "sidecar) is published to the fleet dir so live "
                         "serve workers swap to it between micro-batches "
                         "(the online train-and-serve scenario)")
+    p.add_argument("--cell", type=str, default="default",
+                   help="comma-separated fleet cell names (failure "
+                        "domains): replica i lands in cell i %% "
+                        "len(cells) and advertises it per heartbeat; "
+                        "the router prefers a request's X-DML-Cell "
+                        "target (tools/loadgen.py --target_cell) and "
+                        "fails over cross-cell — logged as cell_route "
+                        "and force-traced — when the cell has no live "
+                        "replica")
     p.add_argument("--learning_rate", type=float, default=0.1)
     p.add_argument("--fidelity", type=str, default="faithful",
                    choices=["faithful", "fixed"],
@@ -462,7 +471,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "may share a step) or a recovery phase "
                         "restore|adopt|decide that fires inside the "
                         "supervisor's recovery paths (utils/faults.py; "
-                        "tools/chaos.py fuzzes these)")
+                        "tools/chaos.py fuzzes these). The network "
+                        "kinds net_partition, net_delay, net_drop, "
+                        "net_dup (need --cluster_transport net) arm a "
+                        "deterministic fault on the coordination "
+                        "service isolating the injecting process "
+                        "(utils/netfaults.py)")
     p.add_argument("--cluster_dir", type=str, default=None,
                    help="shared directory arming the cluster-resilience "
                         "layer (parallel/cluster.py): per-process "
@@ -525,6 +539,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "it raises a classified ckpt_restore error "
                         "instead of scanning a huge retention dir "
                         "forever (0 = unbounded)")
+    p.add_argument("--cluster_transport", type=str, default="file",
+                   choices=["file", "net"],
+                   help="coordination transport (heartbeats, restart "
+                        "decisions, peer-replica pushes, fleet "
+                        "discovery): 'file' = the shared-directory "
+                        "store (n=1 and test fallback); 'net' = a "
+                        "socket service (parallel/net.py) hosted by "
+                        "process 0 (the fleet controller in --mode "
+                        "fleet) over the same directory — bounded "
+                        "timeouts, classified transport errors, and "
+                        "the seam the net_* chaos faults partition "
+                        "(docs/RESILIENCE.md Transport selection)")
+    p.add_argument("--net_timeout_s", type=float, default=5.0,
+                   help="per-request socket timeout on the net "
+                        "coordination transport; every operation is "
+                        "bounded so a dead/partitioned coordinator "
+                        "degrades to the classified peer_lost/eviction "
+                        "paths, never a hang (lockstep sims run 0.5)")
+    p.add_argument("--net_retries", type=int, default=2,
+                   help="bounded retry budget per net-transport "
+                        "operation (exponential backoff between "
+                        "attempts; retried on timeout/unreachable/5xx)")
     p.add_argument("--cluster_lockstep", type="bool", default=False,
                    help="simulation only: make the dispatch seam a "
                         "software barrier over the heartbeat store so "
@@ -763,6 +799,9 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.parallel.peer_redundancy = args.peer_redundancy
     cfg.parallel.replica_keep = args.replica_keep
     cfg.restore_deadline_s = args.restore_deadline_s
+    cfg.parallel.cluster_transport = args.cluster_transport
+    cfg.parallel.net_timeout_s = args.net_timeout_s
+    cfg.parallel.net_retries = args.net_retries
     cfg.parallel.cluster_lockstep = args.cluster_lockstep
     cfg.shard_io_threads = args.shard_io_threads
     cfg.parallel.coordinator_timeout_s = args.coordinator_timeout_s
@@ -883,6 +922,7 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.fleet.autoscale = args.fleet_autoscale
     cfg.fleet.replica_dead_after_s = args.fleet_replica_dead_after_s
     cfg.fleet.publish = args.fleet_publish
+    cfg.fleet.cell = args.cell
     # The worker set also names the cluster-resilience world: process_id
     # feeds chiefness (multihost.is_chief) and the heartbeat identity
     # even when jax.distributed never initializes (the lockstep CPU

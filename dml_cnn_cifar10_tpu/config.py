@@ -350,6 +350,22 @@ class ParallelConfig:
     # Replica retention: committed replica step-dirs kept per owner
     # before the push thread prunes the oldest.
     replica_keep: int = 2
+    # Coordination transport (parallel/net.py; docs/RESILIENCE.md
+    # transport-selection section). "file": the shared-directory store
+    # above — the n=1/test fallback and the shared-filesystem default.
+    # "net": the same HeartbeatStore/RestartCoordinator contracts over
+    # a stdlib-HTTP coordination service hosted by process 0 over
+    # cluster_dir; every operation gets a bounded timeout, bounded
+    # retries, and classified errors, so a dead/partitioned
+    # coordinator degrades into the ordinary peer_lost/eviction paths
+    # instead of a hang.
+    cluster_transport: str = "file"
+    # Per-request socket timeout of the net transport. The lockstep
+    # sims run 0.5s; production WANs want the default.
+    net_timeout_s: float = 5.0
+    # Extra attempts per operation (bounded backoff between attempts)
+    # before a transport failure is surfaced.
+    net_retries: int = 2
     # Simulation only: make the dispatch seam a software barrier over
     # the heartbeat store (wait for every live peer to reach the local
     # step) so multi-process CPU runs without real collectives still
@@ -485,6 +501,10 @@ class FleetConfig:
     # Max re-route attempts for one client request before the router
     # sheds it (each failed attempt also evicts the failing replica).
     route_retries: int = 3
+    # Base inter-attempt delay of the router's bounded retry backoff
+    # (utils/backoff.py, capped at 10x): a flapping replica must not
+    # ping-pong a request across survivors at CPU speed.
+    route_backoff_s: float = 0.05
     # Per-attempt router->worker proxy timeout.
     route_timeout_s: float = 30.0
     # Cadence of `fleet` JSONL window records from the router.
@@ -493,6 +513,13 @@ class FleetConfig:
     # kind (host_lost | heartbeat_stall) on that replica after n batch
     # dispatches — the fleet analogue of --fault_spec. None disables.
     worker_fault: Optional[str] = None
+    # Named cells (comma-separated, e.g. "us-east,us-west"): replica i
+    # belongs to cell i % len(cells), advertises it in its heartbeat,
+    # and the router prefers a request's target cell (X-DML-Cell
+    # header / loadgen --target_cell), failing over cross-cell — with
+    # a `cell_route` record and a force-sampled trace — only when the
+    # target cell has no live replica. One cell = the old behavior.
+    cell: str = "default"
 
 
 @dataclasses.dataclass

@@ -169,11 +169,21 @@ class FleetController:
             self.autopilot.bind("scale_up", self._request_scale_up)
             if self.alerts is not None:
                 self.autopilot.attach(self.alerts)
+        # NET coordination transport (--cluster_transport net): the
+        # controller hosts the fleet's coordination service over the
+        # fleet dir; workers beat through CoordClient. The router keeps
+        # reading the SAME directory straight off disk (it is
+        # co-process with the server), so discovery needs no extra hop.
+        self.net_server = None
+        if getattr(cfg.parallel, "cluster_transport", "file") == "net":
+            from dml_cnn_cifar10_tpu.parallel import net as net_lib
+            self.net_server = net_lib.CoordServer(self.fleet_dir)
         self.router = Router(
             self.fleet_dir,
             dead_after_s=cfg.fleet.replica_dead_after_s,
             route_retries=cfg.fleet.route_retries,
             route_timeout_s=cfg.fleet.route_timeout_s,
+            route_backoff_s=cfg.fleet.route_backoff_s,
             logger=logger,
             trace_sample_rate=cfg.serve.trace_sample_rate)
         config_path = os.path.join(self.fleet_dir, "worker_config.json")
@@ -298,6 +308,10 @@ class FleetController:
         self.router.emit(final=True)
         self.router.shutdown()
         self.pool.terminate_all()
+        # Last: workers drain first so their final beats don't land on
+        # a closed coordination service.
+        if self.net_server is not None:
+            self.net_server.stop()
 
 
 def main_fleet(cfg, ready_event: Optional[threading.Event] = None,

@@ -13,7 +13,11 @@ placement:
   lets the autoscaler add workers by just spawning them.
 - **Placement** — least ``queue_depth`` first (the beat payload), round
   robin among ties: cheap, heartbeat-driven load awareness without a
-  second RPC.
+  second RPC. Replicas advertise a **cell** (named failure domain,
+  ``--cell``); a request tagged ``X-DML-Cell`` prefers its cell's live
+  replicas and fails over cross-cell when the cell has none — the
+  crossing is a ``cell_route`` record and force-samples the request's
+  trace so a cross-cell retry is one Perfetto flow.
 - **Eviction** — a replica whose newest beat is older than
   ``replica_dead_after_s``, or that fails at the socket, leaves the
   rotation immediately (``peer_lost`` JSONL, ``reason
@@ -43,7 +47,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence
 
 from dml_cnn_cifar10_tpu.parallel.cluster import HeartbeatStore
-from dml_cnn_cifar10_tpu.utils import reqtrace
+from dml_cnn_cifar10_tpu.utils import backoff, netfaults, reqtrace
+
+#: Request header naming the cell a client wants served from
+#: (tools/loadgen.py --target_cell sets it). Absent header = no cell
+#: preference; routing is exactly the pre-cell behaviour.
+CELL_HEADER = "X-DML-Cell"
 
 
 @dataclasses.dataclass
@@ -61,6 +70,9 @@ class ReplicaView:
     # deep-queue disambiguator. None before the replica's first batch
     # (and on beats from workers predating the field).
     device_ms: Optional[float] = None
+    # Named failure domain (--cell; beats from workers predating the
+    # field land in "default", same as an unconfigured fleet).
+    cell: str = "default"
 
 
 def view_from_beat(beat, now: Optional[float] = None) -> ReplicaView:
@@ -72,7 +84,8 @@ def view_from_beat(beat, now: Optional[float] = None) -> ReplicaView:
         queue_depth=int(extra.get("queue_depth") or 0),
         phase=beat.phase,
         age_s=beat.age_s(now),
-        device_ms=extra.get("device_ms"))
+        device_ms=extra.get("device_ms"),
+        cell=str(extra.get("cell") or "default"))
 
 
 def live_views(views: Sequence[ReplicaView], dead_after_s: float,
@@ -177,12 +190,19 @@ class Router:
     def __init__(self, fleet_dir: str, dead_after_s: float = 3.0,
                  route_retries: int = 3, route_timeout_s: float = 30.0,
                  logger=None, host: str = "127.0.0.1",
-                 trace_sample_rate: float = 0.0):
+                 trace_sample_rate: float = 0.0,
+                 route_backoff_s: float = 0.05):
         # process_id -1: the router reads every beat but publishes none.
-        self.store = HeartbeatStore(fleet_dir, process_id=-1)
+        self.store = HeartbeatStore(
+            fleet_dir, process_id=-1,
+            log_fn=logger.log if logger is not None else None)
         self.dead_after_s = dead_after_s
         self.route_retries = max(1, int(route_retries))
         self.route_timeout_s = route_timeout_s
+        # Base of the exponential between FAILED placement attempts
+        # (satellite of the partition-tolerance work): a fleet-wide
+        # blip must not see all retries burned in the same millisecond.
+        self.route_backoff_s = max(0.0, float(route_backoff_s))
         self.logger = logger
         self.host = host
         self.trace_sample_rate = float(trace_sample_rate)
@@ -247,14 +267,29 @@ class Router:
     # -- the proxy ------------------------------------------------------
 
     def proxy_predict(self, body: bytes,
-                      trace_header: Optional[str] = None) -> tuple:
+                      trace_header: Optional[str] = None,
+                      target_cell: Optional[str] = None) -> tuple:
         """Route one request; returns ``(status, payload_dict)``.
 
         Worker failure at the socket (refused / reset mid-read /
         timeout) evicts that replica and retries the SAME body on the
         next pick — the re-route that turns a worker kill into zero
-        client errors. Worker 4xx/5xx HTTP answers pass through (they
-        are the worker speaking, not dying).
+        client errors. Consecutive failed attempts are spaced by a
+        bounded exponential (``route_backoff_s`` base) so a transient
+        fleet-wide blip doesn't burn the whole retry budget at once.
+        Worker 4xx/5xx HTTP answers pass through (they are the worker
+        speaking, not dying).
+
+        Cells: ``target_cell`` (the ``X-DML-Cell`` header) narrows each
+        pick to that cell's live replicas while any exist; when the
+        cell has none the pick falls through to the whole fleet — the
+        crossing logs ``cell_route`` and force-samples the trace. No
+        ``target_cell`` = the pre-cell routing, record for record.
+
+        A replica the armed network faults (``utils/netfaults.py``)
+        isolate is unreachable BY DEFINITION of the partition sim —
+        treated exactly like a connect error (evict + re-route) without
+        burning ``route_timeout_s`` on a socket that would hang.
 
         Tracing: one ``rspan`` per placement ATTEMPT, buffered until
         the request resolves — a retry or a shed forces the trace, and
@@ -270,12 +305,24 @@ class Router:
                                    a.pop("dur_s"), a.pop("wallclock"),
                                    **a)
 
+        def _backoff(attempt: int) -> None:
+            if self.route_backoff_s > 0 and attempt < self.route_retries:
+                time.sleep(backoff.delay_s(self.route_backoff_s,
+                                           self.route_backoff_s * 10,
+                                           attempt + 1))
+
         tried: set = set()
         for attempt in range(self.route_retries + 1):
             with self._lock:
                 rr = self._rr
                 self._rr += 1
-            target = pick_replica(self.live(extra_exclude=tried), rr)
+            candidates = self.live(extra_exclude=tried)
+            pool = candidates
+            if target_cell:
+                in_cell = [v for v in candidates
+                           if v.cell == target_cell]
+                pool = in_cell or candidates
+            target = pick_replica(pool, rr)
             if target is None:
                 self.metrics.record_shed()
                 ctx.force()
@@ -284,8 +331,35 @@ class Router:
                                    time.time(), attempt=attempt,
                                    shed="no_live_replicas")
                 return 503, {"shed": "no_live_replicas"}
+            if target_cell and target.cell != target_cell:
+                # Cross-cell failover: the requested cell has no live
+                # replica right now. Force-sample so the whole retry
+                # chain (the in-cell attempt that died, this crossing,
+                # the answer) is one trace flow.
+                ctx.force()
+                if self.logger is not None:
+                    self.logger.log("cell_route", from_cell=target_cell,
+                                    to_cell=target.cell,
+                                    replica_id=target.replica_id,
+                                    attempt=attempt)
             if attempt:
                 self.metrics.record_rerouted()
+            if netfaults.is_isolated(target.replica_id):
+                # Partition sim data plane: don't dial a socket the
+                # fault would hold — fail the attempt as the timeout
+                # eventually would, instantly and deterministically.
+                ctx.force()
+                attempts.append(
+                    {"dur_s": 0.0,
+                     "wallclock": time.time(),
+                     "attempt": attempt, "status": 0,
+                     "replica_id": target.replica_id,
+                     "error": "partitioned"})
+                tried.add(target.replica_id)
+                self.evict(target.replica_id,
+                           "replica_evicted_partitioned")
+                _backoff(attempt)
+                continue
             req = urllib.request.Request(
                 f"http://{self.host}:{target.port}/predict", data=body,
                 headers={"Content-Type": "application/octet-stream",
@@ -339,6 +413,7 @@ class Router:
                 tried.add(target.replica_id)
                 self.evict(target.replica_id,
                            "replica_evicted_connect_error")
+                _backoff(attempt)
         self.metrics.record_shed()
         ctx.force()
         _flush_spans()
@@ -358,6 +433,7 @@ class Router:
                     "queue_depth": v.queue_depth, "phase": v.phase,
                     "age_s": round(v.age_s, 3),
                     "device_ms": v.device_ms,
+                    "cell": v.cell,
                     "live": v.replica_id in live_ids}
                 for v in views},
         }
@@ -432,7 +508,8 @@ class Router:
                 n = int(self.headers.get("Content-Length", 0))
                 code, payload = router.proxy_predict(
                     self.rfile.read(n),
-                    trace_header=self.headers.get(reqtrace.TRACE_HEADER))
+                    trace_header=self.headers.get(reqtrace.TRACE_HEADER),
+                    target_cell=self.headers.get(CELL_HEADER))
                 self._reply(code, payload)
 
         return Handler

@@ -156,7 +156,8 @@ class _BeatPublisher(threading.Thread):
     """Advertise this replica: liveness + placement signals per beat."""
 
     def __init__(self, store: HeartbeatStore, batcher, engine,
-                 interval_s: float, port_ref: dict, phase_ref: dict):
+                 interval_s: float, port_ref: dict, phase_ref: dict,
+                 cell: str = "default"):
         super().__init__(name="fleet-beat-publisher", daemon=True)
         self.store = store
         self.batcher = batcher
@@ -164,6 +165,7 @@ class _BeatPublisher(threading.Thread):
         self.interval_s = interval_s
         self.port_ref = port_ref
         self.phase_ref = phase_ref
+        self.cell = cell
         self._stop = threading.Event()
         self._stalled = False
 
@@ -188,6 +190,10 @@ class _BeatPublisher(threading.Thread):
                    # autoscaler (and trace_aggregate's request-flow
                    # view) can tell a slow DEVICE from a deep queue.
                    "device_ms": self.batcher.metrics.recent_device_ms(),
+                   # Failure domain (--cell): the router prefers a
+                   # request's target cell and logs the crossing when
+                   # it must fail over out of it.
+                   "cell": self.cell,
                    "port": self.port_ref.get("port")})
 
     def run(self) -> None:
@@ -262,7 +268,29 @@ def main_worker(cfg, replica_id: int, fault: Optional[str] = None,
         version=version, replica_id=replica_id)
     holder["engine"] = engine
 
-    store = HeartbeatStore(fleet_dir, process_id=replica_id)
+    # Advertise on the fleet's coordination transport. NET mode talks
+    # to the controller-hosted CoordServer (parallel/net.py) — bounded
+    # timeouts, classified errors, the chaos partition seam; a beat the
+    # transport loses is just a beat the router never sees, the same
+    # silence a crashed worker produces. FILE mode stays the n=1/test
+    # fallback.
+    if getattr(cfg.parallel, "cluster_transport", "file") == "net":
+        from dml_cnn_cifar10_tpu.parallel import net as net_lib
+        net_client = net_lib.CoordClient(
+            fleet_dir, replica_id,
+            timeout_s=cfg.parallel.net_timeout_s,
+            retries=cfg.parallel.net_retries, log_fn=logger.log)
+        store = net_lib.NetHeartbeatStore(fleet_dir, replica_id,
+                                          net_client, log_fn=logger.log)
+    else:
+        store = HeartbeatStore(fleet_dir, process_id=replica_id,
+                               log_fn=logger.log)
+    # Failure-domain assignment is positional — replica i lands in cell
+    # i % len(cells) — so a fleet config names its cells once and every
+    # spawn (autoscaler included) is deterministically placed.
+    cells = [c.strip() for c in (cfg.fleet.cell or "").split(",")
+             if c.strip()] or ["default"]
+    cell = cells[replica_id % len(cells)]
     phase_ref = {"phase": "warmup"}
     port_ref: dict = {}
     parsed_fault = _parse_fault(fault)
@@ -283,7 +311,7 @@ def main_worker(cfg, replica_id: int, fault: Optional[str] = None,
         metrics=metrics, logger=logger)
     beats = _BeatPublisher(store, batcher, engine,
                            cfg.fleet.heartbeat_interval_s, port_ref,
-                           phase_ref)
+                           phase_ref, cell=cell)
     beats.start()
 
     server = ThreadingHTTPServer(

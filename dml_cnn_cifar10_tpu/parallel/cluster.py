@@ -144,11 +144,17 @@ class HeartbeatStore:
     stack. A socket/KV backend can replace it behind the same
     publish/read API."""
 
-    def __init__(self, cluster_dir: str, process_id: int):
+    def __init__(self, cluster_dir: str, process_id: int, log_fn=None):
         self.dir = os.path.join(cluster_dir, "heartbeats")
         self.process_id = process_id
         os.makedirs(self.dir, exist_ok=True)
         self.started_at = time.time()
+        # Telemetry sink for torn/undecodable beats found mid-scan
+        # (read_all). Rate-limited per path: discovery consumers (the
+        # fleet router) scan at poll cadence and one corrupt file must
+        # not flood the stream.
+        self._log = log_fn
+        self._last_decode_note: Dict[str, float] = {}
 
     def _path(self, pid: int) -> str:
         return os.path.join(self.dir, f"proc_{pid}.json")
@@ -182,11 +188,25 @@ class HeartbeatStore:
         return {pid: self.read(pid) for pid in expected
                 if pid != self.process_id}
 
+    def _note_decode(self, path: str, error: str) -> None:
+        if self._log is None:
+            return
+        now = time.time()
+        if now - self._last_decode_note.get(path, 0.0) < 1.0:
+            return
+        self._last_decode_note[path] = now
+        self._log("beat_decode_error", path=path, error=error[:200])
+
     def read_all(self) -> Dict[int, Beat]:
         """Every beat present on disk, keyed by process id — discovery
         for consumers that do NOT know the membership up front (the
         fleet router learns replicas, and their advertised ports, from
-        whoever beats here). Self included; unreadable files skipped."""
+        whoever beats here). Self included. A file that VANISHES
+        mid-scan is a benign rename race and is skipped silently; a
+        file that is present but undecodable (torn/partial write on a
+        non-atomic filesystem) is skipped with a classified
+        ``beat_decode_error`` record — the scan must survive one bad
+        peer, and the stream must say which one."""
         out: Dict[int, Beat] = {}
         try:
             names = os.listdir(self.dir)
@@ -199,9 +219,16 @@ class HeartbeatStore:
                 pid = int(name[len("proc_"):-len(".json")])
             except ValueError:
                 continue
-            beat = self.read(pid)
-            if beat is not None:
-                out[pid] = beat
+            path = os.path.join(self.dir, name)
+            try:
+                with open(path) as f:
+                    text = f.read()
+            except OSError:
+                continue  # mid-rename; self-heals on the next poll
+            try:
+                out[pid] = Beat(**json.loads(text))
+            except (ValueError, TypeError) as e:
+                self._note_decode(path, str(e))
         return out
 
 
@@ -443,7 +470,8 @@ class ClusterMonitor:
                  min_hosts: int = 1, lockstep: bool = False,
                  elastic_expand: bool = False,
                  peer_redundancy: bool = False, replica_keep: int = 2,
-                 logger=None, abort_fn=None):
+                 transport: str = "file", net_timeout_s: float = 5.0,
+                 net_retries: int = 2, logger=None, abort_fn=None):
         self.cluster_dir = cluster_dir
         self.process_id = process_id
         self.min_hosts = min_hosts
@@ -460,9 +488,36 @@ class ClusterMonitor:
         self._stalled = False
         self._last_beat_log = 0.0
         self._last_rejoin_scan = 0.0
-        self.store = HeartbeatStore(cluster_dir, process_id)
-        self.coordinator = RestartCoordinator(cluster_dir,
-                                              log_fn=self.log)
+        # Transport selection (--cluster_transport): the file store is
+        # the n=1/shared-filesystem default; "net" carries the SAME
+        # store/coordinator contracts over parallel/net.py — the lowest
+        # process id hosts the coordination service over cluster_dir,
+        # every process (the host included, via loopback, so one code
+        # path is exercised) talks to it through a bounded, classified,
+        # retrying client.
+        self.net_server = None
+        self.net_client = None
+        if transport == "net":
+            from dml_cnn_cifar10_tpu.parallel import net as net_lib
+            if process_id == 0:
+                self.net_server = net_lib.CoordServer(cluster_dir)
+            self.net_client = net_lib.CoordClient(
+                cluster_dir, process_id, timeout_s=net_timeout_s,
+                retries=net_retries, log_fn=self.log)
+            self.store = net_lib.NetHeartbeatStore(
+                cluster_dir, process_id, self.net_client,
+                log_fn=self.log)
+            self.coordinator = net_lib.NetRestartCoordinator(
+                cluster_dir, self.net_client, log_fn=self.log)
+        elif transport == "file":
+            self.store = HeartbeatStore(cluster_dir, process_id,
+                                        log_fn=self.log)
+            self.coordinator = RestartCoordinator(cluster_dir,
+                                                  log_fn=self.log)
+        else:
+            raise ValueError(
+                f"unknown cluster transport {transport!r} "
+                f"(want 'file' or 'net')")
         # Peer-replica store (ckpt/peerstore.py): rides the monitor so
         # its in-memory payload cache, push thread, and committed-step
         # bookkeeping span supervisor restart attempts — exactly like
@@ -474,7 +529,8 @@ class ClusterMonitor:
                 PeerReplicaStore
             self.peer_store = PeerReplicaStore(
                 cluster_dir, process_id, list(range(num_processes)),
-                keep=replica_keep, log_fn=self.log)
+                keep=replica_keep, log_fn=self.log,
+                client=self.net_client)
         self.watchdog = CollectiveWatchdog(
             self.store, self, straggler_after_s, peer_dead_after_s,
             collective_timeout_s, abort_fn=abort_fn)
@@ -505,6 +561,10 @@ class ClusterMonitor:
             peer_redundancy=getattr(parallel_cfg, "peer_redundancy",
                                     False),
             replica_keep=getattr(parallel_cfg, "replica_keep", 2),
+            transport=getattr(parallel_cfg, "cluster_transport",
+                              "file"),
+            net_timeout_s=getattr(parallel_cfg, "net_timeout_s", 5.0),
+            net_retries=getattr(parallel_cfg, "net_retries", 2),
             logger=logger, abort_fn=abort_fn)
 
     # -- identity / world ------------------------------------------------
@@ -903,3 +963,5 @@ class ClusterMonitor:
             self.peer_store.close()
         self._publisher.join(timeout=2.0)
         self.watchdog.join(timeout=2.0)
+        if self.net_server is not None:
+            self.net_server.stop()

@@ -78,6 +78,25 @@ fire in spec order at the same seam.
   classify it (``decision_corrupt`` telemetry, read as absent), never
   adopt it. Needs a :class:`ClusterMonitor`.
 
+Network-fault kinds (need the NET coordination transport —
+``--cluster_transport net``; they arm ``utils/netfaults.py`` state on
+the coordination service via ``POST /fault``, isolating the INJECTING
+process, and fail loudly on the file transport — there is no network
+to break there):
+
+- ``net_partition`` — this process's link to the coordination service
+  eats replies for ``netfaults.PARTITION_HEAL_S``: beats stop landing,
+  reads come back empty, a decision cannot be committed. The bounded
+  client timeouts turn that into the ordinary ``peer_lost``/eviction
+  paths; under ``--elastic_expand`` the process rejoins when the
+  partition heals.
+- ``net_delay`` — every request answered late for a window (the slow-
+  store drill; bounded re-reads, not hangs).
+- ``net_drop`` — every second request 503s for a window (lossy link;
+  the client's bounded retries absorb it).
+- ``net_dup`` — writes applied twice for a window (duplicate delivery;
+  atomic-replace commits make it invisible).
+
 :class:`FaultSchedule` is the seeded sampler over this vocabulary the
 chaos campaign driver (``tools/chaos.py``) uses: the same seed always
 yields the same compound-fault schedule.
@@ -99,7 +118,12 @@ from typing import List, Optional, Sequence
 FAULT_KINDS = ("nan", "ckpt_corrupt", "sigterm", "data_stall",
                "heartbeat_stall", "host_lost", "collective_hang",
                "host_return", "decision_corrupt", "replica_corrupt",
-               "replica_stale")
+               "replica_stale", "net_partition", "net_delay",
+               "net_drop", "net_dup")
+
+#: The network-fault subset (armed server-side via utils/netfaults.py;
+#: needs --cluster_transport net).
+NET_FAULT_KINDS = ("net_partition", "net_delay", "net_drop", "net_dup")
 
 #: Recovery-path seams a fault may be phase-qualified to
 #: (``kind@phase``). The seams are supervisor-owned: ``restore`` fires
@@ -407,6 +431,19 @@ CHAOS_RUNTIME_VOCABULARY = (
     "ckpt_corrupt@restore", "data_stall@restore",
 )
 
+#: Vocabulary for the 2-process ``net_partition`` scenario's SERVER
+#: seat (the partitioned seat carries the ``net_partition`` backbone):
+#: the expand vocabulary — the partitioned peer rejoins through the
+#: same elastic-expand arc, so the same exclusions apply — plus the
+#: recoverable link faults (delay/drop/dup) on the coordination
+#: service's own loopback link. ``net_partition`` itself is NOT
+#: sampled: partitioning the seat that HOSTS the coordination service
+#: is a liveness torture test (its own held loopback requests), not a
+#: recovery property this scenario fuzzes.
+CHAOS_NET_VOCABULARY = CHAOS_EXPAND_VOCABULARY + (
+    "net_delay@step", "net_drop@step", "net_dup@step",
+)
+
 
 @dataclasses.dataclass
 class FaultSchedule:
@@ -584,6 +621,24 @@ class FaultInjector:
                     continue  # no committed replica yet — stay pending
                 ev.fired = True
                 self._log(logger, step, ev.kind, path=paths[0])
+            elif ev.kind in NET_FAULT_KINDS:
+                client = getattr(cluster, "net_client", None) \
+                    if cluster is not None else None
+                if client is None:
+                    raise InjectedFault(
+                        f"{ev.kind} injection needs --cluster_transport "
+                        f"net (no network between the file store and "
+                        f"its directory to break)")
+                ev.fired = True
+                # Arm ON the coordination service, isolating THIS
+                # process — the arm request must land before the fault
+                # takes effect, which is why the injecting seat is the
+                # isolated one.
+                rec = client.post_fault(ev.kind,
+                                        isolate=[cluster.process_id])
+                self._log(logger, step, ev.kind,
+                          isolate=rec.get("isolate"),
+                          duration_s=rec.get("duration_s"))
             elif ev.kind == "host_return":
                 if cluster is None:
                     raise InjectedFault(

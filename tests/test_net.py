@@ -1,0 +1,602 @@
+"""Partition-tolerant coordination transport (parallel/net.py), the
+deterministic network-fault proxy (utils/netfaults.py), and the cell
+layer it feeds (router cell routing, --target_cell loadgen): the
+transport must degrade CLASSIFIED — timeout / unreachable / http_<code>
+/ proto, never a hang — and every store contract over it must read as
+*absence*, not error, so the existing liveness machinery (stale beats,
+missing decisions) handles a partition without new failure modes."""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dml_cnn_cifar10_tpu.parallel import cluster as cluster_lib
+from dml_cnn_cifar10_tpu.parallel import net as net_lib
+from dml_cnn_cifar10_tpu.utils import backoff
+from dml_cnn_cifar10_tpu.utils import netfaults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeLogger:
+    def __init__(self):
+        self.records = []
+
+    def log(self, kind, **fields):
+        self.records.append({"kind": kind, **fields})
+
+    def flush(self):
+        pass
+
+    def kinds(self):
+        return [r["kind"] for r in self.records]
+
+
+@pytest.fixture(autouse=True)
+def _clean_netfaults():
+    netfaults.clear()
+    yield
+    netfaults.clear()
+
+
+@pytest.fixture
+def served(tmp_path):
+    """A CoordServer over a tmp dir + a loopback client for pid 0."""
+    server = net_lib.CoordServer(str(tmp_path))
+    client = net_lib.CoordClient(str(tmp_path), 0, timeout_s=2.0,
+                                 retries=1)
+    yield str(tmp_path), server, client
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# transport KV contract
+# ---------------------------------------------------------------------------
+
+def test_transport_kv_roundtrip_lands_on_serving_disk(served):
+    root, server, client = served
+    assert client.healthz()
+    assert client.get("a/b.json") is None          # 404 → absent
+    client.put("a/b.json", b'{"x": 1}')
+    assert client.get("a/b.json") == b'{"x": 1}'
+    # Same artifacts: the bytes land in the server's directory layout,
+    # so tools/-side consumers stay transport-blind.
+    with open(os.path.join(root, "a", "b.json"), "rb") as f:
+        assert f.read() == b'{"x": 1}'
+    client.put("a/c.json", b"2")
+    assert sorted(client.list_dir("a")) == ["b.json", "c.json"]
+    assert client.scan("a") == {"b.json": '{"x": 1}', "c.json": "2"}
+    client.rename("a/c.json", "a/d.json")
+    assert client.get("a/c.json") is None
+    assert client.get("a/d.json") == b"2"
+    client.delete("a/d.json")
+    assert client.get("a/d.json") is None
+    client.delete_tree("a")
+    assert client.list_dir("a") == []
+
+
+def test_transport_rejects_path_escape(served):
+    _, _, client = served
+    with pytest.raises(net_lib.TransportError) as e:
+        client.get("../outside.txt")
+    assert e.value.reason.startswith("http_")
+
+
+def test_unreachable_classifies_and_stores_read_absent(tmp_path):
+    """No server at the advertised address: every request classifies
+    `unreachable` after its bounded budget; the store contracts map
+    that to silence (None / {}), never an exception."""
+    with open(os.path.join(str(tmp_path), net_lib.ADDR_FILENAME),
+              "w") as f:
+        json.dump({"host": "127.0.0.1", "port": 1}, f)
+    client = net_lib.CoordClient(str(tmp_path), 0, timeout_s=0.3,
+                                 retries=0, resolve_grace_s=0.0)
+    with pytest.raises(net_lib.TransportError) as e:
+        client.put("x", b"1")
+    assert e.value.reason == "unreachable"
+    store = net_lib.NetHeartbeatStore(str(tmp_path), 0, client)
+    assert store.publish(1, "train") is not None   # swallowed, silent
+    assert store.read(1) is None
+    assert store.read_all() == {}
+    assert not client.healthz()
+
+
+def test_partition_classifies_timeout_within_bound(served):
+    """An armed partition HOLDS the isolated pid's connections; the
+    client-side socket timeout is the only thing that unsticks it —
+    classified `timeout`, inside the budget, not a hang."""
+    root, _, _ = served
+    iso = net_lib.CoordClient(root, 7, timeout_s=0.3, retries=1)
+    netfaults.arm("net_partition", [7], duration_s=30.0)
+    t0 = time.time()
+    with pytest.raises(net_lib.TransportError) as e:
+        iso.get("anything")
+    elapsed = time.time() - t0
+    assert e.value.reason == "timeout"
+    # 2 attempts x 0.3s + one bounded backoff sleep, with slack.
+    assert elapsed < 3.0
+    # Other pids sail through the same server.
+    ok = net_lib.CoordClient(root, 3, timeout_s=2.0, retries=0)
+    ok.put("fine", b"1")
+    assert ok.get("fine") == b"1"
+
+
+def test_partition_auto_heals(served):
+    root, _, client = served
+    netfaults.arm("net_partition", [0], duration_s=0.3)
+    fast = net_lib.CoordClient(root, 0, timeout_s=0.2, retries=0)
+    with pytest.raises(net_lib.TransportError):
+        fast.get("x")
+    time.sleep(0.4)
+    assert netfaults.active() == []                # expired + pruned
+    client.put("x", b"1")                          # healed: works again
+    assert client.get("x") == b"1"
+
+
+def test_net_telemetry_records_are_classified_and_rate_limited(served):
+    root, _, _ = served
+    log = FakeLogger()
+    client = net_lib.CoordClient(root, 0, timeout_s=2.0, retries=0,
+                                 log_fn=log.log)
+    client.put("k", b"v")
+    client.get("k")
+    with open(os.path.join(root, net_lib.ADDR_FILENAME), "w") as f:
+        json.dump({"host": "127.0.0.1", "port": 1}, f)
+    bad = net_lib.CoordClient(root, 0, timeout_s=0.2, retries=0,
+                              log_fn=log.log, resolve_grace_s=0.0)
+    for _ in range(5):                             # rate-limited to 1
+        with pytest.raises(net_lib.TransportError):
+            bad.get("k")
+    nets = [r for r in log.records if r["kind"] == "net"]
+    assert all(set(("op", "ok", "ms", "attempts")) <= set(r)
+               for r in nets)
+    oks = [r for r in nets if r["ok"]]
+    fails = [r for r in nets if not r["ok"]]
+    assert oks and oks[0]["status"] == 200 and oks[0]["error"] is None
+    assert len(fails) == 1                         # 5 failures, 1 record
+    assert fails[0]["error"] == "unreachable"
+
+
+# ---------------------------------------------------------------------------
+# degraded-network drills: delay / drop / dup
+# ---------------------------------------------------------------------------
+
+def test_net_delay_adds_latency_inside_the_budget(served):
+    root, _, client = served
+    client.put("k", b"v")
+    t0 = time.time()
+    assert client.get("k") == b"v"
+    base = time.time() - t0
+    netfaults.arm("net_delay", [0], duration_s=5.0)
+    t0 = time.time()
+    assert client.get("k") == b"v"                 # slower, still fine
+    assert time.time() - t0 >= base + 0.2
+
+
+def test_net_drop_is_absorbed_by_the_retry_budget(served):
+    """Drop 503s every 2nd request inside its window — the bounded
+    retry budget absorbs it, so coordination completes unchanged."""
+    assert netfaults.server_action(2) == ("ok",)
+    netfaults.arm("net_drop", [2], duration_s=60.0)
+    acts = [netfaults.server_action(2) for _ in range(6)]
+    assert acts.count(("drop",)) == 3              # deterministic: 2nd
+    root, _, _ = served
+    client = net_lib.CoordClient(root, 2, timeout_s=2.0, retries=2)
+    for i in range(6):
+        client.put(f"k{i}", b"v")
+        assert client.get(f"k{i}") == b"v"
+
+
+def test_net_dup_is_harmless_under_atomic_commit(served):
+    root, _, _ = served
+    netfaults.arm("net_dup", [4], duration_s=60.0)
+    client = net_lib.CoordClient(root, 4, timeout_s=2.0, retries=0)
+    client.put("dup.json", b"payload")
+    assert client.get("dup.json") == b"payload"
+    with open(os.path.join(root, "dup.json"), "rb") as f:
+        assert f.read() == b"payload"
+
+
+def test_netfaults_unknown_kind_fails_loudly():
+    with pytest.raises(ValueError):
+        netfaults.arm("net_typo", [0])
+
+
+# ---------------------------------------------------------------------------
+# store contracts over the transport
+# ---------------------------------------------------------------------------
+
+def test_net_heartbeat_store_matches_file_store(served):
+    root, _, client = served
+    net_store = net_lib.NetHeartbeatStore(root, 0, client)
+    net_store.publish(5, "train", extra={"port": 9, "cell": "cella"})
+    # The file store over the SAME dir sees the beat — same artifacts.
+    file_store = cluster_lib.HeartbeatStore(root, 1)
+    file_store.publish(3, "serve")
+    beats = net_store.read_all()
+    assert set(beats) == {0, 1}
+    assert beats[0].step == 5 and beats[0].phase == "train"
+    assert beats[0].extra == {"port": 9, "cell": "cella"}
+    assert net_store.read(1).step == 3
+    file_beats = file_store.read_all()
+    assert set(file_beats) == {0, 1} and file_beats[0].step == 5
+    assert net_store.read_peers([0, 1]).keys() == {1}
+
+
+def test_beat_decode_error_classified_on_both_transports(served):
+    """A torn/corrupt beat file reads as ABSENT for that poll with a
+    classified beat_decode_error record — on the file store and on the
+    net store — so a flaky writer degrades to a stale heartbeat, never
+    a monitor crash."""
+    root, _, client = served
+    log = FakeLogger()
+    client.put("heartbeats/proc_2.json", b'{"torn')
+    good = net_lib.NetHeartbeatStore(root, 0, client, log_fn=log.log)
+    good.publish(1, "train")
+    beats = good.read_all()
+    assert set(beats) == {0}                       # torn one skipped
+    nerrs = [r for r in log.records
+             if r["kind"] == "beat_decode_error"]
+    assert nerrs and "proc_2" in nerrs[0]["path"] and nerrs[0]["error"]
+
+    flog = FakeLogger()
+    fstore = cluster_lib.HeartbeatStore(root, 1, log_fn=flog.log)
+    assert set(fstore.read_all()) == {0}
+    ferrs = [r for r in flog.records
+             if r["kind"] == "beat_decode_error"]
+    assert ferrs and "proc_2" in ferrs[0]["path"]
+
+
+def _decision(epoch, survivors=(0,)):
+    return cluster_lib.RestartDecision(
+        epoch=epoch, world_size=len(survivors), restore_step=10,
+        survivors=list(survivors), kind="shrink", source="disk")
+
+
+def test_net_coordinator_sidecar_monotone_and_corruption(served):
+    root, _, client = served
+    log = FakeLogger()
+    coord = net_lib.NetRestartCoordinator(root, client, log_fn=log.log)
+    assert coord.read() is None
+    coord.record(_decision(1, (0, 1)))
+    d = coord.read()
+    assert d.epoch == 1 and d.survivors == [0, 1]
+    # The decision + sidecar land in the file coordinator's layout.
+    assert os.path.exists(os.path.join(root, "restart_decision.json"))
+    # Decision race, included seat: a re-record at a stale epoch ADOPTS
+    # the committed decision instead of racing (or crashing on) it.
+    adopted = coord.record(_decision(1, (0,)))
+    assert adopted.epoch == 1 and adopted.survivors == [0, 1]
+    # Decision race, excluded seat (the healed partition minority):
+    # the committed file wins — classified eviction, fence/rejoin.
+    loser = net_lib.NetRestartCoordinator(
+        root, net_lib.CoordClient(root, 9, timeout_s=2.0, retries=0))
+    with pytest.raises(cluster_lib.EvictedError) as race:
+        loser.record(_decision(1, (9,)))
+    assert "decision race lost" in str(race.value)
+    # Corrupt the payload under a stale sidecar: the digest check
+    # classifies it and the decision reads as ABSENT, never adopted.
+    client.put("restart_decision.json", b'{"epoch": 99}')
+    assert coord.read() is None
+    assert "decision_corrupt" in log.kinds()
+    # await_decision's bounded poll degrades to the classified
+    # coordinator-lost failure on absence — same contract as the file
+    # coordinator, never a hang.
+    with pytest.raises(cluster_lib.PeerLostError):
+        coord.await_decision(2, timeout_s=0.2)
+
+
+def test_record_under_partition_raises_evicted(served):
+    """A host that cannot reach coordination must not believe its own
+    restart decision: record() maps the classified transport failure to
+    EvictedError — the fence (or, under --elastic_expand, the rejoin
+    request) the supervisor already knows how to run."""
+    root, _, _ = served
+    client = net_lib.CoordClient(root, 3, timeout_s=0.2, retries=0)
+    coord = net_lib.NetRestartCoordinator(root, client)
+    netfaults.arm("net_partition", [3], duration_s=30.0)
+    with pytest.raises(cluster_lib.EvictedError) as e:
+        coord.record(_decision(1, (3,)))
+    assert "fencing" in str(e.value)
+    assert coord.read() is None                    # reads: silence
+
+
+# ---------------------------------------------------------------------------
+# decision adoption under a slow store (satellite: bounded re-read)
+# ---------------------------------------------------------------------------
+
+class _SlowChasingCoordinator:
+    """read() is slow AND returns an ever-newer epoch each call — the
+    worst case for the seam check: a chief writing again while we read."""
+
+    def __init__(self, start_epoch, survivors, sleep_s=0.05,
+                 chase=True):
+        self.epoch = start_epoch
+        self.survivors = survivors
+        self.sleep_s = sleep_s
+        self.chase = chase
+        self.reads = 0
+
+    def read(self):
+        self.reads += 1
+        time.sleep(self.sleep_s)
+        d = _decision(self.epoch, self.survivors)
+        if self.chase:
+            self.epoch += 1
+        return d
+
+
+class _Disarmable:
+    def disarm(self):
+        pass
+
+
+def test_check_evicted_bounded_rereads_under_slow_chasing_store():
+    """The included-at-a-newer-epoch seam path re-reads the decision
+    with BOUNDED backoff (3 re-reads, utils/backoff.py) and then acts —
+    a store that is slow and perpetually newer must not turn the seam
+    check into a hang."""
+    log = FakeLogger()
+    stub = type("Stub", (), {})()
+    stub.coordinator = _SlowChasingCoordinator(5, [0, 1])
+    stub.epoch = 1
+    stub.process_id = 0
+    stub.log = log.log
+    stub.watchdog = _Disarmable()
+    t0 = time.time()
+    with pytest.raises(cluster_lib.PeerLostError):
+        cluster_lib.ClusterMonitor.check_evicted(stub, step=20)
+    elapsed = time.time() - t0
+    # Initial read + exactly 3 bounded re-reads, never more.
+    assert stub.coordinator.reads == 1 + 3
+    # Sleeps are the pinned plan: delay_s(0.02, 0.2, 1..3) + 4 slow
+    # reads — comfortably under a second, nowhere near a poll loop.
+    budget = sum(backoff.delay_s(0.02, 0.2, a) for a in (1, 2, 3))
+    assert elapsed < budget + 4 * 0.05 + 1.0
+    assert log.records[-1]["reason"] == "stale_epoch"
+
+
+def test_check_evicted_settles_early_when_epoch_stabilizes():
+    log = FakeLogger()
+    stub = type("Stub", (), {})()
+    stub.coordinator = _SlowChasingCoordinator(5, [1], chase=False)
+    stub.epoch = 1
+    stub.process_id = 0                            # excluded → fence
+    stub.log = log.log
+    stub.watchdog = _Disarmable()
+    with pytest.raises(cluster_lib.EvictedError):
+        cluster_lib.ClusterMonitor.check_evicted(stub, step=20)
+    assert stub.coordinator.reads == 1             # no re-read churn
+    assert log.records[-1]["reason"] == "evicted"
+
+
+# ---------------------------------------------------------------------------
+# cells: router preference, crossing records, data-plane partition
+# ---------------------------------------------------------------------------
+
+class _FakeWorker:
+    """A real HTTP /predict endpoint so the router's socket path runs."""
+
+    def __init__(self, version="7"):
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                outer.hits += 1
+                outer.headers.append(dict(self.headers))
+                body = json.dumps({"version": version,
+                                   "class": 0}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.hits = 0
+        self.headers = []
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _beat(store, port, cell, step=0):
+    store.publish(step, "serve", extra={"port": port, "version": "7",
+                                        "queue_depth": 0, "cell": cell})
+
+
+def test_router_prefers_cell_and_logs_crossings(tmp_path):
+    from dml_cnn_cifar10_tpu.fleet import router as router_lib
+    wa, wb = _FakeWorker(), _FakeWorker()
+    try:
+        log = FakeLogger()
+        _beat(cluster_lib.HeartbeatStore(str(tmp_path), 0), wa.port,
+              "cella")
+        _beat(cluster_lib.HeartbeatStore(str(tmp_path), 1), wb.port,
+              "cellb")
+        r = router_lib.Router(str(tmp_path), dead_after_s=5.0,
+                              logger=log, route_backoff_s=0.0)
+        views = {v.replica_id: v for v in r.live()}
+        assert views[0].cell == "cella" and views[1].cell == "cellb"
+        # In-cell requests stay in-cell: no crossing records.
+        for _ in range(4):
+            status, payload = r.proxy_predict(b"x", target_cell="cellb")
+            assert status == 200 and payload["replica_id"] == 1
+        assert "cell_route" not in log.kinds()
+        # healthz advertises the placement.
+        assert r.healthz()["replicas"]["0"]["cell"] == "cella"
+        # No target_cell: the pre-cell routing, both replicas in play.
+        hit = {r.proxy_predict(b"x")[1]["replica_id"]
+               for _ in range(6)}
+        assert hit == {0, 1}
+        # Cell with no live replica: fail over out of it, log the
+        # crossing, answer the request anyway.
+        status, payload = r.proxy_predict(b"x", target_cell="cellz")
+        assert status == 200
+        routes = [x for x in log.records if x["kind"] == "cell_route"]
+        assert routes and routes[0]["from_cell"] == "cellz"
+        assert routes[0]["to_cell"] in ("cella", "cellb")
+        assert routes[0]["replica_id"] == payload["replica_id"]
+    finally:
+        wa.stop()
+        wb.stop()
+
+
+def test_router_partition_evicts_instantly_with_spaced_retries(
+        tmp_path):
+    """A replica the armed partition isolates is failed WITHOUT dialing
+    the socket that would hang, evicted with its own classified reason,
+    and consecutive failed attempts are spaced by the bounded
+    route_backoff_s exponential."""
+    from dml_cnn_cifar10_tpu.fleet import router as router_lib
+    log = FakeLogger()
+    _beat(cluster_lib.HeartbeatStore(str(tmp_path), 0), 1111, "cella")
+    _beat(cluster_lib.HeartbeatStore(str(tmp_path), 1), 2222, "cellb")
+    r = router_lib.Router(str(tmp_path), dead_after_s=5.0, logger=log,
+                          route_retries=2, route_backoff_s=0.1)
+    netfaults.arm("net_partition", [0, 1], duration_s=30.0)
+    t0 = time.time()
+    status, payload = r.proxy_predict(b"x")
+    elapsed = time.time() - t0
+    assert status == 503 and payload == {"shed": "no_live_replicas"}
+    reasons = [x["reason"] for x in log.records
+               if x["kind"] == "peer_lost"]
+    assert reasons == ["replica_evicted_partitioned"] * 2
+    # Two failed attempts → two backoff sleeps (0.1, 0.2); instant
+    # otherwise — nowhere near a route_timeout_s socket burn.
+    assert 0.25 <= elapsed < 5.0
+
+
+def test_loadgen_target_cell_header(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "loadgen", os.path.join(REPO, "tools", "loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+    w = _FakeWorker()
+    try:
+        c = loadgen._HttpClient(f"http://127.0.0.1:{w.port}",
+                                target_cell="cellb")
+        assert c.predict(b"x") == ("ok", "7")
+        assert w.headers[-1].get("X-Dml-Cell") == "cellb"
+        plain = loadgen._HttpClient(f"http://127.0.0.1:{w.port}")
+        assert plain.predict(b"x") == ("ok", "7")
+        assert "X-Dml-Cell" not in w.headers[-1]
+    finally:
+        w.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing, schema lint, report section
+# ---------------------------------------------------------------------------
+
+def test_cli_transport_and_cell_flags_plumb_to_config():
+    from dml_cnn_cifar10_tpu.cli.main import build_parser, \
+        config_from_args
+    p = build_parser()
+    cfg = config_from_args(p.parse_args([]))
+    assert cfg.parallel.cluster_transport == "file"   # default intact
+    assert cfg.fleet.cell == "default"
+    cfg = config_from_args(p.parse_args(
+        ["--cluster_transport", "net", "--net_timeout_s", "1.5",
+         "--net_retries", "7", "--cell", "cella,cellb"]))
+    assert cfg.parallel.cluster_transport == "net"
+    assert cfg.parallel.net_timeout_s == 1.5
+    assert cfg.parallel.net_retries == 7
+    assert cfg.fleet.cell == "cella,cellb"
+
+
+def _net_stream():
+    return [
+        {"kind": "net", "t": 0.1, "task": 0, "op": "put", "ok": True,
+         "ms": 1.2, "attempts": 1, "status": 200, "error": None,
+         "wallclock": 1.0},
+        {"kind": "net", "t": 0.2, "task": 1, "op": "get", "ok": False,
+         "ms": 600.0, "attempts": 3, "status": None,
+         "error": "timeout", "wallclock": 2.0},
+        {"kind": "fault", "t": 0.3, "task": 1, "step": 15,
+         "fault": "net_partition", "injected": True, "isolate": [1],
+         "duration_s": 6.0},
+        {"kind": "cell_route", "t": 0.4, "task": -1,
+         "from_cell": "cellb", "to_cell": "cella", "replica_id": 0,
+         "attempt": 1},
+        {"kind": "beat_decode_error", "t": 0.5, "task": 0,
+         "path": "heartbeats/proc_2.json", "error": "torn"},
+    ]
+
+
+def test_new_kinds_pass_schema_lint(tmp_path):
+    from tools import check_jsonl_schema as lint
+    good = tmp_path / "good.jsonl"
+    good.write_text("\n".join(json.dumps(r) for r in _net_stream())
+                    + "\n")
+    assert lint.check_file(str(good), strict=True) == []
+    for kind in ("net", "cell_route", "beat_decode_error"):
+        assert kind in lint.list_kinds()
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"kind": "net", "t": 0.1, "task": 0,
+                               "op": "put"}) + "\n")   # missing `ok`
+    assert lint.check_file(str(bad), strict=True) != []
+
+
+def test_report_network_health_section_text_and_json(tmp_path):
+    from tools import telemetry_report
+    path = tmp_path / "run.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in _net_stream())
+                    + "\n")
+    out = telemetry_report.summarize(str(path))
+    assert "network health:" in out
+    assert "timeout" in out and "net_partition" in out
+    doc = telemetry_report.summarize_json(str(path))
+    net = doc["network"]
+    assert net["ops"]["put"]["ok"] == 1
+    assert net["ops"]["get"]["failed"] == 1
+    assert net["errors"] == {"timeout": 1}
+    assert net["partitions"][0]["fault"] == "net_partition"
+    assert net["cell_routes"]["count"] == 1
+    assert net["cell_routes"]["crossings"] == {"cellb->cella": 1}
+    assert net["beat_decode_errors"] == 1
+    # A pre-transport stream renders byte-identical: no section, no key.
+    plain = tmp_path / "plain.jsonl"
+    plain.write_text(json.dumps(
+        {"kind": "done", "t": 1.0, "task": 0, "step": 10,
+         "images_per_sec": 1.0}) + "\n")
+    assert "network health" not in telemetry_report.summarize(
+        str(plain))
+    assert "network" not in telemetry_report.summarize_json(str(plain))
+
+
+# ---------------------------------------------------------------------------
+# the 2-process lockstep sim over the net transport (acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_net_partition_sim_heals_and_ends_bit_identical(tmp_path):
+    """The chaos net_partition drill as one pinned schedule: a 2-seat
+    lockstep run over --cluster_transport net, seat 1 partitioned at
+    step 15 (plus a degraded-network fault on the survivor), the split
+    classified, the world shrunk, the heal rejoined via the expand
+    path — both seats exit 0 and end bit-identical to the fault-free
+    reference."""
+    from tools import chaos as chaos_lib
+
+    from dml_cnn_cifar10_tpu.utils import faults as faults_lib
+    harness = chaos_lib.ChaosHarness(str(tmp_path / "chaos"))
+    r = harness.run_schedule(
+        faults_lib.parse_fault_spec("net_delay@20"), "net_partition",
+        tag="netsim")
+    assert r.ok, r.invariant
+    assert r.injected.get("net_partition") == 1
+    assert r.injected.get("net_delay") == 1

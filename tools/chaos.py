@@ -45,13 +45,33 @@ Usage::
     python tools/chaos.py --seeds 4 --scenario peer_recovery  # diskless-restore sims
     python tools/chaos.py --seeds 4 --scenario runtime  # --mode run (train+serve) sims
     python tools/chaos.py --seeds 4 --scenario autopilot  # alert->remediation sims
+    python tools/chaos.py --seeds 4 --scenario net_partition  # partition/heal sims
 
 Exit 1 when any schedule violates an invariant. ``--plant
 no_decision_sidecar`` reverts the RestartCoordinator sidecar check
 inside the workers (a named regression drill: the campaign must catch
 it and shrink the failure to its ``decision_corrupt`` core);
 ``--plant no_autopilot_policy`` disarms the autopilot's rollback
-policy (the autopilot campaign must catch the un-remediated alert).
+policy (the autopilot campaign must catch the un-remediated alert);
+``--plant no_net_timeout`` strips the net transport's per-request
+socket timeout (the ``net_partition`` campaign must catch the hang as
+a deadline-invariant hole — run it with a reduced ``--deadline_s``,
+the failing probes each run to the deadline).
+
+The ``net_partition`` scenario runs the 2-process lockstep sim over
+the NET coordination transport (``--cluster_transport net``,
+``parallel/net.py``): task 0 hosts the coordination service and is
+fuzzed with the net vocabulary (delay/drop/dup on top of the expand
+kinds), task 1 carries a ``net_partition@15`` backbone that cuts it
+off from the service mid-run. The partitioned seat must classify the
+silence (``peer_lost``), the majority side keeps the chief and shrinks,
+the partition heals (``utils/netfaults.py`` PARTITION_HEAL_S) and the
+cut-off seat rejoins through the PR-7 elastic-expand arc — BOTH seats
+must finish bit-identical to the fault-free reference. Each
+``net_partition`` campaign also runs ONE fleet-under-partition sim:
+a 2-cell fleet with one cell's worker isolated must shed every tagged
+request to the reachable cell with zero client failures (``cell_route``
+records on the stream, all streams schema-strict).
 
 The ``autopilot`` scenario is the ``runtime`` sim with the autopilot
 armed (``--autopilot``) and a guaranteed ``nan@12`` backbone fault:
@@ -121,6 +141,20 @@ def _no_rollback():
             if p.action != "rollback"]
 _ap.default_policies = _no_rollback
 """,
+    # Strip the net transport's per-request socket timeout: every
+    # request waits forever, so a partition's held connection is a HANG
+    # instead of a classified timeout — the net_partition campaign must
+    # catch it as a deadline-invariant failure and shrink it to its
+    # net_partition core. (timeout_s=None is the client's explicit
+    # no-timeout mode; _DEFAULT means "use the configured bound".)
+    "no_net_timeout": """
+from dml_cnn_cifar10_tpu.parallel import net as _net
+_orig_request = _net.CoordClient._request
+def _unbounded_request(self, method, path, body=None,
+                       timeout_s=_net._DEFAULT):
+    return _orig_request(self, method, path, body=body, timeout_s=None)
+_net.CoordClient._request = _unbounded_request
+""",
 }
 
 # One worker script serves every scenario: task 0 is the seat under
@@ -179,6 +213,14 @@ if cluster_dir:
     cfg.parallel.straggler_after_s = 0.4
     cfg.parallel.peer_dead_after_s = 2.5
     cfg.parallel.collective_timeout_s = 300.0
+    if os.environ.get("DML_CHAOS_NET"):
+        # net_partition scenario: coordinate over the socket transport
+        # (task 0 hosts the service). Tight timeouts keep a partitioned
+        # read's cost at ~1.5s so the peer_lost/rejoin arc fits the
+        # sim's step budget.
+        cfg.parallel.cluster_transport = "net"
+        cfg.parallel.net_timeout_s = 0.5
+        cfg.parallel.net_retries = 2
 
 if os.environ.get("DML_CHAOS_RUNTIME") \
         or os.environ.get("DML_CHAOS_AUTOPILOT"):
@@ -235,6 +277,16 @@ CLUSTER_BACKBONE = "host_lost@15"
 EXPAND_BACKBONE = "host_lost@15"
 EXPAND_HOLD = "host_return@18"
 
+#: The net_partition scenario's backbone on task 1: cut off from the
+#: coordination service at step 15, heal after
+#: ``netfaults.PARTITION_HEAL_S``, rejoin through the elastic-expand
+#: arc. Task 0 (the service host) meanwhile holds step 18 until the
+#: rejoin lands — without the hold it would checkpoint world-shrunk
+#: solo progress past the shared restore point and break bit-identity
+#: (the same choreography as the expand scenario).
+NET_BACKBONE = "net_partition@15"
+NET_HOLD = "host_return@18"
+
 #: The autopilot scenario's guaranteed fault: every schedule carries a
 #: nan so the nonfinite_burst alert fires and the remediation loop is
 #: exercised on every run (a sampled schedule with no alert-provoking
@@ -254,7 +306,7 @@ AUTOPILOT_TAIL_STEPS = 60
 #: restore must be BIT-IDENTICAL to a disk restore, which the shared
 #: oracle pins for free.
 REF_ALIAS = {"expand": "train", "peer_recovery": "train",
-             "runtime": "train"}
+             "runtime": "train", "net_partition": "train"}
 
 #: Scenarios that run the 2-process shrink drill (task 1 carries the
 #: backbone ``host_lost`` and must exit with its abrupt-death code).
@@ -310,7 +362,8 @@ class ChaosHarness:
     # -- process plumbing -------------------------------------------------
 
     def _spawn(self, args, planted: bool, peer: bool = False,
-               runtime: bool = False, autopilot: bool = False):
+               runtime: bool = False, autopilot: bool = False,
+               net: bool = False):
         env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env.pop("DML_CHAOS_PLANT", None)
@@ -318,12 +371,15 @@ class ChaosHarness:
         env.pop("DML_CHAOS_PEER", None)
         env.pop("DML_CHAOS_RUNTIME", None)
         env.pop("DML_CHAOS_AUTOPILOT", None)
+        env.pop("DML_CHAOS_NET", None)
         if peer:
             env["DML_CHAOS_PEER"] = "1"
         if runtime:
             env["DML_CHAOS_RUNTIME"] = "1"
         if autopilot:
             env["DML_CHAOS_AUTOPILOT"] = "1"
+        if net:
+            env["DML_CHAOS_NET"] = "1"
         if planted and self.plant:
             env["DML_CHAOS_PLANT"] = self.plant
             env["DML_CHAOS_PLANT_CODE"] = PLANTS[self.plant]
@@ -517,6 +573,9 @@ class ChaosHarness:
         if scenario == "expand":
             return self._run_expand(events, spec, run_dir, cluster,
                                     ref, t0)
+        if scenario == "net_partition":
+            return self._run_net_partition(events, run_dir, cluster,
+                                           ref, t0)
         if scenario == "autopilot":
             # Merge the guaranteed alert-provoking backbone into the
             # sampled schedule (skipping exact duplicates so the
@@ -723,6 +782,200 @@ class ChaosHarness:
         return RunResult(True, None, secs, recovery_s=slowest,
                          injected=injected)
 
+    def _run_net_partition(self, events, run_dir: str, cluster: str,
+                           ref: str, t0: float) -> RunResult:
+        """The 2-process partition/heal sim over the net transport:
+        task 0 hosts the coordination service, runs the fuzz schedule
+        plus the step-18 hold; task 1 is cut off at 15 (backbone),
+        classifies the silence, heals after ``PARTITION_HEAL_S``, and
+        rejoins through the elastic-expand arc. Unlike the expand drill
+        there is no corpse and no respawn: the partitioned process
+        stays alive the whole time, so BOTH seats must exit 0 and
+        finish bit-identical to the reference."""
+        logs = [os.path.join(run_dir, f"logs_{t}") for t in (0, 1)]
+        for d in logs:
+            os.makedirs(d, exist_ok=True)
+        hold = faults_lib.parse_fault_spec(NET_HOLD)
+        spec0 = faults_lib.format_fault_spec(list(events) + hold)
+        procs = [self._spawn([0, 2, self.data_dir, logs[0], cluster,
+                              spec0, self.total_steps], planted=True,
+                             net=True),
+                 self._spawn([1, 2, self.data_dir, logs[1], cluster,
+                              NET_BACKBONE, self.total_steps],
+                             planted=True, net=True)]
+        outs, timed_out = [], False
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=self.deadline_s)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+                timed_out = True
+        secs = time.time() - t0
+        if timed_out:
+            return RunResult(False, f"deadline: a process outlived "
+                                    f"{self.deadline_s:.0f}s", secs)
+        for seat, (p, out) in enumerate(zip(procs, outs)):
+            if p.returncode != 0:
+                tail = out.strip().splitlines()[-1][:200] \
+                    if out.strip() else ""
+                return RunResult(
+                    False, f"completed: seat {seat} exit "
+                           f"{p.returncode} ({tail})", secs)
+            res = self._read_result(out)
+            if res is None or res.get("fenced"):
+                return RunResult(
+                    False, f"completed: seat {seat} "
+                           f"{'fenced' if res else 'no RESULT'}", secs)
+            if res["final_step"] != self.total_steps:
+                return RunResult(
+                    False, f"completed: seat {seat} final step "
+                           f"{res['final_step']}", secs)
+            if res["digest"] != ref:
+                return RunResult(
+                    False, f"bit_identical: seat {seat} params differ "
+                           f"from the fault-free reference", secs)
+        injected: Dict[str, int] = {}
+        slowest = 0.0
+        for i, d in enumerate(logs):
+            evs = (list(events) + hold) if i == 0 else \
+                faults_lib.parse_fault_spec(NET_BACKBONE)
+            inv, inj, slow = self._check_stream(
+                os.path.join(d, "metrics.jsonl"), evs, planted=True)
+            if inv is not None:
+                return RunResult(False, inv, secs)
+            for k, v in inj.items():
+                injected[k] = injected.get(k, 0) + v
+            slowest = max(slowest, slow)
+        return RunResult(True, None, secs, recovery_s=slowest,
+                         injected=injected)
+
+    # -- the fleet-under-partition sim (once per net campaign) ------------
+
+    def run_fleet_partition(self) -> Optional[str]:
+        """One 2-cell fleet sim with one cell's worker partitioned off:
+        every request tagged for the isolated cell must still be
+        answered — shed to the reachable cell with a ``cell_route``
+        record, zero client failures — and every stream must stay
+        schema-strict. Returns the first violated invariant or None.
+
+        Runs IN the driver process (the router and the netfault state
+        live here; workers are real subprocesses), which is exactly
+        what lets the harness arm ``utils/netfaults`` around the
+        router's data plane deterministically."""
+        import socket
+        import threading
+
+        import numpy as np
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from dml_cnn_cifar10_tpu.config import DataConfig, TrainConfig
+        from dml_cnn_cifar10_tpu.fleet.controller import main_fleet
+        from dml_cnn_cifar10_tpu.utils import netfaults
+        from tools.loadgen import _HttpClient
+
+        fdir = os.path.join(self.workdir, "fleet_partition")
+        os.makedirs(fdir, exist_ok=True)
+        stream = os.path.join(fdir, "router.jsonl")
+        cfg = TrainConfig(
+            log_dir=os.path.join(fdir, "logs"),
+            metrics_jsonl=stream,
+            data=DataConfig(dataset="synthetic",
+                            data_dir=self.data_dir,
+                            synthetic_train_records=256,
+                            synthetic_test_records=64,
+                            normalize="scale",
+                            use_native_loader=False))
+        cfg.model.logit_relu = False
+        cfg.serve.buckets = (1, 4)
+        cfg.serve.batch_window_ms = 1.0
+        cfg.serve.metrics_every_s = 0.5
+        cfg.serve.drain_deadline_s = 5.0
+        cfg.fleet.dir = os.path.join(fdir, "fleet")
+        # The controller binds but does not write the port back into
+        # the config — reserve a free one up front (test_fleet idiom).
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            cfg.fleet.port = s.getsockname()[1]
+        cfg.fleet.min_replicas = 2
+        cfg.fleet.max_replicas = 2
+        cfg.fleet.heartbeat_interval_s = 0.1
+        cfg.fleet.replica_dead_after_s = 1.5
+        cfg.fleet.metrics_every_s = 0.5
+        cfg.fleet.cell = "cella,cellb"     # replica i -> cell i % 2
+        cfg.parallel.cluster_transport = "net"
+        cfg.parallel.net_timeout_s = 0.5
+        cfg.parallel.net_retries = 2
+        ready, stop = threading.Event(), threading.Event()
+        thread = threading.Thread(
+            target=lambda: main_fleet(cfg, ready_event=ready,
+                                      stop_event=stop),
+            name="chaos-fleet-partition", daemon=True)
+        rng = np.random.default_rng(0)
+        images = rng.integers(0, 256, (4, 32, 32, 3)).astype(np.uint8)
+        deadline = time.time() + self.deadline_s
+        try:
+            thread.start()
+            if not ready.wait(min(60.0, self.deadline_s)):
+                return "deadline: fleet router never became ready"
+            client = _HttpClient(f"http://127.0.0.1:{cfg.fleet.port}",
+                                 target_cell="cellb")
+            # Warm up: wait until the isolated-cell seat itself
+            # answers, so the partition demonstrably takes a WORKING
+            # cell out (and the pre-partition tag routes in-cell).
+            while True:
+                try:
+                    outcome, _ = client.predict(images[0].tobytes())
+                except OSError:
+                    outcome = "connect"    # router/worker still booting
+                if outcome == "ok":
+                    break
+                if time.time() > deadline:
+                    return ("deadline: fleet never served the target "
+                            "cell fault-free")
+                time.sleep(0.5)
+            netfaults.arm("net_partition", isolate=[1], duration_s=60.0)
+            failures = 0
+            for i in range(30):
+                try:
+                    outcome, _ = client.predict(images[i % 4].tobytes())
+                except OSError:
+                    outcome = "connect"
+                if outcome != "ok":
+                    failures += 1
+                if time.time() > deadline:
+                    return ("deadline: partitioned-fleet drive "
+                            "outlived the budget")
+            if failures:
+                return (f"completed: {failures}/30 client requests "
+                        f"failed under partition (want 0)")
+        finally:
+            netfaults.clear()
+            stop.set()
+            thread.join(timeout=60.0)
+        with open(stream) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        routes = [r for r in recs if r.get("kind") == "cell_route"]
+        if not routes:
+            return ("cell_route: partitioned fleet shed cross-cell "
+                    "but logged no cell_route record")
+        bad = [r for r in routes if r.get("from_cell") != "cellb"
+               or r.get("to_cell") == "cellb"]
+        if bad:
+            return (f"cell_route: crossing {bad[0]} does not leave "
+                    f"the partitioned cell")
+        streams = [stream]
+        tdir = os.path.join(cfg.fleet.dir, "telemetry")
+        if os.path.isdir(tdir):
+            streams += [os.path.join(tdir, f)
+                        for f in sorted(os.listdir(tdir))
+                        if f.endswith(".jsonl")]
+        for path in streams:
+            errs = check_jsonl_schema.check_file(path, strict=True)
+            if errs:
+                return f"schema: {errs[0]}"
+        return None
+
     # -- shrinking --------------------------------------------------------
 
     def shrink(self, events: List[faults_lib.FaultEvent], scenario: str,
@@ -777,7 +1030,11 @@ def run_campaign(seeds: Sequence[int], scenario: str, workdir: str,
              # The autopilot sim is the runtime sim with the policy
              # engine armed; the guaranteed nan backbone rides on top
              # of the sampled schedule (run_schedule merges it).
-             "autopilot": faults_lib.CHAOS_RUNTIME_VOCABULARY}[scenario]
+             "autopilot": faults_lib.CHAOS_RUNTIME_VOCABULARY,
+             # net_partition fuzzes the SERVER seat (task 0); the
+             # partition backbone rides task 1. net_partition itself is
+             # excluded from the fuzz vocabulary — see faults.py.
+             "net_partition": faults_lib.CHAOS_NET_VOCABULARY}[scenario]
     results = []
     faults_by_kind: Dict[str, int] = {}
     slowest = 0.0
@@ -820,6 +1077,27 @@ def run_campaign(seeds: Sequence[int], scenario: str, workdir: str,
                     print(f"[chaos]   FAILED: {r.invariant}")
                     print(f"[chaos]   minimal reproducer: "
                           f"--fault_spec \"{reproducer}\"")
+        if scenario == "net_partition" and explicit_spec is None:
+            # Once per campaign (not per seed — the sim is fault-fixed,
+            # only the schedules vary): the 2-cell fleet must shed a
+            # partitioned cell's tagged load to the reachable cell with
+            # zero client failures.
+            if verbose:
+                print("[chaos] fleet-under-partition sim "
+                      "(2 cells, cellb isolated)")
+            t0 = time.time()
+            inv = harness.run_fleet_partition()
+            rec = {"seed": -1, "scenario": scenario,
+                   "spec": "fleet_partition", "ok": inv is None,
+                   "invariant": inv,
+                   "secs": round(time.time() - t0, 2)}
+            if inv is not None:
+                rec["reproducer"] = "fleet_partition"
+            logger.log("chaos", **rec)
+            results.append(rec)
+            if verbose:
+                print(f"[chaos]   {'OK' if inv is None else 'FAILED: '}"
+                      f"{inv or ''} in {rec['secs']:.1f}s")
         summary = {
             "schedules": len(results),
             "passed": sum(1 for r in results if r["ok"]),
@@ -847,7 +1125,7 @@ def main(argv=None) -> int:
     p.add_argument("--scenario", default="train",
                    choices=["train", "cluster", "expand",
                             "peer_recovery", "runtime", "autopilot",
-                            "mixed"],
+                            "net_partition", "mixed"],
                    help="which sim to fuzz: 1-process supervised "
                         "train, the 2-process cluster shrink drill, "
                         "the 2→1→2 elastic-expand drill, the 2-process "
@@ -857,8 +1135,11 @@ def main(argv=None) -> int:
                         "+ in-process serving, publishes must survive "
                         "recovery), the runtime sim with the autopilot "
                         "armed (alerts must be answered by remediation "
-                        "records and return to healthy), or an "
-                        "alternating mix of all of them")
+                        "records and return to healthy), the 2-process "
+                        "partition/heal sim over the net transport "
+                        "(plus one fleet-under-partition sim per "
+                        "campaign), or an alternating mix of all of "
+                        "them")
     p.add_argument("--budget", type=int, default=3,
                    help="faults sampled per schedule")
     p.add_argument("--total_steps", type=int, default=40,
@@ -891,9 +1172,10 @@ def main(argv=None) -> int:
                  "peer_recovery": ["peer_recovery"],
                  "runtime": ["runtime"],
                  "autopilot": ["autopilot"],
+                 "net_partition": ["net_partition"],
                  "mixed": ["train", "cluster", "expand",
                            "peer_recovery", "runtime",
-                           "autopilot"]}[args.scenario]
+                           "autopilot", "net_partition"]}[args.scenario]
     seeds = list(range(args.seed_base, args.seed_base + args.seeds))
     if args.spec is not None:
         seeds = seeds[:1]

@@ -212,6 +212,21 @@ KIND_KEYS = {
     "job": ("job", "jtype", "state"),
     "job_done": ("job", "jtype", "ok", "secs"),
     "publish": ("step", "version", "source", "latency_ms", "swapped"),
+    # Net coordination transport (parallel/net.py): one rate-limited
+    # record per (operation, error) transition — `op` the client call
+    # (publish/read/scan/record/...), `ok` whether it resolved; failed
+    # ops carry the classified `error` reason (timeout, unreachable,
+    # http_<code>, proto) plus attempts/ms, the partition-timeline
+    # input for telemetry_report's network-health section.
+    "net": ("op", "ok"),
+    # Cross-cell failover: the router had to place a request tagged
+    # `from_cell` (X-DML-Cell) onto a replica in `to_cell` because the
+    # target cell had no live replica; always trace-forced.
+    "cell_route": ("from_cell", "to_cell", "replica_id"),
+    # A torn/undecodable heartbeat found mid-scan (HeartbeatStore
+    # .read_all / the net scan): classified and skipped, never raised —
+    # discovery keeps working through one corrupt beat file.
+    "beat_decode_error": ("path", "error"),
 }
 
 

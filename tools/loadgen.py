@@ -156,8 +156,12 @@ class ClientStats:
 class _HttpClient:
     """Blocking POST /predict against a serve worker or fleet router."""
 
-    def __init__(self, target: str):
+    def __init__(self, target: str, target_cell=None):
         self.target = target.rstrip("/")
+        # Cell preference (--target_cell): tagged on every request so
+        # the fleet router prefers that cell's replicas and logs the
+        # cell_route crossing when it must fail over out of it.
+        self.target_cell = target_cell
 
     def predict(self, body: bytes, trace_header=None):
         """("ok", version) | ("shed", None) | ("rejected", None)."""
@@ -165,6 +169,8 @@ class _HttpClient:
         import urllib.request
 
         headers = {"Content-Type": "application/octet-stream"}
+        if self.target_cell:
+            headers["X-DML-Cell"] = self.target_cell
         if trace_header:
             from dml_cnn_cifar10_tpu.utils import reqtrace
             headers[reqtrace.TRACE_HEADER] = trace_header
@@ -287,6 +293,12 @@ def main(argv=None) -> int:
     ap.add_argument("--target", type=str, default=None,
                     help="drive a running --mode serve/fleet HTTP "
                          "endpoint instead of an in-process engine")
+    ap.add_argument("--target_cell", type=str, default=None,
+                    help="tag every request with this fleet cell "
+                         "(X-DML-Cell): the router prefers the cell's "
+                         "live replicas and fails over cross-cell "
+                         "(cell_route record) when it has none; only "
+                         "meaningful with a --target fleet router")
     ap.add_argument("--runtime", type=str, default=None,
                     help="drive the serving head of a live --mode run "
                          "process: a runtime.json path, or the log_dir "
@@ -357,7 +369,7 @@ def main(argv=None) -> int:
     batcher = None
     metrics = None
     if args.target:
-        client = _HttpClient(args.target)
+        client = _HttpClient(args.target, target_cell=args.target_cell)
         rng = np.random.default_rng(args.seed)
         images = rng.integers(
             0, 256, (256, args.image_size, args.image_size, 3),

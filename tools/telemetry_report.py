@@ -322,6 +322,55 @@ def _jobs_summary(records: List[dict]) -> Optional[dict]:
     return {"jobs": by_job, "publish": publish, "lineage": lineage}
 
 
+def _net_summary(records: List[dict]) -> Optional[dict]:
+    """Network-health rollup (parallel/net.py, utils/netfaults.py):
+    per-operation and per-link ok/fail counters with classified error
+    reasons off the rate-limited ``net`` records, the injected
+    partition timeline off ``fault`` records with a ``net_*`` kind,
+    cross-cell failover counts off ``cell_route``, and classified torn
+    beats off ``beat_decode_error``. None when the stream carries none
+    of them — file-transport streams render byte-identical."""
+    nets = [r for r in records if r.get("kind") == "net"]
+    net_faults = [r for r in records if r.get("kind") == "fault"
+                  and str(r.get("fault") or "").startswith("net_")]
+    routes = [r for r in records if r.get("kind") == "cell_route"]
+    torn = [r for r in records
+            if r.get("kind") == "beat_decode_error"]
+    if not nets and not net_faults and not routes and not torn:
+        return None
+    ops: dict = {}
+    errors: dict = {}
+    links: dict = {}
+    for r in nets:
+        op = ops.setdefault(str(r.get("op")), {"ok": 0, "failed": 0})
+        link = links.setdefault(r.get("task"),
+                                {"ok": 0, "failed": 0, "max_ms": 0.0})
+        bucket = "ok" if r.get("ok") else "failed"
+        op[bucket] += 1
+        link[bucket] += 1
+        if isinstance(r.get("ms"), (int, float)):
+            link["max_ms"] = round(max(link["max_ms"], r["ms"]), 1)
+        if not r.get("ok"):
+            err = str(r.get("error"))
+            errors[err] = errors.get(err, 0) + 1
+    crossings: dict = {}
+    for r in routes:
+        key = f"{r.get('from_cell')}->{r.get('to_cell')}"
+        crossings[key] = crossings.get(key, 0) + 1
+    return {
+        "ops": ops,
+        "errors": errors,
+        "links": {str(t): v for t, v in sorted(
+            links.items(), key=lambda kv: str(kv[0]))},
+        "partitions": [
+            {"fault": r.get("fault"), "step": r.get("step"),
+             "task": r.get("task"), "isolate": r.get("isolate"),
+             "duration_s": r.get("duration_s")} for r in net_faults],
+        "cell_routes": {"count": len(routes), "crossings": crossings},
+        "beat_decode_errors": len(torn),
+    }
+
+
 def _fmt_bytes(n: Optional[int]) -> str:
     if not n:
         return "-"
@@ -820,6 +869,41 @@ def summarize_records(records: List[dict], header: str) -> str:
                 f"[{'expand' if r.get('kind') == 'elastic_expand' else 'shrink'}"
                 f"@{r.get('step')}]" for r in transitions)
             lines.append(f"    world-size timeline: {arc}")
+    # Network health (parallel/net.py `net` records + injected net_*
+    # faults + cell_route crossings): what the coordination transport
+    # saw per link, and where the chaos partitions landed.
+    net = _net_summary(records)
+    if net:
+        lines.append("  network health:")
+        if net["ops"]:
+            per = ", ".join(
+                f"{op} {v['ok']} ok / {v['failed']} failed"
+                for op, v in sorted(net["ops"].items()))
+            lines.append(f"    transport ops: {per}")
+        if net["errors"]:
+            per = ", ".join(f"{e}: {n}" for e, n in
+                            sorted(net["errors"].items()))
+            lines.append(f"    classified errors: {per}")
+        for task, v in net["links"].items():
+            lines.append(
+                f"    link proc {task}: {v['ok']} ok / "
+                f"{v['failed']} failed, slowest {v['max_ms']:.1f} ms")
+        for p in net["partitions"]:
+            lines.append(
+                f"    injected {p['fault']} at step {p['step']} "
+                f"(proc {p['task']}, isolate {p['isolate']}, "
+                f"duration {p['duration_s']} s)")
+        if net["cell_routes"]["count"]:
+            per = ", ".join(
+                f"{k}: {n}" for k, n in
+                sorted(net["cell_routes"]["crossings"].items()))
+            lines.append(
+                f"    cross-cell failovers: "
+                f"{net['cell_routes']['count']} ({per})")
+        if net["beat_decode_errors"]:
+            lines.append(
+                f"    torn beats classified: "
+                f"{net['beat_decode_errors']} (beat_decode_error)")
     # Sharded fast-resume breakdown (ckpt/sharded.py `shard_io` rows):
     # how many shard files moved, how many bytes, and the slowest shard
     # — the wall-clock of a concurrent phase is its slowest member.
@@ -993,6 +1077,9 @@ def summarize_json(path: str) -> dict:
                 for r in sorted(transitions,
                                 key=lambda r: (r.get("epoch") or 0))],
         }
+    net = _net_summary(records)
+    if net:
+        out["network"] = net
     hbm = _last(records, "hbm")
     if hbm and hbm.get("available"):
         out["hbm"] = {k: hbm.get(k) for k in
