@@ -327,6 +327,92 @@ def measure(compute_dtype: str, chunk_k: int = 100, chunks: int = 60,
     return row
 
 
+def measure_int8_serve(batch: int = 128, reps: int = 3,
+                       windows: int = 50) -> dict:
+    """Serving-path A/B: the int8 quantized forward
+    (``quant/convert.py`` — int8 ``dot_general``/``conv`` with
+    ``preferred_element_type=int32``, dequant fused into the epilogue)
+    vs the SAME weights served through the float program in bf16
+    compute. Single device, one jitted dispatch per batch — the shape
+    the serving engine's bucket fns execute, without batcher overhead,
+    so the row isolates the numeric path. ``speedup_vs_bf16`` is what
+    ``tools/bench_gate.py`` floors (TPU rows only — XLA's CPU int8
+    lowering has no MXU to win on; the ``backend`` key says which this
+    row is)."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from dml_cnn_cifar10_tpu.config import reference_config
+    from dml_cnn_cifar10_tpu.export import make_variable_serving_fn
+    from dml_cnn_cifar10_tpu.models.registry import get_model
+    from dml_cnn_cifar10_tpu.quant.calibrate import calibrate
+    from dml_cnn_cifar10_tpu.quant.convert import (
+        make_quantized_serving_fn, quantize_params)
+    from dml_cnn_cifar10_tpu.utils.telemetry import percentile
+
+    cfg = reference_config()
+    cfg.data.dataset = "synthetic"
+    cfg.data.data_dir = "/tmp/bench_cifar"
+    cfg.data.synthetic_train_records = 20480
+    cfg.data.synthetic_test_records = 1024
+    cfg.data.use_native_loader = False
+
+    model_def = get_model(cfg.model.name)
+    params = model_def.init(jax.random.key(0), cfg.model, cfg.data)
+    d = cfg.data
+    rng = np.random.default_rng(0)
+    images = rng.integers(
+        0, 256, (512, d.image_height, d.image_width, d.num_channels),
+        dtype=np.uint8)
+    scales = calibrate(params, images[:256], cfg.model, cfg.data,
+                       batch_size=64, num_batches=4)
+    qtree = quantize_params(params, scales)
+    bf16_cfg = dataclasses.replace(cfg.model, compute_dtype="bfloat16")
+    quant_fn = jax.jit(make_quantized_serving_fn(cfg.model, cfg.data))
+    float_fn = jax.jit(make_variable_serving_fn(model_def, bf16_cfg,
+                                                cfg.data))
+    batch_imgs = images[:batch]
+
+    def drive(fn, variables):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(variables, batch_imgs))  # compile
+        compile_s = time.perf_counter() - t0
+        rates, lat_ms = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(windows):
+                out = fn(variables, batch_imgs)
+            jax.block_until_ready(out)
+            rates.append(windows * batch / (time.perf_counter() - t0))
+        for _ in range(min(windows, 30)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(variables, batch_imgs))
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+        return rates, lat_ms, compile_s
+
+    q_rates, q_lat, q_compile = drive(quant_fn, (qtree, None))
+    f_rates, f_lat, _ = drive(float_fn, (params, None))
+    q_med, f_med = statistics.median(q_rates), statistics.median(f_rates)
+    return {
+        "images_per_sec_per_chip": round(q_med, 1),
+        "img_s_min": round(min(q_rates), 1),
+        "img_s_max": round(max(q_rates), 1),
+        "spread_pct": round(
+            100.0 * (max(q_rates) - min(q_rates)) / q_med, 2),
+        "reps": reps,
+        "batch": batch,
+        "compile_s": round(q_compile, 4),
+        "step_ms_p50": round(percentile(q_lat, 50), 4),
+        "step_ms_p99": round(percentile(q_lat, 99), 4),
+        "bf16_images_per_sec_per_chip": round(f_med, 1),
+        "bf16_step_ms_p50": round(percentile(f_lat, 50), 4),
+        "speedup_vs_bf16": round(q_med / f_med, 3),
+        "backend": jax.default_backend(),
+    }
+
+
 def main() -> None:
     # Before any jax backend use: the native persistent compilation
     # cache (the warm start when executable swapping is off — the
@@ -354,6 +440,10 @@ def main() -> None:
         # the new path cannot regress silently.
         "fp32_zero1": measure("float32", chunk_k=100,
                               optimizer_sharding="zero1"),
+        # Serving A/B: the post-training int8 path (docs/QUANT.md) vs
+        # the same weights in bf16 compute. Joins the gate
+        # (tools/bench_gate.py) with a speedup floor on TPU backends.
+        "int8_serve": measure_int8_serve(),
     }
     # Headline = best PARITY config (K=100): the plateau row is reported
     # as data but may not claim the headline — it relaxes the

@@ -134,6 +134,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="p99 latency objective in ms; the fleet "
                         "autoscaler scales up while the replicas' p99 "
                         "sits above it (declarative elsewhere)")
+    p.add_argument("--serve_quantize", type=str, default=None,
+                   choices=["int8"],
+                   help="quantized serving path (docs/QUANT.md): int8 "
+                        "post-training quantization with calibrated "
+                        "scales; served versions carry a '+int8' "
+                        "suffix. Default: float serving")
+    p.add_argument("--quant_calib_batches", type=int, default=4,
+                   help="eval-stream batches the activation "
+                        "calibration observes before quantizing")
+    p.add_argument("--quant_max_delta", type=float, default=0.005,
+                   help="pinned accuracy contract: max allowed "
+                        "(float top-1 - int8 top-1) on the calibration "
+                        "holdout, as a fraction (0.005 = 0.5%%); a "
+                        "candidate beyond it is rejected at publish "
+                        "time (quant_rejected) and float keeps serving")
+    p.add_argument("--serve_cache_size", type=int, default=0,
+                   help="exact-match response cache capacity (entries) "
+                        "keyed by (input digest, version); hits bypass "
+                        "the batcher; flushed on hot-swap. 0 = off")
     # --- unified runtime flags (--mode run; docs/RUNTIME.md) ---
     p.add_argument("--jobs", type=str, default="train,serve",
                    help="--mode run job spec: comma-separated from "
@@ -880,6 +899,10 @@ def config_from_args(args: argparse.Namespace) -> config_lib.TrainConfig:
     cfg.serve.drain_deadline_s = args.serve_drain_deadline_s
     cfg.serve.slo_ms = args.serve_slo_ms
     cfg.serve.trace_sample_rate = args.trace_sample_rate
+    cfg.serve.quantize = args.serve_quantize
+    cfg.serve.quant_calib_batches = args.quant_calib_batches
+    cfg.serve.quant_max_delta = args.quant_max_delta
+    cfg.serve.cache_size = args.serve_cache_size
     cfg.postmortem_dir = args.postmortem_dir
     cfg.flightrec_size = args.flightrec_size
     cfg.autopilot.enabled = args.autopilot
@@ -1015,14 +1038,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         params = state.opt.get("ema", state.params)
         mstate = state.opt.get("ema_mstate", state.model_state) \
             if trainer.model_def.has_state else None
-        blob = export_lib.export_forward(
-            trainer.model_def, cfg.model, cfg.data, params, mstate)
+        if cfg.serve.quantize == "int8":
+            # Quantized export: calibrate on the eval stream, then bake
+            # the int8 weights + scales into the artifact. Default
+            # output name advertises the path (model_int8.jaxexport).
+            # import from the module path: the package re-exports a
+            # `calibrate` FUNCTION that shadows the module name
+            from dml_cnn_cifar10_tpu.quant.calibrate import (
+                calibrate as quant_calibrate, calibration_sets)
+            calib, _, _ = calibration_sets(
+                cfg.data, 64, cfg.serve.quant_calib_batches, holdout=0)
+            scales = quant_calibrate(
+                params, calib, cfg.model, cfg.data, batch_size=64,
+                num_batches=cfg.serve.quant_calib_batches)
+            if not args.export_path:
+                path = f"{cfg.log_dir}/model_int8.jaxexport"
+            blob = export_lib.export_quantized_forward(
+                cfg.model, cfg.data, params, scales)
+        else:
+            blob = export_lib.export_forward(
+                trainer.model_def, cfg.model, cfg.data, params, mstate)
         if jax.process_index() == 0:
             os.makedirs(os.path.dirname(os.path.abspath(path)),
                         exist_ok=True)
             export_lib.save_exported(path, blob)
-            print(f"[cli] exported step-{step} forward ({len(blob)} bytes, "
-                  f"tpu+cpu, symbolic batch) to {path}")
+            kind = "int8 " if cfg.serve.quantize == "int8" else ""
+            print(f"[cli] exported step-{step} {kind}forward "
+                  f"({len(blob)} bytes, tpu+cpu, symbolic batch) to {path}")
         return 0
 
     if args.mode == "serve":

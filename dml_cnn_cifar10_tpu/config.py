@@ -449,6 +449,25 @@ class ServeConfig:
     # engine dispatch, batch). Shed or retried requests are
     # force-sampled regardless. 0 = off.
     trace_sample_rate: float = 0.0
+    # Quantized serving path (quant/ package, docs/QUANT.md): "int8"
+    # serves the post-training-quantized forward (per-channel weight
+    # scales + calibrated activation scales, XLA-native int8 compute);
+    # versions carry a "+int8" suffix. None = float serving.
+    quantize: Optional[str] = None
+    # Eval-stream batches (of 64) the activation calibration observes.
+    # More batches = tighter amax estimates; the holdout the publish
+    # gate scores on is drawn disjointly after them.
+    quant_calib_batches: int = 4
+    # The pinned accuracy contract: an int8 candidate whose holdout
+    # top-1 trails float top-1 by more than this FRACTION (0.005 =
+    # 0.5%) is rejected at publish time (`quant_rejected` JSONL) and
+    # the previous version keeps serving.
+    quant_max_delta: float = 0.005
+    # Exact-match response cache: LRU over (input digest, serving
+    # version) entries; hits bypass the batcher entirely and count as
+    # `cache_hit` in serve windows. Flushed whenever the serving
+    # version changes, so a stale version can never answer. 0 = off.
+    cache_size: int = 0
 
 
 @dataclasses.dataclass

@@ -111,6 +111,33 @@ def export_forward(model_def: ModelDef, model_cfg: ModelConfig,
     return exp.serialize()
 
 
+def export_quantized_forward(model_cfg: ModelConfig, data_cfg: DataConfig,
+                             params: Any, quant_scales,
+                             platforms: Optional[list] = None) -> bytes:
+    """:func:`export_forward`'s int8 sibling: quantize the float params
+    with ``quant_scales`` (``quant.calibrate.QuantScales``) and
+    serialize the XLA-int8 forward with the int8 weights + f32 scales
+    baked in as constants. Same symbolic batch dim, same raw-uint8
+    input contract — a deserialized artifact is served exactly like a
+    float one, it just computes on the int8 path."""
+    from dml_cnn_cifar10_tpu.ckpt.checkpoint import fetch_to_host
+    from dml_cnn_cifar10_tpu.quant import convert as quant_convert
+
+    params = jax.tree.map(np.asarray, fetch_to_host(params))
+    qtree = quant_convert.quantize_params(params, quant_scales)
+    vfn = quant_convert.make_quantized_serving_fn(model_cfg, data_cfg)
+
+    def fn(images_u8):
+        return vfn((qtree, None), images_u8)
+
+    spec = jax.ShapeDtypeStruct(
+        (jax_export.symbolic_shape("b")[0], data_cfg.image_height,
+         data_cfg.image_width, data_cfg.num_channels), jnp.uint8)
+    exp = jax_export.export(
+        jax.jit(fn), platforms=platforms or ["tpu", "cpu"])(spec)
+    return exp.serialize()
+
+
 def save_exported(path: str, blob: bytes) -> None:
     """Atomic write (tmp + rename, the checkpoint module's convention) so
     a crash mid-write can't leave a truncated artifact for a server to

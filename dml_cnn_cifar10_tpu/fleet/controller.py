@@ -203,7 +203,8 @@ class FleetController:
                                worker_fault=cfg.fleet.worker_fault)
         self.publisher = DirectoryPublisher(
             cfg.log_dir, self.fleet_dir,
-            poll_s=cfg.fleet.publish_poll_s, logger=logger)
+            poll_s=cfg.fleet.publish_poll_s, logger=logger,
+            quantize=cfg.serve.quantize)
         self._cooldown_until = 0.0
         self._last_decide = 0.0
         self._last_fleet_emit = time.time()

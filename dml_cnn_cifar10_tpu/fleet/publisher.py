@@ -51,10 +51,16 @@ def fleet_coord_dir(cfg) -> str:
 @dataclasses.dataclass
 class PublishedVersion:
     seq: int          # monotone publish counter (swap trigger)
-    version: str      # the tag responses will carry (checkpoint step)
+    version: str      # the tag responses will carry (checkpoint step,
+                      # "+int8"-suffixed for a quantized variant)
     step: int
     path: str         # the checkpoint to restore
     published_at: float
+    # Quantized variant marker (docs/QUANT.md): "int8" tells workers to
+    # calibrate + convert the restored float checkpoint and run the
+    # accuracy-delta gate before swapping. Defaulted so published.json
+    # files from float-only fleets keep reading back fine.
+    quantize: Optional[str] = None
 
 
 def read_published(fleet_dir: str) -> Optional[PublishedVersion]:
@@ -76,11 +82,19 @@ def publishable(path: str) -> tuple:
 
 
 def publish_checkpoint(fleet_dir: str, ckpt_path: str, step: int,
-                       logger=None) -> Optional[PublishedVersion]:
+                       logger=None,
+                       quantize: Optional[str] = None
+                       ) -> Optional[PublishedVersion]:
     """Gate on the integrity sidecar, then commit ``published.json``
     (atomic rename, monotone seq). Returns the published record, or
     None when the candidate was rejected or is not newer than what is
-    already published."""
+    already published.
+
+    ``quantize="int8"`` publishes the QUANTIZED variant of the same
+    checkpoint: the path still names the float weights (workers
+    calibrate/convert on adoption, behind the accuracy gate) but the
+    version string carries the ``+int8`` suffix, so every response the
+    fleet returns advertises the numeric path that computed it."""
     ok, reason = publishable(ckpt_path)
     if not ok:
         print(f"[fleet] NOT publishing {ckpt_path}: {reason}")
@@ -88,10 +102,14 @@ def publish_checkpoint(fleet_dir: str, ckpt_path: str, step: int,
     prior = read_published(fleet_dir)
     if prior is not None and prior.step >= step:
         return None
+    version = str(step)
+    if quantize == "int8":
+        from dml_cnn_cifar10_tpu.quant.convert import quantized_version
+        version = quantized_version(version)
     rec = PublishedVersion(
         seq=(prior.seq + 1) if prior is not None else 1,
-        version=str(step), step=int(step), path=os.path.abspath(ckpt_path),
-        published_at=time.time())
+        version=version, step=int(step), path=os.path.abspath(ckpt_path),
+        published_at=time.time(), quantize=quantize)
     os.makedirs(fleet_dir, exist_ok=True)
     target = os.path.join(fleet_dir, PUBLISHED_FILE)
     tmp = target + f".tmp{os.getpid()}"
@@ -118,12 +136,14 @@ class DirectoryPublisher(threading.Thread):
     """
 
     def __init__(self, ckpt_dir: str, fleet_dir: str,
-                 poll_s: float = 0.5, logger=None):
+                 poll_s: float = 0.5, logger=None,
+                 quantize: Optional[str] = None):
         super().__init__(name="fleet-publisher", daemon=True)
         self.ckpt_dir = ckpt_dir
         self.fleet_dir = fleet_dir
         self.poll_s = poll_s
         self.logger = logger
+        self.quantize = quantize
         self._stop = threading.Event()
         self._rejected = set()   # (step, sidecar_mtime) seen-bad cache
 
@@ -152,7 +172,8 @@ class DirectoryPublisher(threading.Thread):
             if key in self._rejected:
                 continue
             rec = publish_checkpoint(self.fleet_dir, path, step,
-                                     logger=self.logger)
+                                     logger=self.logger,
+                                     quantize=self.quantize)
             if rec is not None:
                 return rec
             self._rejected.add(key)

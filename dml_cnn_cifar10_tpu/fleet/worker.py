@@ -41,6 +41,7 @@ from typing import Optional
 from dml_cnn_cifar10_tpu.fleet import publisher as publisher_lib
 from dml_cnn_cifar10_tpu.parallel.cluster import HeartbeatStore
 from dml_cnn_cifar10_tpu.serve.batcher import MicroBatcher
+from dml_cnn_cifar10_tpu.serve.cache import ResponseCache
 from dml_cnn_cifar10_tpu.serve.metrics import ServeMetrics
 from dml_cnn_cifar10_tpu.serve.server import _make_handler, _MetricsFlusher
 
@@ -99,10 +100,17 @@ class _SwapWatcher(threading.Thread):
     The restore target is the worker's own TrainState (structure from
     its first restore), so a published checkpoint from a DIFFERENT
     model config fails restore — which is handled exactly like an
-    engine-contract mismatch: ``swap_rejected``, keep serving."""
+    engine-contract mismatch: ``swap_rejected``, keep serving.
+
+    A record carrying ``quantize="int8"`` is adopted through the quant
+    publish gate instead (``quant/convert.gate_and_swap``): recalibrate
+    for the restored weights, score int8 vs float top-1 on the holdout,
+    and swap only on pass — a failing candidate emits
+    ``quant_rejected`` and the current version keeps serving."""
 
     def __init__(self, fleet_dir: str, engine, trainer, state,
-                 poll_s: float, last_seq: int, logger=None):
+                 poll_s: float, last_seq: int, logger=None,
+                 quant_ctx=None):
         super().__init__(name="fleet-swap-watcher", daemon=True)
         self.fleet_dir = fleet_dir
         self.engine = engine
@@ -111,6 +119,7 @@ class _SwapWatcher(threading.Thread):
         self.poll_s = poll_s
         self.last_seq = last_seq
         self.logger = logger
+        self.quant_ctx = quant_ctx
         self._stop = threading.Event()
 
     def stop(self) -> None:
@@ -141,6 +150,22 @@ class _SwapWatcher(threading.Thread):
         params = new_state.opt.get("ema", new_state.params)
         mstate = new_state.opt.get("ema_mstate", new_state.model_state) \
             if self.trainer.model_def.has_state else None
+        if getattr(rec, "quantize", None) == "int8":
+            if self.quant_ctx is None:
+                if self.logger is not None:
+                    self.logger.log("swap_rejected",
+                                    replica_id=self.engine.replica_id,
+                                    version=rec.version,
+                                    reason="quantized publish but worker "
+                                           "has no int8 program "
+                                           "(--serve_quantize unset)")
+                print(f"[fleet] REJECTED published version "
+                      f"{rec.version}: worker has no int8 program")
+                return False
+            from dml_cnn_cifar10_tpu.quant.convert import gate_and_swap
+            ok, _ = gate_and_swap(self.engine, self.quant_ctx, params,
+                                  str(rec.step), logger=self.logger)
+            return ok
         ok, _ = self.engine.try_swap(params, mstate, version=rec.version)
         return ok
 
@@ -268,6 +293,31 @@ def main_worker(cfg, replica_id: int, fault: Optional[str] = None,
         version=version, replica_id=replica_id)
     holder["engine"] = engine
 
+    # Quantized serving (docs/QUANT.md): the engine stays FLOAT-first —
+    # it is built over the float weights, then armed with the int8
+    # program so try_swap can route either tree shape. Adoption follows
+    # the PUBLISHED record: a replica joining a fleet whose current
+    # version is quantized gates + swaps before going routable (every
+    # replica serves the same variant regardless of spawn order); with
+    # nothing quantized published yet it serves float and the watcher
+    # gates the first quantized publish like any other. A failed gate
+    # means float keeps serving and the version string says so — that
+    # is the contract.
+    quant_ctx = None
+    if cfg.serve.quantize == "int8":
+        from dml_cnn_cifar10_tpu.quant.convert import (QuantContext,
+                                                       gate_and_swap)
+        quant_ctx = QuantContext.build(trainer.model_def, cfg.model,
+                                       cfg.data, cfg.serve)
+        engine.attach_program(
+            "int8", quant_ctx.quant_fn,
+            (quant_ctx.quantize(params), None),
+            warm_buckets=cfg.serve.buckets)
+        if published is not None and \
+                getattr(published, "quantize", None) == "int8":
+            gate_and_swap(engine, quant_ctx, params, version,
+                          logger=logger)
+
     # Advertise on the fleet's coordination transport. NET mode talks
     # to the controller-hosted CoordServer (parallel/net.py) — bounded
     # timeouts, classified errors, the chaos partition seam; a beat the
@@ -314,15 +364,18 @@ def main_worker(cfg, replica_id: int, fault: Optional[str] = None,
                            phase_ref, cell=cell)
     beats.start()
 
+    response_cache = (ResponseCache(serve_cfg.cache_size)
+                      if serve_cfg.cache_size > 0 else None)
     server = ThreadingHTTPServer(
         ("", serve_cfg.port),
         _make_handler(batcher, metrics, replica_id=replica_id,
                       hop="worker", logger=logger,
-                      sample_rate=serve_cfg.trace_sample_rate))
+                      sample_rate=serve_cfg.trace_sample_rate,
+                      cache=response_cache))
     port_ref["port"] = server.server_address[1]
     watcher = _SwapWatcher(fleet_dir, engine, trainer, state,
                            cfg.fleet.swap_poll_s, last_seq,
-                           logger=logger)
+                           logger=logger, quant_ctx=quant_ctx)
     flusher = _MetricsFlusher(metrics, logger, serve_cfg.metrics_every_s,
                               alerts=alert_engine)
     accept = threading.Thread(target=server.serve_forever,

@@ -1,0 +1,27 @@
+"""Post-training int8 quantization for the serving path.
+
+Two halves, mirroring every PTQ deployment stack since the original
+TensorFlow system paper treated 8-bit inference as the standard
+CNN-classifier serving path:
+
+- :mod:`~dml_cnn_cifar10_tpu.quant.calibrate` — observe the float
+  model: per-channel weight ranges plus activation ranges over N
+  batches of the eval stream, reduced to symmetric int8 scales
+  (``calibration`` JSONL records).
+- :mod:`~dml_cnn_cifar10_tpu.quant.convert` — act on the scales:
+  quantize the param tree (int8 weights + f32 scale leaves), run the
+  quantized forward on XLA's native int8 ``dot_general``/conv, and
+  enforce the accuracy-delta publish gate (``quant_rejected`` JSONL
+  on failure; the float path keeps serving).
+
+The serving integration (engine construction, fleet hot-swap, export)
+lives in ``serve/``/``fleet/``/``export.py`` — this package owns only
+the quantization math and the gate. docs/QUANT.md is the contract.
+"""
+
+from dml_cnn_cifar10_tpu.quant.calibrate import (  # noqa: F401
+    ACT_TAPS, QuantScales, calibrate, calibration_sets, weight_scales)
+from dml_cnn_cifar10_tpu.quant.convert import (  # noqa: F401
+    VERSION_SUFFIX, QuantContext, accuracy_gate, batched_logits,
+    dequantize_params, gate_and_swap, is_quantized_version,
+    make_quantized_serving_fn, quantize_params, quantized_version, top1)

@@ -1,0 +1,173 @@
+"""Calibration: observe the float model, produce symmetric int8 scales.
+
+Weights need no data — their ranges are known exactly, and they get
+PER-CHANNEL scales (one per output channel, the last axis of both HWIO
+conv kernels and IO dense kernels) because per-layer weight ranges vary
+by an order of magnitude across channels and a single per-tensor scale
+would waste most of the int8 grid on the widest channel.
+
+Activations DO need data: their ranges depend on what flows through the
+net, so :func:`calibrate` runs N batches of the eval stream through a
+"tapped" float forward (the exact :mod:`models/cnn` eval graph with the
+five layer-boundary tensors observed) and keeps a running absolute max
+per tap. Symmetric quantization throughout: ``scale = amax / 127``,
+zero-point 0 — ReLU networks lose one sign bit on activations but
+symmetric scales keep the int8 matmul a plain ``dot_general`` with no
+zero-point correction terms, which is what XLA fuses best.
+
+Every calibrated tensor is logged as one ``calibration`` JSONL record
+(``tools/check_jsonl_schema.py`` lints them; the quantization section
+of ``tools/telemetry_report.py`` summarizes them), so a quantized
+rollout's scale provenance is in the same stream as its publish gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# Layer-boundary activation taps of the reference CNN, in forward
+# order: the tensor QUANTIZED as input to conv1/conv2/full1/full2/full3
+# respectively (convert.ACT_FOR_LAYER maps layers to taps).
+ACT_TAPS = ("in", "pool1", "flat", "fc1", "fc2")
+
+# Guard against a dead tensor (all-zero channel / activation): a zero
+# scale would divide by zero at quantize time. The guard value keeps
+# the quantized tensor all-zero, which is exactly right for dead input.
+EPS = 1e-8
+
+
+@dataclasses.dataclass
+class QuantScales:
+    """The calibration product :func:`quant.convert.quantize_params`
+    consumes: per-output-channel weight scales and per-tensor
+    activation scales, both ``amax / 127``."""
+
+    weight: Dict[str, np.ndarray]   # layer -> f32 [out_channels]
+    act: Dict[str, float]           # tap (ACT_TAPS) -> f32 scalar
+    calib_batches: int = 0
+
+
+def weight_scales(params) -> Dict[str, np.ndarray]:
+    """Per-output-channel symmetric scales for every ``kernel`` leaf.
+
+    Works straight off the float param tree (no data needed): for each
+    layer's kernel, the absolute max over all axes but the last —
+    channels live on the last axis in both HWIO and IO layouts."""
+    out = {}
+    for layer, leaves in params.items():
+        k = np.asarray(leaves["kernel"], np.float32)
+        amax = np.abs(k.reshape(-1, k.shape[-1])).max(axis=0)
+        out[layer] = np.maximum(amax, EPS).astype(np.float32) / 127.0
+    return out
+
+
+def _tapped_forward(model_cfg, data_cfg):
+    """The float eval forward with the five boundary tensors observed:
+    ``fn(params, images_u8) -> (logits, {tap: batch_amax})``. Must stay
+    line-for-line parallel with ``models/cnn.apply`` + the serving
+    decode (``export.make_variable_serving_fn``) — the scales are only
+    valid for the graph they were measured on."""
+    import jax
+    import jax.numpy as jnp
+
+    from dml_cnn_cifar10_tpu.ops import layers as L
+    from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
+
+    eval_cfg = data_cfg.without_augmentation()
+
+    def fn(params, images_u8):
+        p = jax.tree.map(lambda a: a.astype(jnp.float32), params)
+        x = device_preprocess(images_u8, eval_cfg)
+        taps = {"in": x}
+        x = jax.nn.relu(L.conv2d(x, p["conv1"]["kernel"])
+                        + p["conv1"]["bias"])
+        x = L.max_pool(x)
+        taps["pool1"] = x
+        x = jax.nn.relu(L.conv2d(x, p["conv2"]["kernel"])
+                        + p["conv2"]["bias"])
+        x = L.max_pool(x)
+        x = x.reshape(x.shape[0], -1)
+        taps["flat"] = x
+        x = jax.nn.relu(L.dense(x, p["full1"]["kernel"],
+                                p["full1"]["bias"]))
+        taps["fc1"] = x
+        x = jax.nn.relu(L.dense(x, p["full2"]["kernel"],
+                                p["full2"]["bias"]))
+        taps["fc2"] = x
+        logits = L.dense(x, p["full3"]["kernel"], p["full3"]["bias"])
+        if model_cfg.logit_relu:
+            logits = jax.nn.relu(logits)
+        return logits, {t: jnp.max(jnp.abs(v)) for t, v in taps.items()}
+
+    return fn
+
+
+def calibrate(params, images_u8: np.ndarray, model_cfg, data_cfg,
+              batch_size: int = 64, num_batches: Optional[int] = None,
+              logger=None) -> QuantScales:
+    """Weight scales + activation scales from ``num_batches`` batches of
+    raw uint8 eval images (the serving input contract — the eval decode
+    is part of the tapped graph). Emits one ``calibration`` record per
+    tensor through ``logger`` when given.
+    """
+    import jax
+
+    if model_cfg.name != "cnn":
+        raise ValueError(
+            f"int8 quantization supports the reference CNN only "
+            f"(got model {model_cfg.name!r})")
+    images_u8 = np.asarray(images_u8)
+    if images_u8.dtype != np.uint8 or images_u8.ndim != 4:
+        raise ValueError("calibration images must be raw uint8 "
+                         "[N, H, W, C] (the serving input contract)")
+    n_avail = max(images_u8.shape[0] // batch_size, 1)
+    batches = min(num_batches, n_avail) if num_batches else n_avail
+    fn = jax.jit(_tapped_forward(model_cfg, data_cfg))
+    amax = {t: 0.0 for t in ACT_TAPS}
+    for i in range(batches):
+        chunk = images_u8[i * batch_size:(i + 1) * batch_size]
+        if chunk.shape[0] < batch_size:   # short tail on tiny sets
+            reps = -(-batch_size // chunk.shape[0])
+            chunk = np.concatenate([chunk] * reps)[:batch_size]
+        _, taps = fn(params, chunk)
+        for t in ACT_TAPS:
+            amax[t] = max(amax[t], float(taps[t]))
+    scales = QuantScales(
+        weight=weight_scales(params),
+        act={t: max(amax[t], EPS) / 127.0 for t in ACT_TAPS},
+        calib_batches=batches)
+    if logger is not None:
+        for layer, s in sorted(scales.weight.items()):
+            logger.log("calibration", tensor=f"{layer}/kernel",
+                       amax=round(float(s.max() * 127.0), 8),
+                       scale=round(float(s.max()), 8),
+                       channels=int(s.shape[0]), batches=batches)
+        for tap in ACT_TAPS:
+            logger.log("calibration", tensor=f"act/{tap}",
+                       amax=round(amax[tap], 8),
+                       scale=round(scales.act[tap], 8),
+                       channels=0, batches=batches)
+    return scales
+
+
+def calibration_sets(data_cfg, batch_size: int, calib_batches: int,
+                     holdout: int = 256, seed: int = 0
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(calib_images, holdout_images, holdout_labels), raw uint8, drawn
+    disjointly from the EVAL split: the first ``calib_batches *
+    batch_size`` records calibrate, the next ``holdout`` records are
+    the held-out set the publish gate scores float-vs-int8 top-1 on —
+    a scale must never be graded on the data that produced it."""
+    from dml_cnn_cifar10_tpu.data.pipeline import input_pipeline
+
+    it = input_pipeline(data_cfg, batch_size, train=False, seed=seed)
+    n_cal = min(calib_batches * batch_size, max(it.n - 1, 1))
+    calib = it.images[:n_cal]
+    hold = slice(n_cal, n_cal + holdout)
+    hold_images, hold_labels = it.images[hold], it.labels[hold]
+    if hold_images.shape[0] == 0:   # tiny synthetic sets: fall back to
+        hold_images, hold_labels = calib, it.labels[:n_cal]  # calib set
+    return calib, hold_images, np.asarray(hold_labels)

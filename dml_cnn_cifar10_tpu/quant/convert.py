@@ -1,0 +1,287 @@
+"""Conversion + the quantized forward + the accuracy-delta publish gate.
+
+The quantized tree is a plain pytree — int8 ``w_q`` leaves, f32
+``w_scale``/``bias``/``act_scale`` leaves — passed to ONE jitted
+program as arguments, exactly like the float engine's live-params path.
+That buys the whole hot-swap seam for free: ``ServingEngine.try_swap``
+validates candidates by variable spec, and a quantized tree's spec is
+structurally distinct from a float tree's, so the engine can hold both
+programs and route a candidate to whichever program it matches.
+
+The forward runs on XLA's NATIVE int8: activations are quantized at
+layer boundaries with the calibrated per-tensor scales, the matmuls and
+convs execute as ``int8 × int8 → int32`` (``preferred_element_type``),
+and the dequant is one fused multiply by ``act_scale * w_scale[c]``
+before the f32 bias/ReLU epilogue. No Pallas — int8 ``dot_general`` /
+``conv_general_dilated`` lower natively on both TPU and CPU, which is
+why the accuracy gate is testable in tier-1.
+
+The gate (:func:`accuracy_gate` / :func:`gate_and_swap`) is the pinned
+deployment contract: quantized top-1 on the calibration HOLDOUT must be
+within ``--quant_max_delta`` of float top-1, or the candidate is
+rejected with a ``quant_rejected`` record and the previous version
+keeps serving bit-identically. Version strings carry a ``+int8`` suffix
+so every response advertises which numeric path computed it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from dml_cnn_cifar10_tpu.quant.calibrate import (ACT_TAPS, QuantScales,
+                                                 calibrate)
+
+VERSION_SUFFIX = "+int8"
+
+# Which calibrated activation tap feeds which layer (forward order).
+ACT_FOR_LAYER = {"conv1": "in", "conv2": "pool1", "full1": "flat",
+                 "full2": "fc1", "full3": "fc2"}
+
+
+def quantized_version(version: str) -> str:
+    """``"123" -> "123+int8"`` (idempotent)."""
+    version = str(version)
+    return version if version.endswith(VERSION_SUFFIX) \
+        else version + VERSION_SUFFIX
+
+
+def is_quantized_version(version) -> bool:
+    return str(version).endswith(VERSION_SUFFIX)
+
+
+def quantize_params(params, scales: QuantScales) -> Dict[str, Any]:
+    """Float param tree + scales -> the quantized tree the serving fn
+    takes: per layer ``{w_q int8, w_scale f32[out], bias f32}`` plus
+    the per-tensor activation scales as leaves (so a swap replaces the
+    scales WITH the weights they were calibrated for)."""
+    q: Dict[str, Any] = {}
+    for layer in sorted(ACT_FOR_LAYER):
+        w = np.asarray(params[layer]["kernel"], np.float32)
+        s = np.asarray(scales.weight[layer], np.float32)
+        q[layer] = {
+            "w_q": np.clip(np.rint(w / s), -127, 127).astype(np.int8),
+            "w_scale": s,
+            "bias": np.asarray(params[layer]["bias"], np.float32),
+        }
+    q["act_scale"] = {t: np.float32(scales.act[t]) for t in ACT_TAPS}
+    return q
+
+
+def dequantize_params(qtree) -> Dict[str, Any]:
+    """Back to a float tree (``w_q * w_scale``): each dequantized
+    weight is within ``scale/2`` of the original float weight — the
+    roundtrip bound tests pin."""
+    return {layer: {
+        "kernel": (np.asarray(qtree[layer]["w_q"], np.float32)
+                   * np.asarray(qtree[layer]["w_scale"], np.float32)),
+        "bias": np.asarray(qtree[layer]["bias"], np.float32),
+    } for layer in sorted(ACT_FOR_LAYER)}
+
+
+def _quantize_act(x, scale):
+    import jax.numpy as jnp
+
+    return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+
+
+def _qconv(x, layer, act_scale):
+    """Quantize input -> int8 conv (int32 accum) -> fused dequant ->
+    f32 bias + ReLU."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    xq = _quantize_act(x, act_scale)
+    y = lax.conv_general_dilated(
+        xq, layer["w_q"], window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.int32)
+    y = y.astype(jnp.float32) * (act_scale * layer["w_scale"])
+    return jax.nn.relu(y + layer["bias"])
+
+
+def _qdense(x, layer, act_scale):
+    """int8 matmul (int32 accum) with fused per-channel dequant + bias;
+    the caller owns the activation (the last layer has none)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    xq = _quantize_act(x, act_scale)
+    y = lax.dot_general(xq, layer["w_q"], (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * (act_scale * layer["w_scale"]) \
+        + layer["bias"]
+
+
+def make_quantized_serving_fn(model_cfg, data_cfg):
+    """``fn((qtree, None), images_u8) -> f32 logits`` — the int8 mirror
+    of ``export.make_variable_serving_fn``: same two-arg contract (so
+    one jit serves every quantized checkpoint of this config), same
+    fused eval decode in front, reference-CNN graph only."""
+    import jax
+    import jax.numpy as jnp
+
+    from dml_cnn_cifar10_tpu.ops import layers as L
+    from dml_cnn_cifar10_tpu.ops.preprocess import device_preprocess
+
+    if model_cfg.name != "cnn":
+        raise ValueError(
+            f"int8 serving supports the reference CNN only "
+            f"(got model {model_cfg.name!r})")
+    eval_cfg = data_cfg.without_augmentation()
+
+    def fn(variables, images_u8):
+        qtree, _ = variables
+        a = qtree["act_scale"]
+        x = device_preprocess(images_u8, eval_cfg)
+        x = _qconv(x, qtree["conv1"], a["in"])
+        x = L.max_pool(x)
+        x = _qconv(x, qtree["conv2"], a["pool1"])
+        x = L.max_pool(x)
+        x = x.reshape(x.shape[0], -1)
+        x = jax.nn.relu(_qdense(x, qtree["full1"], a["flat"]))
+        x = jax.nn.relu(_qdense(x, qtree["full2"], a["fc1"]))
+        logits = _qdense(x, qtree["full3"], a["fc2"])
+        if model_cfg.logit_relu:
+            logits = jax.nn.relu(logits)
+        return logits.astype(jnp.float32)
+
+    return fn
+
+
+# --- the gate ---
+
+
+def top1(logits: np.ndarray, labels: np.ndarray) -> float:
+    return float(np.mean(np.argmax(logits, axis=-1)
+                         == np.asarray(labels)))
+
+
+def batched_logits(fn: Callable[[np.ndarray], Any],
+                   images_u8: np.ndarray,
+                   batch_size: int = 64) -> np.ndarray:
+    """Run ``fn`` (images -> logits) over the set in fixed-size chunks,
+    padding the tail — one compiled batch shape, no tail recompile."""
+    outs = []
+    n = images_u8.shape[0]
+    for i in range(0, n, batch_size):
+        chunk = images_u8[i:i + batch_size]
+        pad = batch_size - chunk.shape[0]
+        if pad:
+            chunk = np.concatenate(
+                [chunk, np.zeros((pad,) + chunk.shape[1:], chunk.dtype)])
+        out = np.asarray(fn(chunk))
+        outs.append(out[:batch_size - pad] if pad else out)
+    return np.concatenate(outs) if outs else np.zeros((0,))
+
+
+def accuracy_gate(float_logits: np.ndarray, quant_logits: np.ndarray,
+                  labels: np.ndarray, max_delta: float) -> dict:
+    """The pinned contract: ``float_top1 - quant_top1 <= max_delta``
+    (an int8 candidate BETTER than float always passes)."""
+    f_acc, q_acc = top1(float_logits, labels), top1(quant_logits, labels)
+    delta = round(f_acc - q_acc, 6)
+    return {"ok": delta <= max_delta,
+            "float_top1": round(f_acc, 6), "quant_top1": round(q_acc, 6),
+            "delta": delta, "max_delta": float(max_delta),
+            "n": int(np.asarray(labels).shape[0])}
+
+
+@dataclasses.dataclass
+class QuantContext:
+    """Everything a serving process needs to re-quantize and gate each
+    published float checkpoint: config, the jitted float/int8 programs
+    (built once — recalibration swaps data through them, never
+    recompiles), the disjoint calib/holdout sets, and the contract."""
+
+    model_cfg: Any
+    data_cfg: Any
+    calib_images: np.ndarray
+    holdout_images: np.ndarray
+    holdout_labels: np.ndarray
+    float_fn: Callable        # jitted fn((params, state), images_u8)
+    quant_fn: Callable        # jitted fn((qtree, None), images_u8)
+    calib_batch_size: int = 64
+    calib_batches: int = 4
+    max_delta: float = 0.005
+
+    @classmethod
+    def build(cls, model_def, model_cfg, data_cfg, serve_cfg,
+              calib_batch_size: int = 64, holdout: int = 256,
+              seed: int = 0) -> "QuantContext":
+        """From configs: draw the calib/holdout split off the eval
+        stream and jit both programs."""
+        import jax
+
+        from dml_cnn_cifar10_tpu.export import make_variable_serving_fn
+        from dml_cnn_cifar10_tpu.quant.calibrate import calibration_sets
+
+        calib, hold_x, hold_y = calibration_sets(
+            data_cfg, calib_batch_size, serve_cfg.quant_calib_batches,
+            holdout=holdout, seed=seed)
+        return cls(
+            model_cfg=model_cfg, data_cfg=data_cfg, calib_images=calib,
+            holdout_images=hold_x, holdout_labels=hold_y,
+            float_fn=jax.jit(make_variable_serving_fn(
+                model_def, model_cfg, data_cfg)),
+            quant_fn=jax.jit(make_quantized_serving_fn(
+                model_cfg, data_cfg)),
+            calib_batch_size=calib_batch_size,
+            calib_batches=serve_cfg.quant_calib_batches,
+            max_delta=serve_cfg.quant_max_delta)
+
+    def quantize(self, params, logger=None):
+        """Calibrate (fresh scales for THESE weights) + convert."""
+        scales = calibrate(params, self.calib_images, self.model_cfg,
+                           self.data_cfg, batch_size=self.calib_batch_size,
+                           num_batches=self.calib_batches, logger=logger)
+        return quantize_params(params, scales)
+
+    def gate(self, params, qtree) -> dict:
+        """Score float vs int8 top-1 on the holdout."""
+        bs = self.calib_batch_size
+        f_logits = batched_logits(
+            lambda x: self.float_fn((params, None), x),
+            self.holdout_images, bs)
+        q_logits = batched_logits(
+            lambda x: self.quant_fn((qtree, None), x),
+            self.holdout_images, bs)
+        return accuracy_gate(f_logits, q_logits, self.holdout_labels,
+                             self.max_delta)
+
+
+def gate_and_swap(engine, ctx: QuantContext, params, version: str,
+                  logger=None, max_delta: Optional[float] = None):
+    """The quantized publish-adoption path (fleet worker + tests):
+    recalibrate for the candidate weights, run the gate on the holdout,
+    and only on pass hand the int8 tree to ``engine.try_swap``. A
+    failing candidate emits ``quant_rejected`` and changes NOTHING —
+    the engine keeps serving its current version bit-identically.
+
+    Returns ``(swapped, reason)`` like ``try_swap``.
+    """
+    qversion = quantized_version(version)
+    qtree = ctx.quantize(params, logger=logger)
+    verdict = ctx.gate(params, qtree)
+    if max_delta is not None:        # caller override (tests, drills)
+        verdict["max_delta"] = float(max_delta)
+        verdict["ok"] = verdict["delta"] <= max_delta
+    if not verdict["ok"]:
+        reason = (f"accuracy delta {verdict['delta']:+.4f} exceeds "
+                  f"max_delta {verdict['max_delta']:.4f} "
+                  f"(float {verdict['float_top1']:.4f} vs "
+                  f"int8 {verdict['quant_top1']:.4f})")
+        if logger is not None:
+            logger.log("quant_rejected", replica_id=engine.replica_id,
+                       version=qversion,
+                       float_top1=verdict["float_top1"],
+                       quant_top1=verdict["quant_top1"],
+                       delta=verdict["delta"],
+                       max_delta=verdict["max_delta"], reason=reason)
+        print(f"[quant] REJECTED candidate {qversion}: {reason} "
+              f"(still serving {engine.version})")
+        return False, reason
+    return engine.try_swap(qtree, None, version=qversion)

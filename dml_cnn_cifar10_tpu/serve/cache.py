@@ -1,0 +1,88 @@
+"""Exact-match response cache: (input digest, serving version) -> the
+finished response payload.
+
+CIFAR-sized inference repeats inputs more than it looks like it should
+— canaries, health probes, replayed loadgen corpora, duplicate client
+retries — and an exact hit costs one SHA-1 over 3 KB of pixels versus a
+queue wait plus a device dispatch. Hits bypass the batcher entirely
+(no submit, no bucket padding, no shed exposure) and are counted as
+``cache_hit`` in the serve windows plus ``dml_serve_cache_hits_total``
+in the live registry.
+
+Version safety is structural, not best-effort: the cache binds every
+entry generation to ONE serving version and self-flushes the moment a
+lookup or store sees a different one — the hot-swap flush. A response
+computed by version N can never answer while version M serves, so the
+version tag in every response (the ``+int8`` suffix included) stays
+truthful even through a float→int8 swap under load.
+
+``--serve_cache_size`` (0 = off) bounds the LRU; eviction is
+oldest-use first. One instance is shared by every handler thread —
+all mutation under one lock, same discipline as ``ServeMetrics``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+
+class ResponseCache:
+    """Thread-safe exact-match LRU, one generation per serving version."""
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("ResponseCache needs capacity >= 1 "
+                             "(0 means: don't construct one)")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+        self._version: Optional[str] = None
+        self.hits = 0
+        self.misses = 0
+        self.flushes = 0   # version-change flushes (hot-swaps observed)
+
+    @staticmethod
+    def digest(body: bytes) -> bytes:
+        return hashlib.sha1(body).digest()
+
+    def _sync_version(self, version: str) -> None:
+        # caller holds the lock
+        if version != self._version:
+            if self._version is not None and self._entries:
+                self.flushes += 1
+            self._entries.clear()
+            self._version = version
+
+    def lookup(self, body: bytes, version: str) -> Optional[dict]:
+        """The cached payload for this exact input under the CURRENT
+        serving version, or None. Seeing a new version flushes the
+        previous generation (the hot-swap flush)."""
+        key = self.digest(body)
+        with self._lock:
+            self._sync_version(str(version))
+            payload = self._entries.get(key)
+            if payload is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return payload
+
+    def store(self, body: bytes, version: str, payload: dict) -> None:
+        """Cache a finished response under the version that COMPUTED it
+        (``VersionedLogits.version``) — if a swap landed between
+        dispatch and completion, the generation check just drops it."""
+        key = self.digest(body)
+        with self._lock:
+            self._sync_version(str(version))
+            self._entries[key] = payload
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -108,11 +108,20 @@ class ServingEngine:
         # compiled program never sees a placement change. None keeps
         # jax's default single-device placement.
         self._put_sharding = None
-        # bucket size -> AOT executable obtained through the cache;
+        # Alternate serving programs (e.g. the int8 path): name ->
+        # (jitted two-arg fn, variable spec). try_swap routes a
+        # candidate tree to whichever program its spec matches, and
+        # the forward reads the active path name under the same lock
+        # as the variables — a float->int8 swap is the same pytree
+        # pointer replacement as a float->float one.
+        self._alt_programs = {}
+        self._active_path = "primary"
+        # (path, bucket) -> AOT executable obtained through the cache;
         # forward_timed prefers these, falling back to the jitted fn
         # for sizes the warmup never saw. Swap-safe by construction:
         # the executables are compiled for the variables' AVALS, which
-        # try_swap pins, so they serve every installed version.
+        # try_swap pins per path, so they serve every installed version
+        # of that path.
         self._bucket_fns = {}
         #: last warmup's {bucket: event dict} (hit/source/compile_s).
         self.last_warmup: dict = {}
@@ -148,7 +157,9 @@ class ServingEngine:
     def from_params(cls, model_def, model_cfg, data_cfg, params: Any,
                     model_state: Any = None, compile_cache=None,
                     logger=None, version: str = "0",
-                    replica_id: int = 0, mesh=None) -> "ServingEngine":
+                    replica_id: int = 0, mesh=None,
+                    quantize: Optional[str] = None,
+                    quant_scales=None) -> "ServingEngine":
         """Engine over live params — the same eval forward export.py
         would serialize, with the weights as jit ARGUMENTS so
         :meth:`try_swap` can replace them without a recompile.
@@ -157,7 +168,14 @@ class ServingEngine:
         unified runtime's): weights are placed replicated over it, and
         every later :meth:`try_swap` re-places candidates onto the SAME
         sharding — a device-to-device transfer, never a host round-trip
-        — so train-sharded publishes and the serving program agree."""
+        — so train-sharded publishes and the serving program agree.
+
+        ``quantize="int8"`` builds the quantized construction path
+        instead: the float params are converted with ``quant_scales``
+        (a ``quant.calibrate.QuantScales``, required) and the engine's
+        primary program is the XLA-int8 forward — the version carries
+        the ``+int8`` suffix so every response advertises the numeric
+        path. The swap contract then accepts QUANTIZED trees."""
         import jax
 
         from dml_cnn_cifar10_tpu.export import make_variable_serving_fn
@@ -166,8 +184,24 @@ class ServingEngine:
                          data_cfg.num_channels),
                   compile_cache=compile_cache, logger=logger,
                   version=version, replica_id=replica_id)
-        eng._jitted_v = jax.jit(
-            make_variable_serving_fn(model_def, model_cfg, data_cfg))
+        if quantize:
+            if quantize != "int8":
+                raise ValueError(f"unknown quantize mode {quantize!r} "
+                                 f"(supported: int8)")
+            if quant_scales is None:
+                raise ValueError(
+                    "quantize='int8' needs quant_scales= (run "
+                    "quant.calibrate.calibrate on eval batches first)")
+            from dml_cnn_cifar10_tpu.quant import convert as quant_convert
+            eng._jitted_v = jax.jit(
+                quant_convert.make_quantized_serving_fn(model_cfg,
+                                                        data_cfg))
+            eng.version = quant_convert.quantized_version(version)
+            params = quant_convert.quantize_params(params, quant_scales)
+            model_state = None
+        else:
+            eng._jitted_v = jax.jit(
+                make_variable_serving_fn(model_def, model_cfg, data_cfg))
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             eng._put_sharding = NamedSharding(mesh, PartitionSpec())
@@ -191,6 +225,54 @@ class ServingEngine:
     @property
     def swappable(self) -> bool:
         return self._jitted_v is not None
+
+    def attach_program(self, name: str, jitted_fn,
+                       template_variables, warm_buckets=None) -> None:
+        """Arm an alternate serving program (same ``fn(variables,
+        batch_u8) -> logits`` contract as the primary). ``try_swap``
+        then routes any candidate whose variable spec matches the
+        TEMPLATE's to this program — e.g. a float engine armed with the
+        int8 program hot-swaps to a quantized tree the moment one
+        passes the publish gate, and back, with no engine rebuild.
+
+        ``warm_buckets`` pre-pays the alternate path's per-bucket
+        compiles with the template variables (zero batches), so the
+        first post-swap batch doesn't eat an XLA compile mid-traffic.
+        """
+        import jax
+
+        if not self.swappable:
+            raise ValueError("alternate programs need a live-params "
+                             "engine (artifact engines are baked)")
+        template_variables = self._place(template_variables)
+        self._alt_programs[name] = (jitted_fn,
+                                    _variable_spec(template_variables))
+        for b in sorted(set(int(b) for b in (warm_buckets or ()))):
+            zeros = np.zeros((b, *self.image_shape), np.uint8)
+            t0 = time.perf_counter()
+            jax.block_until_ready(jitted_fn(template_variables, zeros))
+            if self.logger is not None:
+                self.logger.log(
+                    "compile", key=None, phase=f"serve_warmup_{name}",
+                    hit=False,
+                    compile_s=round(time.perf_counter() - t0, 4),
+                    source="uncached")
+
+    def _match_program(self, spec):
+        """(path name, jitted fn) whose compiled contract the candidate
+        spec satisfies, or None. The construction-time program is
+        checked first, then attached alternates."""
+        if spec == self._var_spec:
+            return "primary", self._jitted_v
+        for name, (fn, pspec) in self._alt_programs.items():
+            if spec == pspec:
+                return name, fn
+        return None
+
+    def _active_fn(self):
+        if self._active_path == "primary":
+            return self._jitted_v
+        return self._alt_programs[self._active_path][0]
 
     def try_swap(self, params: Any, model_state: Any = None,
                  version: str = "?") -> Tuple[bool, str]:
@@ -217,9 +299,11 @@ class ServingEngine:
                          "into the program); not swappable")
         candidate = (params, model_state)
         spec = _variable_spec(candidate)
-        if spec != self._var_spec:
+        match = self._match_program(spec)
+        if match is None:
             return False, self._reject(
                 version, _spec_mismatch(self._var_spec, spec))
+        path, _ = match
         # Place on device BEFORE taking the lock: the transfer is the
         # slow part and must not stall a concurrent forward. With an
         # attached mesh this re-places onto the engine's replicated
@@ -229,6 +313,7 @@ class ServingEngine:
         with self._swap_lock:
             from_version = self.version
             self._variables = candidate
+            self._active_path = path
             self.version = version
             self.swap_count += 1
         swap_ms = round((time.perf_counter() - t0) * 1e3, 3)
@@ -263,7 +348,7 @@ class ServingEngine:
         return (var_avals, batch)
 
     def _jitted(self):
-        return self._jitted_v if self.swappable else self._fn
+        return self._active_fn() if self.swappable else self._fn
 
     def _warm_bucket(self, b: int) -> None:
         """Obtain bucket ``b``'s executable through the cache (hit =
@@ -290,7 +375,7 @@ class ServingEngine:
             compiled, ev = self.compile_cache.obtain(
                 self._jitted(), avals, "serve_warmup", {"bucket": b})
             if compiled is not None:
-                self._bucket_fns[b] = compiled
+                self._bucket_fns[(self._active_path, b)] = compiled
                 # One zeros forward through the obtained executable:
                 # warms the dispatch/transfer path and proves the
                 # deserialized program actually runs before traffic.
@@ -339,13 +424,16 @@ class ServingEngine:
             with self._swap_lock:
                 variables = self._variables
                 version = self.version
-            fn = self._bucket_fns.get(b)
+                path = self._active_path
+            fn = self._bucket_fns.get((path, b))
+            if fn is None:
+                fn = self._jitted_v if path == "primary" \
+                    else self._alt_programs[path][0]
             t0 = time.perf_counter()
-            out = fn(variables, batch_u8) if fn is not None \
-                else self._jitted_v(variables, batch_u8)
+            out = fn(variables, batch_u8)
             logits = np.asarray(jax.device_get(out))
             return logits, time.perf_counter() - t0, version
-        fn = self._bucket_fns.get(b, self._fn)
+        fn = self._bucket_fns.get(("primary", b), self._fn)
         t0 = time.perf_counter()
         logits = np.asarray(jax.device_get(fn(batch_u8)))
         return logits, time.perf_counter() - t0, self.version

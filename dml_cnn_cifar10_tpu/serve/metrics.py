@@ -28,14 +28,15 @@ class _Window:
     """One accumulation window's raw samples (no derived stats)."""
 
     __slots__ = ("submitted", "completed", "shed_queue", "shed_deadline",
-                 "latencies", "queue_waits", "device_secs", "fills",
-                 "batches", "t0")
+                 "cache_hits", "latencies", "queue_waits", "device_secs",
+                 "fills", "batches", "t0")
 
     def __init__(self):
         self.submitted = 0
         self.completed = 0
         self.shed_queue = 0
         self.shed_deadline = 0
+        self.cache_hits = 0
         self.latencies = []       # submit -> result, seconds
         self.queue_waits = []     # submit -> dispatch start, seconds
         self.device_secs = []     # per batch
@@ -58,6 +59,14 @@ class ServeMetrics:
         with self._lock:
             self._win.submitted += 1
             self._total.submitted += 1
+
+    def record_cache_hit(self) -> None:
+        """A request answered from the response cache — it bypassed the
+        batcher, so it appears in ``cache_hit`` ONLY (not in
+        requests/completed, which count batcher traffic)."""
+        with self._lock:
+            for w in (self._win, self._total):
+                w.cache_hits += 1
 
     def record_shed(self, reason: str) -> None:
         field = "shed_queue" if reason == "queue_full" else "shed_deadline"
@@ -101,6 +110,7 @@ class ServeMetrics:
             "completed": w.completed,
             "shed_queue": w.shed_queue,
             "shed_deadline": w.shed_deadline,
+            "cache_hit": w.cache_hits,
             "qps": round(w.completed / span, 2),
             "p50_ms": lat["p50_ms"],
             "p95_ms": lat["p95_ms"],

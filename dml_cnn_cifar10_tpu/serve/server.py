@@ -40,7 +40,7 @@ from dml_cnn_cifar10_tpu.utils import reqtrace
 
 def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics,
                   replica_id: int = 0, hop: str = "server",
-                  logger=None, sample_rate: float = 0.0):
+                  logger=None, sample_rate: float = 0.0, cache=None):
     image_bytes = 1
     for d in batcher.engine.image_shape:
         image_bytes *= d
@@ -103,6 +103,17 @@ def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics,
                              f"(HWC {batcher.engine.image_shape}), "
                              f"got {len(body)}"})
                 return
+            # Response cache probe BEFORE the batcher: an exact hit
+            # under the current serving version answers immediately
+            # (no queue, no device). The cache self-flushes on any
+            # version change, so a hot-swap can never serve stale.
+            if cache is not None:
+                hit = cache.lookup(
+                    body, getattr(batcher.engine, "version", ""))
+                if hit is not None:
+                    metrics.record_cache_hit()
+                    self._reply(200, hit)
+                    return
             image = np.frombuffer(body, np.uint8).reshape(
                 batcher.engine.image_shape)
             # Adopt the caller's trace context (or become the trace
@@ -137,6 +148,10 @@ def _make_handler(batcher: MicroBatcher, metrics: ServeMetrics,
                 # The weights version that computed THIS response —
                 # what makes a hot-swap rollout observable end-to-end.
                 payload["version"] = version
+                if cache is not None:
+                    # Keyed to the version that COMPUTED it; if a swap
+                    # landed meanwhile the generation check drops it.
+                    cache.store(body, version, payload)
             reqtrace.emit_span(logger, ctx, hop,
                                time.perf_counter() - t0,
                                reqtrace.wallclock_at(t0),
@@ -183,6 +198,35 @@ def resolve_engine(cfg, task_index: int = 0, logger=None,
 
     cache = CompileCache.from_config(cfg, logger=logger)
     serve_cfg = cfg.serve
+    if serve_cfg.quantize == "int8":
+        # Quantized serving wants live params (calibration needs the
+        # float weights); a float artifact can't be quantized post-hoc.
+        if serve_cfg.artifact_path:
+            raise SystemExit(
+                "--serve_quantize int8 quantizes live checkpoint "
+                "params; it cannot combine with --serve_artifact "
+                "(export a quantized artifact with --mode export "
+                "--serve_quantize int8 and serve that instead)")
+        import jax
+
+        # import from the module path: the package re-exports a
+        # `calibrate` FUNCTION that shadows the module name
+        from dml_cnn_cifar10_tpu.quant.calibrate import (
+            calibrate as quant_calibrate, calibration_sets)
+        from dml_cnn_cifar10_tpu.train.loop import Trainer
+        trainer = Trainer(cfg, task_index=task_index)
+        state = trainer.init_or_restore()
+        params = state.opt.get("ema", state.params)
+        calib, _, _ = calibration_sets(
+            cfg.data, 64, serve_cfg.quant_calib_batches, holdout=0)
+        scales = quant_calibrate(
+            params, calib, cfg.model, cfg.data, batch_size=64,
+            num_batches=serve_cfg.quant_calib_batches, logger=logger)
+        return ServingEngine.from_params(
+            trainer.model_def, cfg.model, cfg.data, params,
+            compile_cache=cache, logger=logger,
+            version=str(int(jax.device_get(state.step))),
+            replica_id=replica_id, quantize="int8", quant_scales=scales)
     if serve_cfg.artifact_path:
         if not os.path.exists(serve_cfg.artifact_path):
             raise SystemExit(
@@ -278,11 +322,15 @@ def main_serve(cfg, task_index: int = 0,
           f"{engine.image_shape} buckets={batcher.buckets} "
           f"compile_s={batcher.compile_secs}")
 
+    from dml_cnn_cifar10_tpu.serve.cache import ResponseCache
+    response_cache = ResponseCache(serve_cfg.cache_size) \
+        if serve_cfg.cache_size > 0 else None
     server = ThreadingHTTPServer(
         ("", serve_cfg.port),
         _make_handler(batcher, metrics, replica_id=task_index,
                       hop="server", logger=logger,
-                      sample_rate=serve_cfg.trace_sample_rate))
+                      sample_rate=serve_cfg.trace_sample_rate,
+                      cache=response_cache))
     flusher = _MetricsFlusher(metrics, logger, serve_cfg.metrics_every_s,
                               alerts=alert_engine)
     flusher.start()

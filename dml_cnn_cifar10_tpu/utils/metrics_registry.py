@@ -422,6 +422,10 @@ def _observe_record(kind: str, f: dict, reg: MetricsRegistry) -> None:
                            labelnames=("reason",))
         shed.inc(f.get("shed_queue") or 0, reason="queue_full")
         shed.inc(f.get("shed_deadline") or 0, reason="deadline")
+        reg.counter("dml_serve_cache_hits_total",
+                    "Requests answered by the response cache "
+                    "(bypassed the batcher)"
+                    ).inc(f.get("cache_hit") or 0)
     elif kind == "fleet":
         reg.gauge("dml_fleet_live_replicas",
                   "Replicas in the routing rotation").set(f.get("live"))

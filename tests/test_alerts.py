@@ -40,8 +40,8 @@ def _engine(min_interval_s=0.0):
 
 def _serve(requests=100, shed=0, p99=10.0):
     return {"requests": requests, "completed": requests - shed,
-            "shed_queue": shed, "shed_deadline": 0, "qps": 10.0,
-            "p50_ms": 5.0, "p95_ms": 8.0, "p99_ms": p99,
+            "shed_queue": shed, "shed_deadline": 0, "cache_hit": 0,
+            "qps": 10.0, "p50_ms": 5.0, "p95_ms": 8.0, "p99_ms": p99,
             "batch_fill": 0.9, "window_s": 5.0}
 
 

@@ -19,8 +19,8 @@ from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
 
 def _serve(shed=0, p99=10.0):
     return {"requests": 100, "completed": 100 - shed,
-            "shed_queue": shed, "shed_deadline": 0, "qps": 50.0,
-            "p50_ms": 2.0, "p95_ms": 5.0, "p99_ms": p99,
+            "shed_queue": shed, "shed_deadline": 0, "cache_hit": 0,
+            "qps": 50.0, "p50_ms": 2.0, "p95_ms": 5.0, "p99_ms": p99,
             "batch_fill": 0.5, "window_s": 2.0}
 
 

@@ -1,0 +1,313 @@
+"""Quantized serving subsystem (``quant/``; docs/QUANT.md).
+
+Pins the four contracts the int8 path rides on: the calibration scale
+math (per-channel symmetric absmax), the convert roundtrip bound
+(dequantized weights within half a quantization step of the float
+originals), the publish-time accuracy-delta gate — BOTH verdicts: a
+passing candidate hot-swaps the engine to a ``+int8`` version, a
+failing one emits ``quant_rejected`` and leaves the float path serving
+bit-identically — and the serving-side furniture that rides along
+(the exact-match response cache, the JSONL schema of the new record
+kinds, and ``tools/loadgen.py --check_labels``).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from dml_cnn_cifar10_tpu.config import ModelConfig, ServeConfig
+from dml_cnn_cifar10_tpu.export import make_variable_serving_fn
+from dml_cnn_cifar10_tpu.models.registry import get_model
+from dml_cnn_cifar10_tpu.quant.calibrate import (EPS, calibrate,
+                                                 weight_scales)
+from dml_cnn_cifar10_tpu.quant.convert import (QuantContext,
+                                               accuracy_gate,
+                                               dequantize_params,
+                                               gate_and_swap,
+                                               is_quantized_version,
+                                               quantize_params,
+                                               quantized_version)
+from dml_cnn_cifar10_tpu.serve.cache import ResponseCache
+from dml_cnn_cifar10_tpu.serve.engine import ServingEngine
+
+MODEL_CFG = ModelConfig(name="cnn", logit_relu=False)
+
+
+class RecordingLogger:
+    def __init__(self):
+        self.records = []
+
+    def log(self, kind, **fields):
+        self.records.append({"kind": kind, **fields})
+
+    def of(self, kind):
+        return [r for r in self.records if r["kind"] == kind]
+
+
+@pytest.fixture(scope="module")
+def model_def():
+    return get_model("cnn")
+
+
+@pytest.fixture(scope="module")
+def params(model_def):
+    # data geometry only matters through crop size; use the session
+    # defaults (32 -> 24) so the jitted programs are shared.
+    from dml_cnn_cifar10_tpu.config import DataConfig
+    dcfg = DataConfig()
+    return model_def.init(jax.random.key(0), MODEL_CFG, dcfg)
+
+
+def _images(n, seed=0, hw=32):
+    return np.random.default_rng(seed).integers(
+        0, 256, (n, hw, hw, 3), dtype=np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# scale math + convert roundtrip
+# ---------------------------------------------------------------------------
+
+def test_weight_scales_per_out_channel_absmax(params):
+    scales = weight_scales(params)
+    assert set(scales) == {"conv1", "conv2", "full1", "full2", "full3"}
+    for layer, s in scales.items():
+        k = np.asarray(params[layer]["kernel"])
+        assert s.shape == (k.shape[-1],)          # one per out channel
+        axes = tuple(range(k.ndim - 1))
+        want = np.maximum(np.abs(k).max(axis=axes), EPS) / 127.0
+        np.testing.assert_allclose(s, want, rtol=1e-6)
+        assert (s > 0).all()                      # EPS guard: never 0
+
+
+def test_weight_scales_zero_channel_guard():
+    params = {"full1": {"kernel": np.zeros((4, 3), np.float32),
+                        "bias": np.zeros((3,), np.float32)}}
+    s = weight_scales(params)["full1"]
+    assert (s > 0).all()                          # no divide-by-zero
+
+
+def test_quantize_roundtrip_within_half_scale(params, data_cfg):
+    scales = calibrate(params, _images(64), MODEL_CFG, data_cfg,
+                       batch_size=32, num_batches=2)
+    assert scales.calib_batches == 2
+    qtree = quantize_params(params, scales)
+    for layer in ("conv1", "conv2", "full1", "full2", "full3"):
+        assert qtree[layer]["w_q"].dtype == np.int8
+        assert np.abs(qtree[layer]["w_q"]).max() <= 127
+    deq = dequantize_params(qtree)
+    for layer, s in scales.weight.items():
+        w = np.asarray(params[layer]["kernel"])
+        err = np.abs(deq[layer]["kernel"] - w)
+        # symmetric rounding: within half a quantization step,
+        # per-channel (the scale broadcast over the out axis)
+        assert (err <= s / 2 + 1e-7).all()
+        np.testing.assert_array_equal(deq[layer]["bias"],
+                                      params[layer]["bias"])
+
+
+def test_version_suffix_helpers():
+    assert quantized_version("120") == "120+int8"
+    assert quantized_version("120+int8") == "120+int8"   # idempotent
+    assert is_quantized_version("120+int8")
+    assert not is_quantized_version("120")
+
+
+# ---------------------------------------------------------------------------
+# the accuracy-delta gate
+# ---------------------------------------------------------------------------
+
+def test_accuracy_gate_math():
+    labels = np.array([0, 1, 2, 3])
+    eye = np.eye(4, 10, dtype=np.float32)
+    f_logits = eye.copy()                       # float: 4/4
+    q_logits = eye.copy()
+    q_logits[3] = np.eye(1, 10)[0]              # int8: 3/4 -> delta 0.25
+    v = accuracy_gate(f_logits, q_logits, labels, max_delta=0.30)
+    assert v["ok"] and v["delta"] == pytest.approx(0.25)
+    assert v["float_top1"] == 1.0 and v["quant_top1"] == 0.75
+    v = accuracy_gate(f_logits, q_logits, labels, max_delta=0.20)
+    assert not v["ok"]
+    # A quant candidate BETTER than float never fails the gate.
+    v = accuracy_gate(q_logits, f_logits, labels, max_delta=0.0)
+    assert v["ok"] and v["delta"] == pytest.approx(-0.25)
+
+
+def test_gate_on_tiny_cnn_delta_near_zero(model_def, params, data_cfg):
+    """Tier-1 pin of the whole calibrate->convert->gate path on the
+    real CNN: on synthetic data both variants sit at chance, so the
+    int8 top-1 must track float top-1 closely — a generous ceiling
+    still catches a broken quantized forward, which scores ~0 delta
+    only by accident."""
+    serve_cfg = ServeConfig(quant_calib_batches=2, quant_max_delta=0.5)
+    ctx = QuantContext.build(model_def, MODEL_CFG, data_cfg, serve_cfg,
+                             calib_batch_size=32, holdout=96)
+    qtree = ctx.quantize(params)
+    v = ctx.gate(params, qtree)
+    assert set(v) == {"ok", "float_top1", "quant_top1", "delta",
+                      "max_delta", "n"}
+    assert v["n"] > 0
+    assert abs(v["delta"]) <= 0.5 and v["ok"]
+
+
+# ---------------------------------------------------------------------------
+# engine integration: quantized construction, gate_and_swap both ways
+# ---------------------------------------------------------------------------
+
+def test_engine_quantized_construction(model_def, params, data_cfg):
+    scales = calibrate(params, _images(64), MODEL_CFG, data_cfg,
+                       batch_size=32, num_batches=2)
+    eng = ServingEngine.from_params(
+        model_def, MODEL_CFG, data_cfg, params, None,
+        version="7", quantize="int8", quant_scales=scales)
+    assert eng.version == "7+int8"
+    logits, _, version = eng.forward_timed_versioned(_images(4, seed=3))
+    assert logits.shape == (4, 10) and version == "7+int8"
+    assert np.isfinite(logits).all()
+    # A float tree does not match the int8 program's spec: rejected,
+    # and the quantized weights keep serving bit-identically.
+    before = eng.forward_timed_versioned(_images(4, seed=3))[0]
+    ok, reason = eng.try_swap(params, None, version="8")
+    assert not ok and "structure" in reason
+    after, _, version = eng.forward_timed_versioned(_images(4, seed=3))
+    assert version == "7+int8"
+    np.testing.assert_array_equal(before, after)
+
+
+def test_gate_and_swap_reject_then_accept(model_def, params, data_cfg):
+    """The publish-adoption path end to end on one engine: a candidate
+    failing the gate changes NOTHING (quant_rejected logged, float
+    logits bit-identical, version untouched); a passing one hot-swaps
+    to the ``+int8`` version — and the engine can swap BACK to a float
+    publish afterwards."""
+    serve_cfg = ServeConfig(quant_calib_batches=1, quant_max_delta=0.5)
+    logger = RecordingLogger()
+    ctx = QuantContext.build(model_def, MODEL_CFG, data_cfg, serve_cfg,
+                             calib_batch_size=32, holdout=64)
+    eng = ServingEngine.from_params(model_def, MODEL_CFG, data_cfg,
+                                    params, None, version="3",
+                                    logger=logger)
+    eng.attach_program("int8", ctx.quant_fn,
+                       (ctx.quantize(params), None))
+    probe = _images(4, seed=5)
+    before = eng.forward_timed_versioned(probe)[0]
+
+    # Reject: max_delta=-1 fails any candidate (delta 0 > -1).
+    ok, reason = gate_and_swap(eng, ctx, params, "9", logger=logger,
+                               max_delta=-1.0)
+    assert not ok and "exceeds" in reason
+    rejects = logger.of("quant_rejected")
+    assert len(rejects) == 1
+    assert rejects[0]["version"] == "9+int8"
+    assert rejects[0]["delta"] > rejects[0]["max_delta"]
+    after, _, version = eng.forward_timed_versioned(probe)
+    assert version == "3"                       # float kept serving
+    np.testing.assert_array_equal(before, after)
+
+    # Accept: the configured ceiling (generous on untrained weights).
+    ok, _ = gate_and_swap(eng, ctx, params, "9", logger=logger)
+    assert ok
+    logits, _, version = eng.forward_timed_versioned(probe)
+    assert version == "9+int8"
+    assert np.isfinite(logits).all()
+    # And back to float: the primary program still matches its spec.
+    ok, _ = eng.try_swap(params, None, version="12")
+    assert ok
+    back, _, version = eng.forward_timed_versioned(probe)
+    assert version == "12"
+    np.testing.assert_array_equal(before, back)
+
+
+# ---------------------------------------------------------------------------
+# response cache
+# ---------------------------------------------------------------------------
+
+def test_response_cache_hit_miss_lru_and_capacity():
+    c = ResponseCache(2)
+    assert c.lookup(b"a", "v1") is None                 # miss
+    c.store(b"a", "v1", {"class": 1})
+    assert c.lookup(b"a", "v1") == {"class": 1}         # hit
+    c.store(b"b", "v1", {"class": 2})
+    assert c.lookup(b"a", "v1") == {"class": 1}         # refreshes LRU
+    c.store(b"c", "v1", {"class": 3})                   # evicts b
+    assert c.lookup(b"b", "v1") is None
+    assert c.lookup(b"a", "v1") == {"class": 1}
+    assert c.hits == 3 and c.misses == 2
+    with pytest.raises(ValueError):
+        ResponseCache(0)
+
+
+def test_response_cache_flushes_on_version_change():
+    c = ResponseCache(8)
+    c.store(b"a", "3", {"class": 1})
+    assert c.lookup(b"a", "3") == {"class": 1}
+    # Hot-swap: the serving version moves -> every cached entry is for
+    # dead weights and must go.
+    assert c.lookup(b"a", "3+int8") is None
+    assert len(c) == 0 and c.flushes == 1
+    c.store(b"a", "3+int8", {"class": 2})
+    assert c.lookup(b"a", "3+int8") == {"class": 2}
+    # A stale store (computed by the OLD version, landing after the
+    # swap) is dropped at lookup time, not served.
+    c.store(b"b", "3", {"class": 9})
+    assert c.lookup(b"b", "3+int8") is None
+
+
+# ---------------------------------------------------------------------------
+# JSONL schema: the new record kinds
+# ---------------------------------------------------------------------------
+
+def test_quant_record_kinds_schema_strict(tmp_path):
+    from dml_cnn_cifar10_tpu.utils.logging import MetricsLogger
+    from tools import check_jsonl_schema
+
+    path = str(tmp_path / "quant.jsonl")
+    logger = MetricsLogger(jsonl_path=path)
+    logger.log("calibration", tensor="conv1/kernel", amax=1.25,
+               scale=0.0098, channels=64, batches=4)
+    logger.log("calibration", tensor="act/in", amax=2.64,
+               scale=0.0208, channels=0, batches=4)
+    logger.log("quant_rejected", replica_id=0, version="9+int8",
+               float_top1=0.61, quant_top1=0.55, delta=0.06,
+               max_delta=0.005, reason="accuracy delta 0.06 exceeds")
+    logger.close()
+    assert check_jsonl_schema.check_file(path, strict=True) == []
+    # A calibration record missing its scale is a schema violation.
+    with open(path, "a") as f:
+        f.write(json.dumps({"kind": "calibration", "t": 1.0, "task": 0,
+                            "tensor": "conv2/kernel", "amax": 0.5,
+                            "channels": 64, "batches": 4}) + "\n")
+    errs = check_jsonl_schema.check_file(path, strict=True)
+    assert errs and "scale" in errs[0]
+
+
+# ---------------------------------------------------------------------------
+# loadgen --check_labels
+# ---------------------------------------------------------------------------
+
+def test_loadgen_check_labels_smoke(tmp_path, model_def, params):
+    """End-to-end prediction verification: labels built from the
+    model's own argmax must score accuracy 1.0 through the serving
+    stack (any preprocessing/quantization drift in the serve path
+    would break the equality)."""
+    import tools.loadgen as loadgen
+    from dml_cnn_cifar10_tpu.config import DataConfig
+
+    dcfg = DataConfig(normalize="scale")
+    imgs = _images(32, seed=11)
+    fn = jax.jit(make_variable_serving_fn(model_def, MODEL_CFG, dcfg))
+    labels = np.asarray(fn((params, None), imgs)).argmax(-1)
+    npz = str(tmp_path / "check.npz")
+    np.savez(npz, images=imgs, labels=labels)
+
+    report_path = str(tmp_path / "report.json")
+    assert loadgen.main([
+        "--mode", "closed", "--concurrency", "2", "--duration_s", "1.0",
+        "--buckets", "1,8", "--check_labels", npz,
+        "--report", report_path]) == 0
+    with open(report_path) as f:
+        report = json.load(f)
+    assert report["label_checked"] == report["completed"] > 0
+    assert report["accuracy"] == 1.0

@@ -47,7 +47,8 @@ from typing import List, Optional
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: Benchmark rows a report may carry (bench.py main()).
-ROW_KEYS = ("fp32", "bf16", "fp32_k320", "fp32_hostidx", "fp32_zero1")
+ROW_KEYS = ("fp32", "bf16", "fp32_k320", "fp32_hostidx", "fp32_zero1",
+            "int8_serve")
 
 #: Default tolerances — one place, shared by the CLI and --self-check.
 DEFAULTS = {
@@ -56,16 +57,20 @@ DEFAULTS = {
     "tol_compile": 2.0,
     "max_spread": 10.0,
     "tol_tail": 0.5,
+    "min_int8_speedup": 1.5,
 }
 
 #: Per-row tolerance overrides, layered over DEFAULTS (and over any CLI
 #: override). fp32_zero1 carries the ZeRO-1 reduce-scatter/all-gather
 #: pair whose cost varies with interconnect weather more than the plain
 #: all-reduce's — slightly wider floors keep the gate honest without
-#: letting a real regression through. (Absent-metric skipping still
-#: applies: rounds before the row existed simply don't gate it.)
+#: letting a real regression through. int8_serve times single-batch
+#: serving dispatches (~ms each), jitterier than the amortized 100-step
+#: train chunks. (Absent-metric skipping still applies: rounds before
+#: a row existed simply don't gate it.)
 ROW_TOLERANCES = {
     "fp32_zero1": {"tol_throughput": 0.08, "tol_mfu": 0.10},
+    "int8_serve": {"tol_throughput": 0.10, "max_spread": 15.0},
 }
 
 
@@ -143,6 +148,19 @@ def gate(candidate: dict, baselines: List[dict], **tol) -> List[dict]:
         if cand is not None and med is not None:
             limit = med * (1.0 + tr["tol_tail"])
             add("step_tail_p99", row, cand, med, limit, cand <= limit)
+    # Quantized-serving speedup floor (docs/QUANT.md): int8 must beat
+    # the bf16 serving path by min_int8_speedup — an absolute contract,
+    # not a trajectory comparison, because the whole point of shipping
+    # the path is the speedup. TPU rows only: XLA's CPU int8 lowering
+    # has no MXU advantage, so CPU rows (where the gate MACHINERY is
+    # verified in tier-1) are recorded but not floored.
+    row = candidate.get("int8_serve")
+    if isinstance(row, dict):
+        tr = {**t, **ROW_TOLERANCES.get("int8_serve", {})}
+        sp = row.get("speedup_vs_bf16")
+        if isinstance(sp, (int, float)) and row.get("backend") == "tpu":
+            add("int8_speedup", "int8_serve", sp, None,
+                tr["min_int8_speedup"], sp >= tr["min_int8_speedup"])
     return checks
 
 
@@ -166,12 +184,17 @@ def render(checks: List[dict]) -> str:
 # ---------------------------------------------------------------------------
 
 def _synth(ips=1000.0, mfu=0.30, compile_s=20.0, spread=2.0,
-           p99=1.2) -> dict:
-    return {"metric": "train_throughput", "value": ips,
-            "unit": "images/sec/chip",
-            "fp32": {"images_per_sec_per_chip": ips, "mfu": mfu,
-                     "compile_s": compile_s, "spread_pct": spread,
-                     "step_ms_p50": 1.0, "step_ms_p99": p99}}
+           p99=1.2, int8=None) -> dict:
+    doc = {"metric": "train_throughput", "value": ips,
+           "unit": "images/sec/chip",
+           "fp32": {"images_per_sec_per_chip": ips, "mfu": mfu,
+                    "compile_s": compile_s, "spread_pct": spread,
+                    "step_ms_p50": 1.0, "step_ms_p99": p99}}
+    if int8 is not None:   # (speedup_vs_bf16, backend)
+        doc["int8_serve"] = {"images_per_sec_per_chip": 5000.0,
+                             "speedup_vs_bf16": int8[0],
+                             "backend": int8[1], "spread_pct": 2.0}
+    return doc
 
 
 #: (case name, candidate overrides, expected gate verdict).
@@ -185,6 +208,11 @@ SELF_CHECK_TABLE = (
     ("spread_blowup", {"spread": 15.0}, False),
     ("tail_p99_2x", {"p99": 2.4}, False),
     ("warm_cache_compile_0", {"compile_s": 0.1}, True),
+    # int8_serve speedup floor: absolute, TPU rows only (the row's own
+    # backend key decides — a CPU row never trips it).
+    ("int8_speedup_ok", {"int8": (1.8, "tpu")}, True),
+    ("int8_speedup_low", {"int8": (1.2, "tpu")}, False),
+    ("int8_cpu_not_floored", {"int8": (0.8, "cpu")}, True),
 )
 
 
@@ -233,6 +261,9 @@ def main(argv=None) -> int:
     p.add_argument("--tol-tail", type=float, default=None,
                    help=f"max fractional step_ms_p99 growth "
                         f"(default {DEFAULTS['tol_tail']})")
+    p.add_argument("--min-int8-speedup", type=float, default=None,
+                   help=f"int8_serve speedup_vs_bf16 floor, TPU rows "
+                        f"only (default {DEFAULTS['min_int8_speedup']})")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--self-check", action="store_true",
                    help="run the built-in synthetic decision table "
@@ -259,7 +290,8 @@ def main(argv=None) -> int:
     checks = gate(candidate, baselines,
                   tol_throughput=args.tol_throughput,
                   tol_mfu=args.tol_mfu, tol_compile=args.tol_compile,
-                  max_spread=args.max_spread, tol_tail=args.tol_tail)
+                  max_spread=args.max_spread, tol_tail=args.tol_tail,
+                  min_int8_speedup=args.min_int8_speedup)
     bad = any(not c["ok"] for c in checks)
     if args.format == "json":
         print(json.dumps({"candidate": args.candidate,

@@ -976,6 +976,161 @@ class ChaosHarness:
                 return f"schema: {errs[0]}"
         return None
 
+    # -- the quantized-publish fleet sim (once per net campaign) ----------
+
+    def run_fleet_quant_publish(self) -> Optional[str]:
+        """One fleet sim of the int8 rollout path (docs/QUANT.md): a
+        fleet armed with ``--serve_quantize int8`` boots serving FLOAT
+        (nothing published yet), a checkpoint lands mid-load, the
+        directory publisher publishes its quantized variant, and the
+        worker must calibrate + gate + hot-swap float→int8 between
+        micro-batches. Invariants: zero failed client requests across
+        the whole drive, the pre-publish responses carry the bare float
+        version, the fleet demonstrably flips to a ``+int8``-suffixed
+        version, no response ever carries any OTHER version, and every
+        stream stays schema-strict. Returns the first violated
+        invariant or None."""
+        import copy
+        import socket
+        import threading
+
+        import numpy as np
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from dml_cnn_cifar10_tpu.ckpt import checkpoint as ckpt_lib
+        from dml_cnn_cifar10_tpu.config import DataConfig, TrainConfig
+        from dml_cnn_cifar10_tpu.fleet.controller import main_fleet
+        from dml_cnn_cifar10_tpu.quant.convert import is_quantized_version
+        from dml_cnn_cifar10_tpu.train.loop import Trainer
+        from tools.loadgen import _HttpClient
+
+        fdir = os.path.join(self.workdir, "fleet_quant")
+        os.makedirs(fdir, exist_ok=True)
+        stream = os.path.join(fdir, "router.jsonl")
+        cfg = TrainConfig(
+            log_dir=os.path.join(fdir, "logs"),
+            metrics_jsonl=stream,
+            data=DataConfig(dataset="synthetic",
+                            data_dir=self.data_dir,
+                            synthetic_train_records=256,
+                            synthetic_test_records=64,
+                            normalize="scale",
+                            use_native_loader=False))
+        cfg.model.logit_relu = False
+        cfg.serve.buckets = (1, 4)
+        cfg.serve.batch_window_ms = 1.0
+        cfg.serve.metrics_every_s = 0.5
+        cfg.serve.drain_deadline_s = 5.0
+        cfg.serve.quantize = "int8"
+        cfg.serve.quant_calib_batches = 2
+        # The gate MECHANISM is under test, not the numeric threshold:
+        # on untrained weights both accuracies sit at chance and the
+        # delta is sampling noise, so a production-tight 0.5% would
+        # make the sim a coin flip. The rejection path has its own
+        # tier-1 test (tests/test_quant.py).
+        cfg.serve.quant_max_delta = 0.5
+        cfg.fleet.dir = os.path.join(fdir, "fleet")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            cfg.fleet.port = s.getsockname()[1]
+        cfg.fleet.min_replicas = 1
+        cfg.fleet.max_replicas = 1
+        cfg.fleet.heartbeat_interval_s = 0.1
+        cfg.fleet.replica_dead_after_s = 2.0
+        cfg.fleet.metrics_every_s = 0.5
+        cfg.fleet.swap_poll_s = 0.2
+        cfg.fleet.publish_poll_s = 0.2
+        # The checkpoint the sim drops mid-load: built through the same
+        # Trainer the worker restores with, so the published candidate
+        # is structurally exactly what a training run would publish.
+        # Separate logger target — the driver must not interleave the
+        # router's stream.
+        tcfg = copy.deepcopy(cfg)
+        tcfg.metrics_jsonl = None
+        trainer = Trainer(tcfg)
+        ckpt_state = trainer.init_or_restore()
+        ready, stop = threading.Event(), threading.Event()
+        thread = threading.Thread(
+            target=lambda: main_fleet(cfg, ready_event=ready,
+                                      stop_event=stop),
+            name="chaos-fleet-quant", daemon=True)
+        rng = np.random.default_rng(0)
+        images = rng.integers(0, 256, (4, 32, 32, 3)).astype(np.uint8)
+        deadline = time.time() + self.deadline_s
+        float_version = None
+        versions_seen: Dict[str, int] = {}
+        try:
+            thread.start()
+            if not ready.wait(min(120.0, self.deadline_s)):
+                return "deadline: fleet router never became ready"
+            client = _HttpClient(f"http://127.0.0.1:{cfg.fleet.port}")
+            # Pre-publish: the fleet must serve, and serve FLOAT.
+            while True:
+                try:
+                    outcome, ver = client.predict(images[0].tobytes())
+                except OSError:
+                    outcome, ver = "connect", None
+                if outcome == "ok":
+                    break
+                if time.time() > deadline:
+                    return "deadline: fleet never served fault-free"
+                time.sleep(0.5)
+            if ver is None or is_quantized_version(str(ver)):
+                return (f"float_first: pre-publish response carries "
+                        f"version {ver!r} (want the bare float tag)")
+            float_version = str(ver)
+            versions_seen[float_version] = 1
+            # Publish the quantized variant mid-load: the checkpoint
+            # lands here; the controller's DirectoryPublisher (armed
+            # with quantize="int8") takes it from there.
+            ckpt_lib.save_checkpoint(tcfg.log_dir, ckpt_state, 5)
+            failures = 0
+            confirmed = 0   # +int8-versioned responses seen
+            for i in range(400):
+                try:
+                    outcome, ver = client.predict(
+                        images[i % 4].tobytes())
+                except OSError:
+                    outcome, ver = "connect", None
+                if outcome != "ok":
+                    failures += 1
+                elif ver is not None:
+                    key = str(ver)
+                    versions_seen[key] = versions_seen.get(key, 0) + 1
+                    if is_quantized_version(key):
+                        confirmed += 1
+                if confirmed >= 20:   # swap observed + held under load
+                    break
+                if time.time() > deadline:
+                    return ("deadline: quantized-publish drive "
+                            "outlived the budget")
+                time.sleep(0.05)
+            if failures:
+                return (f"completed: {failures} client requests failed "
+                        f"across the quantized hot-swap (want 0)")
+            if not confirmed:
+                return (f"quant_swap: fleet never served a +int8 "
+                        f"version (saw {sorted(versions_seen)})")
+            stray = [v for v in versions_seen
+                     if v != float_version and not is_quantized_version(v)]
+            if stray:
+                return (f"version_suffix: responses carried "
+                        f"unexpected version(s) {stray}")
+        finally:
+            stop.set()
+            thread.join(timeout=60.0)
+        streams = [stream]
+        tdir = os.path.join(cfg.fleet.dir, "telemetry")
+        if os.path.isdir(tdir):
+            streams += [os.path.join(tdir, f)
+                        for f in sorted(os.listdir(tdir))
+                        if f.endswith(".jsonl")]
+        for path in streams:
+            errs = check_jsonl_schema.check_file(path, strict=True)
+            if errs:
+                return f"schema: {errs[0]}"
+        return None
+
     # -- shrinking --------------------------------------------------------
 
     def shrink(self, events: List[faults_lib.FaultEvent], scenario: str,
@@ -1093,6 +1248,26 @@ def run_campaign(seeds: Sequence[int], scenario: str, workdir: str,
                    "secs": round(time.time() - t0, 2)}
             if inv is not None:
                 rec["reproducer"] = "fleet_partition"
+            logger.log("chaos", **rec)
+            results.append(rec)
+            if verbose:
+                print(f"[chaos]   {'OK' if inv is None else 'FAILED: '}"
+                      f"{inv or ''} in {rec['secs']:.1f}s")
+            # And the quantized-rollout sim (docs/QUANT.md): same
+            # once-per-campaign rule — the fleet must hot-swap
+            # float→int8 under load with zero client failures and
+            # consistent version suffixes.
+            if verbose:
+                print("[chaos] fleet quantized-publish sim "
+                      "(float→int8 hot-swap under load)")
+            t0 = time.time()
+            inv = harness.run_fleet_quant_publish()
+            rec = {"seed": -2, "scenario": scenario,
+                   "spec": "fleet_quant_publish", "ok": inv is None,
+                   "invariant": inv,
+                   "secs": round(time.time() - t0, 2)}
+            if inv is not None:
+                rec["reproducer"] = "fleet_quant_publish"
             logger.log("chaos", **rec)
             results.append(rec)
             if verbose:

@@ -165,11 +165,21 @@ KIND_KEYS = {
     # Serving runtime (serve/metrics.py; docs/SERVING.md). Percentile
     # values are null until the window has completions.
     "serve": ("requests", "completed", "shed_queue", "shed_deadline",
-              "qps", "p50_ms", "p95_ms", "p99_ms", "batch_fill",
-              "window_s"),
+              "cache_hit", "qps", "p50_ms", "p95_ms", "p99_ms",
+              "batch_fill", "window_s"),
     "serve_done": ("requests", "completed", "shed_queue",
-                   "shed_deadline", "qps", "p50_ms", "p95_ms", "p99_ms",
-                   "batch_fill", "shed_fraction", "total_s"),
+                   "shed_deadline", "cache_hit", "qps", "p50_ms",
+                   "p95_ms", "p99_ms", "batch_fill", "shed_fraction",
+                   "total_s"),
+    # Quantized serving (quant/; docs/QUANT.md). `calibration` is one
+    # record per calibrated tensor (weights per-channel, activations
+    # per-tensor; channels=0 marks a per-tensor scale); `quant_rejected`
+    # is the accuracy-delta publish gate firing — the int8 candidate's
+    # holdout top-1 trailed float by more than max_delta, so the
+    # previous version keeps serving (the quantized `swap_rejected`).
+    "calibration": ("tensor", "amax", "scale", "channels", "batches"),
+    "quant_rejected": ("replica_id", "version", "float_top1",
+                       "quant_top1", "delta", "max_delta", "reason"),
     # Serving fleet (fleet/; docs/SERVING.md fleet section). `fleet` is
     # the router's periodic window (replica membership + routing
     # counters; `fleet_done` the final cumulative one); `swap` a

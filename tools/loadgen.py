@@ -57,6 +57,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import math
 import os
@@ -123,6 +124,26 @@ def load_images(args, image_shape):
     return rng.integers(0, 256, (256, *image_shape), dtype=np.uint8)
 
 
+def load_check_set(path):
+    """``--check_labels``: (images, {sha1(image bytes) -> label}) from
+    an npz with ``images`` [N,H,W,C] uint8 + ``labels`` [N]. Keyed by
+    request-body digest, not pool index, because the drive loops walk
+    the shared pool concurrently — the label is recovered from the
+    exact bytes each request carried."""
+    import numpy as np
+
+    with np.load(path) as z:
+        images = np.ascontiguousarray(z["images"]).astype(np.uint8)
+        labels = np.asarray(z["labels"]).astype(np.int64)
+    if images.ndim != 4 or images.shape[0] != labels.shape[0]:
+        raise SystemExit(
+            f"--check_labels: want images [N,H,W,C] + labels [N], got "
+            f"images {images.shape} / labels {labels.shape}")
+    by_digest = {hashlib.sha1(images[i].tobytes()).hexdigest():
+                 int(labels[i]) for i in range(images.shape[0])}
+    return images, by_digest
+
+
 class ClientStats:
     """Client-side accounting shared by every drive mode: completions
     with latency + the responding model version, sheds, and (the
@@ -133,12 +154,14 @@ class ClientStats:
         self.completed = 0
         self.shed = 0
         self.rejected = 0
+        self.label_checked = 0
+        self.label_correct = 0
         self.latencies = []
         self.samples = []   # (latency_s, trace_id, version) per completion
         self.versions = {}
 
     def record(self, outcome: str, dt: float = 0.0, version=None,
-               trace_id=None):
+               trace_id=None, correct=None):
         with self.lock:
             if outcome == "ok":
                 self.completed += 1
@@ -147,6 +170,9 @@ class ClientStats:
                 if version is not None:
                     key = str(version)
                     self.versions[key] = self.versions.get(key, 0) + 1
+                if correct is not None:   # --check_labels verification
+                    self.label_checked += 1
+                    self.label_correct += int(correct)
             elif outcome == "shed":
                 self.shed += 1
             else:
@@ -163,8 +189,11 @@ class _HttpClient:
         # cell_route crossing when it must fail over out of it.
         self.target_cell = target_cell
 
-    def predict(self, body: bytes, trace_header=None):
-        """("ok", version) | ("shed", None) | ("rejected", None)."""
+    def predict(self, body: bytes, trace_header=None, full=False):
+        """("ok", version) | ("shed", None) | ("rejected", None).
+        ``full=True`` returns the whole response payload as the second
+        element instead (the ``--check_labels`` path needs the
+        predicted ``class`` too)."""
         import urllib.error
         import urllib.request
 
@@ -179,7 +208,7 @@ class _HttpClient:
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
                 payload = json.loads(resp.read())
-            return "ok", payload.get("version")
+            return "ok", (payload if full else payload.get("version"))
         except urllib.error.HTTPError as e:
             if e.code == 503:
                 return "shed", None
@@ -248,7 +277,7 @@ def _row(stats: ClientStats, wall: float, latency_summary) -> dict:
     # (tools/trace_aggregate.py --out), and its version says which
     # weights answered it.
     slowest = sorted(stats.samples, key=lambda s: -s[0])[:5]
-    return {
+    row = {
         "requests": total,
         "completed": stats.completed,
         "shed": stats.shed,
@@ -265,6 +294,15 @@ def _row(stats: ClientStats, wall: float, latency_summary) -> dict:
                      "trace_id": tid, "version": ver}
                     for dt, tid, ver in slowest],
     }
+    if stats.label_checked:
+        # --check_labels: end-to-end prediction accuracy as the client
+        # measured it — over the wire for HTTP targets, so a quantized
+        # (or wrong) serving path shows up here, not just in its own
+        # publish gate.
+        row["label_checked"] = stats.label_checked
+        row["accuracy"] = round(
+            stats.label_correct / stats.label_checked, 4)
+    return row
 
 
 def main(argv=None) -> int:
@@ -309,6 +347,12 @@ def main(argv=None) -> int:
     ap.add_argument("--crop_size", type=int, default=24)
     ap.add_argument("--source", choices=["random", "dataset"],
                     default="random")
+    ap.add_argument("--check_labels", type=str, default=None,
+                    help="npz with images [N,H,W,C] uint8 + labels "
+                         "[N]: drive THESE images (replacing --source) "
+                         "and verify each response's predicted class "
+                         "against its label; the report gains "
+                         "accuracy + label_checked")
     ap.add_argument("--dataset", type=str, default="synthetic")
     ap.add_argument("--data_dir", type=str, default="cifar10data")
     ap.add_argument("--metrics_jsonl", type=str, default=None,
@@ -368,12 +412,15 @@ def main(argv=None) -> int:
 
     batcher = None
     metrics = None
+    labels_by_digest = None
     if args.target:
         client = _HttpClient(args.target, target_cell=args.target_cell)
         rng = np.random.default_rng(args.seed)
         images = rng.integers(
             0, 256, (256, args.image_size, args.image_size, 3),
             dtype=np.uint8)
+        if args.check_labels:
+            images, labels_by_digest = load_check_set(args.check_labels)
 
         def submit(img, stats, oversize):
             # Oversize = wrong byte count on the wire; the server (or
@@ -382,15 +429,26 @@ def main(argv=None) -> int:
             body = img.tobytes() + (b"\x00" if oversize else b"")
             ctx = reqtrace.mint(args.trace_sample_rate)
             t0 = time.perf_counter()
-            outcome, version = client.predict(
-                body, trace_header=ctx.header())
+            correct = None
+            if labels_by_digest is None:
+                outcome, version = client.predict(
+                    body, trace_header=ctx.header())
+            else:
+                outcome, payload = client.predict(
+                    body, trace_header=ctx.header(), full=True)
+                version = (payload or {}).get("version")
+                label = labels_by_digest.get(
+                    hashlib.sha1(body).hexdigest())
+                if outcome == "ok" and label is not None:
+                    correct = (payload or {}).get("class") == label
             dt = time.perf_counter() - t0
             if outcome == "shed":
                 ctx.force()
             reqtrace.emit_span(logger, ctx, "client", dt,
                                reqtrace.wallclock_at(t0),
                                outcome=outcome, version=version)
-            stats.record(outcome, dt, version, trace_id=ctx.trace_id)
+            stats.record(outcome, dt, version, trace_id=ctx.trace_id,
+                         correct=correct)
     else:
         from dml_cnn_cifar10_tpu.serve.batcher import (MicroBatcher,
                                                        ShedError)
@@ -398,6 +456,8 @@ def main(argv=None) -> int:
 
         engine = build_engine(args)
         images = load_images(args, engine.image_shape)
+        if args.check_labels:
+            images, labels_by_digest = load_check_set(args.check_labels)
         metrics = ServeMetrics()
         buckets = tuple(int(b) for b in args.buckets.split(",") if b)
         batcher = MicroBatcher(
@@ -422,10 +482,17 @@ def main(argv=None) -> int:
                 row = batcher.submit(img, trace=ctx).result()
                 dt = time.perf_counter() - t0
                 version = getattr(row, "version", None)
+                correct = None
+                if labels_by_digest is not None:
+                    label = labels_by_digest.get(
+                        hashlib.sha1(img.tobytes()).hexdigest())
+                    if label is not None:
+                        correct = int(np.asarray(row).argmax()) == label
                 reqtrace.emit_span(logger, ctx, "client", dt,
                                    reqtrace.wallclock_at(t0),
                                    outcome="ok", version=version)
-                stats.record("ok", dt, version, trace_id=ctx.trace_id)
+                stats.record("ok", dt, version, trace_id=ctx.trace_id,
+                             correct=correct)
             except ShedError:
                 dt = time.perf_counter() - t0
                 ctx.force()
@@ -454,6 +521,7 @@ def main(argv=None) -> int:
         "queue_depth": args.queue_depth,
         "batch_window_ms": args.batch_window_ms,
         "source": args.source,
+        "check_labels": args.check_labels,
         "seed": args.seed,
     }
 

@@ -385,6 +385,67 @@ def summarize(path: str) -> str:
     return summarize_records(load_records(path), path)
 
 
+def _quant_summary(records: List[dict]) -> Optional[dict]:
+    """Quantized-serving rollout view (docs/QUANT.md): calibration
+    coverage (how many tensors, over how many batches, at what scales),
+    publish-gate outcomes (a ``swap`` to a ``+int8`` version is an
+    accept; ``quant_rejected`` is the gate holding the line), and how
+    much traffic each variant actually answered — shared by the text
+    and ``--format json`` paths. None when the stream has no
+    quantization activity at all."""
+    def _is_q(v) -> bool:
+        return str(v).endswith("+int8")
+
+    calibs = [r for r in records if r.get("kind") == "calibration"]
+    rejects = [r for r in records if r.get("kind") == "quant_rejected"]
+    accepts = [r for r in records if r.get("kind") == "swap"
+               and _is_q(r.get("version"))]
+    if not (calibs or rejects or accepts):
+        return None
+    out: dict = {}
+    if calibs:
+        acts = [r for r in calibs
+                if str(r.get("tensor", "")).startswith("act/")]
+        scales = [r.get("scale") for r in calibs
+                  if isinstance(r.get("scale"), (int, float))]
+        out["calibration"] = {
+            "records": len(calibs),
+            "weight_tensors": len(calibs) - len(acts),
+            "act_tensors": len(acts),
+            "batches": max((r.get("batches") or 0 for r in calibs),
+                           default=0),
+            "scale_min": min(scales) if scales else None,
+            "scale_max": max(scales) if scales else None,
+        }
+    out["publishes"] = {"accepted": len(accepts),
+                        "rejected": len(rejects)}
+    if rejects:
+        out["rejections"] = [
+            {"version": r.get("version"),
+             "replica_id": r.get("replica_id"),
+             "delta": r.get("delta"), "max_delta": r.get("max_delta")}
+            for r in rejects]
+    # Traffic split: the fleet's cumulative version mix when the run
+    # flushed one, summed windows otherwise (same fallback the fleet
+    # health section uses).
+    fleet_done = _last(records, "fleet_done")
+    if fleet_done:
+        mix = dict(fleet_done.get("version_mix") or {})
+    else:
+        mix = {}
+        for r in records:
+            if r.get("kind") == "fleet":
+                for v, n in (r.get("version_mix") or {}).items():
+                    mix[v] = mix.get(v, 0) + n
+    if mix:
+        out["traffic"] = {
+            "by_version": mix,
+            "int8": sum(n for v, n in mix.items() if _is_q(v)),
+            "float": sum(n for v, n in mix.items() if not _is_q(v)),
+        }
+    return out
+
+
 def summarize_records(records: List[dict], header: str) -> str:
     """The report body over an in-memory record list — the seam
     ``--follow`` re-renders from as the stream grows (no re-reading
@@ -633,6 +694,37 @@ def summarize_records(records: List[dict], header: str) -> str:
                 sorted(dev_rows[-1]["device_ms"].items()))
             lines.append(f"    per-replica device_ms (beats, last "
                          f"window): {per}")
+    # Quantized serving (quant/; docs/QUANT.md): calibration coverage,
+    # what the publish-time accuracy gate decided, and the float/int8
+    # traffic split — the stream-side answer to "is the fleet actually
+    # serving the quantized variant, and did anything get rejected on
+    # the way there".
+    quant = _quant_summary(records)
+    if quant:
+        lines.append("  quantization (int8 serving):")
+        cal = quant.get("calibration")
+        if cal:
+            rng = ""
+            if cal["scale_min"] is not None:
+                rng = (f", scales [{cal['scale_min']:.3g}, "
+                       f"{cal['scale_max']:.3g}]")
+            lines.append(
+                f"    calibration: {cal['weight_tensors']} weight / "
+                f"{cal['act_tensors']} activation tensor record(s) "
+                f"over {cal['batches']} batch(es){rng}")
+        pub = quant["publishes"]
+        lines.append(f"    publish gate: {pub['accepted']} accepted, "
+                     f"{pub['rejected']} rejected")
+        for r in quant.get("rejections", []):
+            lines.append(
+                f"      REJECTED {r['version']} on replica "
+                f"{r['replica_id']}: top-1 delta {r['delta']:+.4f} > "
+                f"max {r['max_delta']:.4f}")
+        tr = quant.get("traffic")
+        if tr:
+            lines.append(
+                f"    traffic mix: {tr['int8']} int8 / {tr['float']} "
+                f"float response(s)")
     # Alerting (utils/alerts.py; docs/OBSERVABILITY.md Alerting
     # section): what fired while the run was live, what resolved, and
     # what was STILL firing when the stream ended — the post-hoc view
@@ -1000,6 +1092,9 @@ def summarize_json(path: str) -> dict:
                                     if r.get("kind") == "swap")
         out["fleet"]["scales"] = sum(1 for r in records
                                      if r.get("kind") == "scale")
+    quant = _quant_summary(records)
+    if quant:
+        out["quant"] = quant
     chaos_runs = [r for r in records if r.get("kind") == "chaos"]
     chaos_done = _chaos_totals(records)
     if chaos_runs or chaos_done:
